@@ -1,0 +1,49 @@
+"""bigdl_tpu — a TPU-native LLM acceleration framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of IPEX-LLM
+(reference: /root/reference, qiuxin2012/BigDL): low-bit weight quantization
+(INT4/INT8/NF4/FP4/FP8/...), an optimized model zoo, KV-cache management,
+decode-time algorithms (speculative decoding, prompt lookup), QLoRA-style
+finetuning, and distributed inference/training over a `jax.sharding.Mesh`.
+
+Where the reference patches PyTorch/HuggingFace modules in place
+(ipex_llm/transformers/convert.py), this framework owns its model
+definitions: models are pure functions over parameter pytrees whose leaves
+may be `QTensor` (packed low-bit weights + scales), and everything runs
+under `jax.jit` on a device mesh.
+
+Public API (mirrors the reference's user surface, optimize.py:197 and
+transformers/model.py:111):
+
+    from bigdl_tpu import AutoModelForCausalLM
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="sym_int4")
+    out = model.generate(token_ids, max_new_tokens=64)
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.quant import QTensor, quantize, dequantize, qtype_registry
+
+__all__ = [
+    "QTensor",
+    "quantize",
+    "dequantize",
+    "qtype_registry",
+    "AutoModelForCausalLM",
+    "optimize_model",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import bigdl_tpu` light (no transformers/safetensors
+    # unless the HF ingest path is actually used).
+    if name == "AutoModelForCausalLM":
+        from bigdl_tpu.api import AutoModelForCausalLM
+
+        return AutoModelForCausalLM
+    if name == "optimize_model":
+        from bigdl_tpu.api import optimize_model
+
+        return optimize_model
+    raise AttributeError(f"module 'bigdl_tpu' has no attribute {name!r}")

@@ -1,0 +1,124 @@
+"""User-facing API.
+
+Mirrors the reference's two entry points (SURVEY.md §3.1):
+- `AutoModelForCausalLM.from_pretrained(path, load_in_low_bit=...)`
+  (reference transformers/model.py:111) — load an HF checkpoint directory
+  and quantize on the fly;
+- `optimize_model(...)` (reference optimize.py:197) — quantize an
+  already-built dense param tree;
+plus `save_low_bit`/`load_low_bit` fast reload (model.py:58-104).
+
+The returned `TpuModel` wraps (config, params, qtype) with a
+`generate()` that compiles one XLA program per (bucket, max_new_tokens)
+and runs the whole decode loop on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.generate import GenerationConfig, generate_tokens, pad_prompts
+from bigdl_tpu.models import get_family
+from bigdl_tpu.models.config import ModelConfig
+
+
+def optimize_model(
+    params: dict,
+    config: ModelConfig,
+    low_bit: str = "sym_int4",
+    lm_head_qtype: Optional[str] = None,
+) -> dict:
+    """Quantize a dense param tree in place of the reference's module
+    surgery (optimize.py:197 → ggml_convert_low_bit)."""
+    family = get_family(config.model_type)
+    return family.quantize_params(params, low_bit, lm_head_qtype)
+
+
+@dataclasses.dataclass
+class TpuModel:
+    config: ModelConfig
+    params: dict
+    qtype: str
+
+    @property
+    def family(self):
+        return get_family(self.config.model_type)
+
+    def save_low_bit(self, path: str) -> None:
+        from bigdl_tpu.convert import save_low_bit
+
+        save_low_bit(path, self.config, self.params, self.qtype)
+
+    def generate(
+        self,
+        prompts: Union[Sequence[Sequence[int]], np.ndarray],
+        max_new_tokens: int = 32,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: int = 0,
+        seed: int = 0,
+        quantize_kv: bool = False,
+    ) -> np.ndarray:
+        """prompts: ragged list of token-id lists (or [B, T] array).
+        Returns [B, max_new_tokens] generated ids."""
+        if isinstance(prompts, np.ndarray):
+            prompts = [list(row) for row in prompts]
+        tokens, start = pad_prompts(prompts, pad_token_id)
+        gen = GenerationConfig(
+            max_new_tokens=max_new_tokens,
+            do_sample=do_sample,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id,
+        )
+        # cache sized to a 64-slot multiple: few distinct compiled programs
+        need = tokens.shape[1] + max_new_tokens
+        cache_len = ((need + 63) // 64) * 64
+        out = generate_tokens(
+            self.config,
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(start),
+            jax.random.PRNGKey(seed),
+            gen,
+            self.family.forward,
+            cache_len=cache_len,
+            quantize_kv=quantize_kv,
+        )
+        return np.asarray(out)
+
+
+class AutoModelForCausalLM:
+    """Loader namespace, reference-compatible spelling
+    (ipex_llm.transformers.AutoModelForCausalLM)."""
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        model_path: str,
+        load_in_low_bit: str = "sym_int4",
+        load_in_4bit: bool = False,
+        **_ignored,
+    ) -> TpuModel:
+        from bigdl_tpu.convert import load_hf_checkpoint
+
+        qtype = "sym_int4" if load_in_4bit else load_in_low_bit
+        config, params = load_hf_checkpoint(model_path, qtype=qtype)
+        return TpuModel(config=config, params=params, qtype=qtype)
+
+    @classmethod
+    def load_low_bit(cls, path: str) -> TpuModel:
+        from bigdl_tpu.convert import load_low_bit
+
+        config, params, qtype = load_low_bit(path)
+        return TpuModel(config=config, params=params, qtype=qtype)

@@ -1,0 +1,157 @@
+"""HuggingFace checkpoint ingest.
+
+Equivalent of the reference load path (`transformers/model.py:111`
+`from_pretrained` → `load_convert` → `ggml_convert_low_bit`,
+SURVEY.md §3.1), TPU-shaped: safetensors shards are streamed tensor by
+tensor, each layer's weights are quantized immediately (peak host memory
+~ one layer in fp32), and per-layer results are stacked along the leading
+axis for `lax.scan`.
+
+Shards are read via safetensors' torch framework (robust bf16/fp16
+handling); torch is imported lazily and only by this ingest path —
+the runtime itself never touches it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.quant import QTensor, quantize
+from bigdl_tpu.quant.qtypes import resolve_qtype
+
+# our layer-param name -> HF per-layer suffix
+_LAYER_MAP = {
+    "attn_norm": "input_layernorm.weight",
+    "mlp_norm": "post_attention_layernorm.weight",
+    "wq": "self_attn.q_proj.weight",
+    "wk": "self_attn.k_proj.weight",
+    "wv": "self_attn.v_proj.weight",
+    "wo": "self_attn.o_proj.weight",
+    "w_gate": "mlp.gate_proj.weight",
+    "w_up": "mlp.up_proj.weight",
+    "w_down": "mlp.down_proj.weight",
+    "bq": "self_attn.q_proj.bias",
+    "bk": "self_attn.k_proj.bias",
+    "bv": "self_attn.v_proj.bias",
+}
+
+_QUANT_TARGETS = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+
+
+def state_dict_mapping(config: ModelConfig) -> dict[str, list[str]]:
+    """our param path -> list of HF tensor names (one per layer for stacked)."""
+    L = config.num_hidden_layers
+    mapping: dict[str, list[str]] = {
+        "embed": ["model.embed_tokens.weight"],
+        "final_norm": ["model.norm.weight"],
+    }
+    if not config.tie_word_embeddings:
+        mapping["lm_head"] = ["lm_head.weight"]
+    for ours, suffix in _LAYER_MAP.items():
+        if ours.startswith("b") and not config.attention_bias:
+            continue
+        mapping[f"layers.{ours}"] = [
+            f"model.layers.{i}.{suffix}" for i in range(L)
+        ]
+    return mapping
+
+
+def params_from_state_dict(
+    config: ModelConfig,
+    get_tensor: Callable[[str], np.ndarray],
+    qtype: str = "sym_int4",
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Build the model param pytree from a tensor-name accessor.
+
+    `get_tensor` returns a numpy array for an HF tensor name (backed by a
+    dict for tests, or by lazy safetensors shards for real checkpoints).
+    """
+    spec = resolve_qtype(qtype)
+    params: dict = {"layers": {}}
+
+    def put(path: str, value):
+        parts = path.split(".")
+        node = params
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+
+    for path, names in state_dict_mapping(config).items():
+        leaf = path.split(".")[-1]
+        quantize_it = (not spec.is_dense) and (
+            leaf in _QUANT_TARGETS or path == "lm_head"
+        )
+        per_layer = []
+        for name in names:
+            arr = np.asarray(get_tensor(name))
+            if quantize_it:
+                per_layer.append(quantize(jnp.asarray(arr, jnp.float32), spec.name))
+            else:
+                per_layer.append(jnp.asarray(arr).astype(dtype))
+        if len(per_layer) == 1:
+            put(path, per_layer[0])
+        elif isinstance(per_layer[0], QTensor):
+            stacked = QTensor(
+                data=jnp.stack([q.data for q in per_layer]),
+                scales=jnp.stack([q.scales for q in per_layer]),
+                mins=(
+                    jnp.stack([q.mins for q in per_layer])
+                    if per_layer[0].mins is not None
+                    else None
+                ),
+                qtype=per_layer[0].qtype,
+            )
+            put(path, stacked)
+        else:
+            put(path, jnp.stack(per_layer))
+    return params
+
+
+def load_hf_checkpoint(
+    model_path: str,
+    qtype: str = "sym_int4",
+    dtype=jnp.bfloat16,
+    config: Optional[ModelConfig] = None,
+) -> tuple[ModelConfig, dict]:
+    """Load an HF-format local checkpoint directory (config.json +
+    *.safetensors) into a quantized param tree."""
+    import torch  # lazy: only the ingest path touches torch
+    from safetensors import safe_open  # lazy: heavy import
+
+    if config is None:
+        with open(os.path.join(model_path, "config.json")) as f:
+            config = ModelConfig.from_hf_config(json.load(f))
+
+    index_path = os.path.join(model_path, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            weight_map = json.load(f)["weight_map"]
+    else:
+        single = os.path.join(model_path, "model.safetensors")
+        with safe_open(single, framework="pt") as f:
+            weight_map = {k: "model.safetensors" for k in f.keys()}
+
+    handles: dict[str, object] = {}
+
+    def get_tensor(name: str) -> np.ndarray:
+        if name not in weight_map and name == "lm_head.weight":
+            # some checkpoints tie without the flag; fall back to embeddings
+            name = "model.embed_tokens.weight"
+        shard = weight_map[name]
+        if shard not in handles:
+            # torch framework: robust bf16/fp16 handling without ml_dtypes
+            handles[shard] = safe_open(
+                os.path.join(model_path, shard), framework="pt"
+            )
+        t = handles[shard].get_tensor(name)
+        return t.to(dtype=torch.float32).numpy()
+
+    params = params_from_state_dict(config, get_tensor, qtype, dtype)
+    return config, params

@@ -1,0 +1,118 @@
+"""save_low_bit / load_low_bit — persist quantized models.
+
+Equivalent of the reference's `save_low_bit`/`load_low_bit`
+(transformers/model.py:58-104, optimize.py:40-57,137-196): quantize once,
+reload in seconds without re-running conversion. Format: a directory with
+
+    bigdl_tpu_config.json   {format_version, qtype, model_config, manifest}
+    weights.npz             flat arrays; bf16/fp8 stored as integer views
+
+The manifest records each pytree path, its dtype, and which paths fold
+back into QTensor nodes, so loading needs no model code — it rebuilds the
+exact param pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.quant import QTensor
+
+FORMAT_VERSION = 1
+
+_VIEW_DTYPES = {
+    "bfloat16": np.uint16,
+    "float8_e4m3fn": np.uint8,
+    "float8_e5m2": np.uint8,
+}
+
+
+def _encode(arr: jax.Array) -> tuple[np.ndarray, str]:
+    a = np.asarray(arr)
+    name = a.dtype.name
+    if name in _VIEW_DTYPES:
+        return a.view(_VIEW_DTYPES[name]), name
+    return a, name
+
+
+def _decode(a: np.ndarray, dtype_name: str) -> jnp.ndarray:
+    if dtype_name in _VIEW_DTYPES:
+        return jnp.asarray(a).view(jnp.dtype(dtype_name))
+    return jnp.asarray(a)
+
+
+def _flatten(tree: Any, prefix: str, arrays: dict, manifest: dict) -> None:
+    if isinstance(tree, QTensor):
+        manifest[prefix] = {"kind": "qtensor", "qtype": tree.qtype}
+        for field in ("data", "scales", "mins"):
+            val = getattr(tree, field)
+            if val is not None:
+                arr, dt = _encode(val)
+                arrays[f"{prefix}@{field}"] = arr
+                manifest[f"{prefix}@{field}"] = {"kind": "array", "dtype": dt}
+        return
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            _flatten(tree[k], f"{prefix}.{k}" if prefix else k, arrays, manifest)
+        return
+    arr, dt = _encode(tree)
+    arrays[prefix] = arr
+    manifest[prefix] = {"kind": "array", "dtype": dt}
+
+
+def save_low_bit(path: str, config: ModelConfig, params: dict, qtype: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    arrays: dict[str, np.ndarray] = {}
+    manifest: dict[str, dict] = {}
+    _flatten(params, "", arrays, manifest)
+    np.savez(os.path.join(path, "weights.npz"), **arrays)
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "qtype": qtype,
+        "model_config": dataclasses.asdict(config),
+        "manifest": manifest,
+    }
+    with open(os.path.join(path, "bigdl_tpu_config.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+def load_low_bit(path: str) -> tuple[ModelConfig, dict, str]:
+    """Returns (config, params, qtype)."""
+    with open(os.path.join(path, "bigdl_tpu_config.json")) as f:
+        meta = json.load(f)
+    if meta["format_version"] != FORMAT_VERSION:
+        raise ValueError(f"unsupported format_version {meta['format_version']}")
+    config = ModelConfig(**meta["model_config"])
+    manifest = meta["manifest"]
+    npz = np.load(os.path.join(path, "weights.npz"))
+
+    params: dict = {}
+
+    def put(path_key: str, value) -> None:
+        parts = path_key.split(".")
+        node = params
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+
+    for key, info in manifest.items():
+        if info["kind"] == "qtensor":
+            fields = {}
+            for field in ("data", "scales", "mins"):
+                fkey = f"{key}@{field}"
+                if fkey in manifest:
+                    fields[field] = _decode(npz[fkey], manifest[fkey]["dtype"])
+                else:
+                    fields[field] = None
+            put(key, QTensor(qtype=info["qtype"], **fields))
+        elif "@" not in key:
+            put(key, _decode(npz[key], info["dtype"]))
+    return config, params, meta["qtype"]

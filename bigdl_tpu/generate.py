@@ -1,0 +1,143 @@
+"""Generation: jitted prefill + on-device decode loop.
+
+The reference rides HF `GenerationMixin.generate` — a host-side Python
+token loop launching one eager kernel per op (SURVEY.md §3.2). The
+TPU-native design compiles the whole decode loop into one XLA program:
+`lax.while_loop` carrying the KV cache, with on-device sampling
+(greedy / temperature / top-k / top-p) and early exit when every row hit
+EOS. Host↔device traffic is two transfers total (prompt in, tokens out).
+
+Prompt lengths are bucketed (powers of two) so at most O(log S) prefill
+programs are ever compiled per model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.models.config import ModelConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 32
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+
+def sample_token(
+    logits: jax.Array,  # [B, V] float32
+    key: jax.Array,
+    gen: GenerationConfig,
+) -> jax.Array:
+    """On-device sampling; gen is static so dead branches compile away."""
+    if not gen.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / max(gen.temperature, 1e-5)
+    if gen.top_k is not None:
+        kth = jax.lax.top_k(logits, gen.top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if gen.top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        cutoff_idx = jnp.sum(cum < gen.top_p, axis=-1, keepdims=True) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def pad_prompts(
+    prompts: Sequence[Sequence[int]], pad_id: int, bucket: Optional[int] = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Left-pad a ragged batch to a power-of-two bucket.
+
+    Returns (tokens [B, T], start [B]) — `start[b]` = number of pad slots,
+    feeding KVCache's validity mask. Left-padding keeps every row's last
+    prompt token at index T-1, so prefill logits need no gather.
+    """
+    maxlen = max(len(p) for p in prompts)
+    if bucket is None:
+        bucket = 16
+        while bucket < maxlen:
+            bucket *= 2
+    assert bucket >= maxlen
+    b = len(prompts)
+    tokens = np.full((b, bucket), pad_id, np.int32)
+    start = np.zeros((b,), np.int32)
+    for i, p in enumerate(prompts):
+        tokens[i, bucket - len(p):] = np.asarray(p, np.int32)
+        start[i] = bucket - len(p)
+    return tokens, start
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "gen", "model_forward", "cache_len", "quantize_kv"),
+    donate_argnames=(),
+)
+def generate_tokens(
+    config: ModelConfig,
+    params,
+    tokens: jax.Array,  # [B, T] left-padded prompt
+    start: jax.Array,  # [B]
+    key: jax.Array,
+    gen: GenerationConfig,
+    model_forward,  # static: the family forward fn (models.llama.forward)
+    cache_len: int,
+    quantize_kv: bool = False,
+) -> jax.Array:
+    """One compiled program: prefill + full decode loop.
+
+    Returns [B, max_new_tokens] generated ids (pad_token_id after EOS).
+    """
+    B, T = tokens.shape
+    assert cache_len >= T + gen.max_new_tokens
+    cache = kvcache.init_cache(
+        config.num_hidden_layers, B, cache_len, config.num_key_value_heads,
+        config.head_dim_, quantize_kv=quantize_kv,
+    )
+    cache = dataclasses.replace(cache, start=start)
+
+    logits, cache = model_forward(config, params, tokens, cache, mode="prefill")
+    key, k0 = jax.random.split(key)
+    first = sample_token(logits[:, -1], k0, gen)
+
+    out = jnp.full((B, gen.max_new_tokens), gen.pad_token_id, jnp.int32)
+    out = out.at[:, 0].set(first)
+    eos = gen.eos_token_id
+    done = (
+        first == eos if eos is not None else jnp.zeros((B,), jnp.bool_)
+    )
+
+    def cond(state):
+        i, _, _, done, _, _ = state
+        return (i < gen.max_new_tokens) & ~jnp.all(done)
+
+    def step(state):
+        i, cur, cache, done, out, key = state
+        logits, cache = model_forward(
+            config, params, cur[:, None], cache, mode="decode"
+        )
+        key, k = jax.random.split(key)
+        nxt = sample_token(logits[:, -1], k, gen)
+        if eos is not None:
+            nxt = jnp.where(done, gen.pad_token_id, nxt)
+            done = done | (nxt == eos)
+        out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+        return (i + 1, nxt, cache, done, out, key)
+
+    state = (jnp.ones((), jnp.int32), first, cache, done, out, key)
+    _, _, _, _, out, _ = jax.lax.while_loop(cond, step, state)
+    return out

@@ -1,0 +1,33 @@
+"""Model zoo registry.
+
+The reference dispatches ~70 `model_type` branches in `_optimize_post`
+(convert.py:1251-2027) to per-file patched forwards. Here a family
+registry maps HF `model_type` to a (init, quantize, forward) triple; one
+decoder-family implementation covers the llama-shaped architectures and
+further families register alongside it.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.models.config import ModelConfig, PRESETS
+from bigdl_tpu.models import llama
+
+# model_type -> module implementing init_params / quantize_params / forward
+_FAMILIES = {
+    "llama": llama,
+    "mistral": llama,
+    "qwen2": llama,
+    # gemma2 intentionally absent until softcap/post-norms/(1+w)-rmsnorm are
+    # implemented — registering it would silently produce wrong logits.
+}
+
+
+def get_family(model_type: str):
+    if model_type not in _FAMILIES:
+        raise NotImplementedError(
+            f"model_type {model_type!r} not yet supported; have {sorted(_FAMILIES)}"
+        )
+    return _FAMILIES[model_type]
+
+
+__all__ = ["ModelConfig", "PRESETS", "get_family", "llama"]

@@ -1,0 +1,129 @@
+"""Model configuration.
+
+One frozen dataclass covers the decoder-family architectures the
+reference optimizes per-file in `transformers/models/` (llama, mistral,
+qwen2, ...; SURVEY.md §2.2 "Model zoo"): the differences the reference
+encodes as separate patched forwards (qkv bias, tied embeddings, rope
+scaling, sliding window, logit softcap) are config flags here, resolved
+once at trace time — dead branches compile away under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    model_type: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    head_dim: Optional[int] = None  # defaults to hidden // heads
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[dict] = None
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False  # qwen2-style qkv bias
+    mlp_bias: bool = False
+    sliding_window: Optional[int] = None  # mistral-style local attention
+    attn_logit_softcap: Optional[float] = None  # gemma2
+    final_logit_softcap: Optional[float] = None  # gemma2
+    hidden_act: str = "silu"
+    # gemma-style normalizations
+    scale_embeddings: bool = False  # multiply embed output by sqrt(hidden)
+    post_attn_norm: bool = False  # gemma2 extra norms around blocks
+    rms_norm_offset: bool = False  # gemma (1+w) rmsnorm weights
+
+    def __post_init__(self):
+        # ModelConfig is a static jit argument and must hash; rope_scaling
+        # arrives as a dict from HF config.json (or a list-of-pairs after a
+        # JSON round-trip through save_low_bit) — normalize to a tuple.
+        rs = self.rope_scaling
+        if isinstance(rs, dict):
+            rs = tuple(sorted(rs.items()))
+        elif isinstance(rs, (list, tuple)):
+            rs = tuple(tuple(kv) for kv in rs)
+        object.__setattr__(self, "rope_scaling", rs)
+
+    @property
+    def rope_scaling_dict(self) -> Optional[dict]:
+        return dict(self.rope_scaling) if self.rope_scaling else None
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_attention_heads * self.head_dim_
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_key_value_heads * self.head_dim_
+
+    @classmethod
+    def from_hf_config(cls, hf: dict[str, Any]) -> "ModelConfig":
+        """Build from a HuggingFace config.json dict (the ingest path the
+        reference drives through transformers AutoConfig, model.py:111)."""
+        model_type = hf.get("model_type", "llama")
+        known = {
+            "vocab_size", "hidden_size", "intermediate_size",
+            "num_hidden_layers", "num_attention_heads", "num_key_value_heads",
+            "head_dim", "rms_norm_eps", "rope_theta", "rope_scaling",
+            "max_position_embeddings", "tie_word_embeddings", "sliding_window",
+            "hidden_act", "attention_bias", "mlp_bias",
+        }
+        kwargs = {k: hf[k] for k in known if k in hf and hf[k] is not None}
+        kwargs["model_type"] = model_type
+        if model_type == "qwen2":
+            # qwen2 has qkv bias but no o/mlp bias; HF config lacks the flag
+            kwargs.setdefault("attention_bias", True)
+        if "num_key_value_heads" not in kwargs:
+            kwargs["num_key_value_heads"] = kwargs.get(
+                "num_attention_heads", cls.num_attention_heads
+            )
+        if model_type == "gemma2":
+            kwargs["attn_logit_softcap"] = hf.get("attn_logit_softcapping", 50.0)
+            kwargs["final_logit_softcap"] = hf.get("final_logit_softcapping", 30.0)
+            kwargs["scale_embeddings"] = True
+            kwargs["post_attn_norm"] = True
+            kwargs["rms_norm_offset"] = True
+            kwargs.setdefault("tie_word_embeddings", True)
+        return cls(**kwargs)
+
+
+# Canonical shapes for tests and benchmarks (no checkpoints needed).
+PRESETS: dict[str, ModelConfig] = {
+    "tiny-llama": ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    ),
+    "llama2-7b": ModelConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+    ),
+    "llama3-8b": ModelConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        rope_theta=500000.0, max_position_embeddings=8192,
+    ),
+    "mistral-7b": ModelConfig(
+        model_type="mistral", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_hidden_layers=32,
+        num_attention_heads=32, num_key_value_heads=8,
+        sliding_window=4096, rope_theta=1000000.0,
+    ),
+    "qwen2-7b": ModelConfig(
+        model_type="qwen2", vocab_size=152064, hidden_size=3584,
+        intermediate_size=18944, num_hidden_layers=28,
+        num_attention_heads=28, num_key_value_heads=4,
+        attention_bias=True, rope_theta=1000000.0,
+    ),
+}

@@ -1,0 +1,240 @@
+"""LLaMA-family decoder (covers llama/llama2/llama3, mistral, qwen2, ...).
+
+TPU-native re-design of the reference's patched forwards
+(`models/llama.py:56-200`, `models/mistral.py`, `models/qwen2.py` in
+/root/reference): instead of monkey-patching HF modules, the model is a
+pure function over a parameter pytree whose linear-layer leaves may be
+`QTensor` (packed low-bit). Layers are **stacked along a leading axis and
+iterated with `lax.scan`**, which keeps compile time O(1) in depth and
+gives the pipeline axis a natural sharding target.
+
+With a cache, attention always runs over the full cache [0, max_len)
+under a validity mask derived from (start, pos) — so multi-chunk prefill
+and decode share one code path and chunked prefill sees earlier chunks.
+The `mode` argument only labels the jit specialization (prefill T>1 vs
+decode T=1), mirroring the reference's prefill/decode kernel split
+(low_bit_linear.py:606-716); a Pallas flash-attention prefill fast path
+will key off it.
+
+Batch rows are left-padded (see bigdl_tpu/kvcache.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.kvcache import KVCache
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import apply_rotary_emb, attention, linear, rms_norm, rope_cos_sin
+from bigdl_tpu.ops.rope import make_inv_freq
+from bigdl_tpu.quant import QTensor, quantize
+from bigdl_tpu.quant.qtypes import resolve_qtype
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# init / quantize
+# ---------------------------------------------------------------------------
+
+def init_params(
+    config: ModelConfig,
+    key: jax.Array,
+    dtype=jnp.bfloat16,
+    scale: float = 0.02,
+) -> Params:
+    """Random dense init (tests/benchmarks run without checkpoints)."""
+    L, H, I = config.num_hidden_layers, config.hidden_size, config.intermediate_size
+    V, QD, KD = config.vocab_size, config.q_dim, config.kv_dim
+    keys = iter(jax.random.split(key, 16))
+
+    def w(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((L, H), dtype),
+        "mlp_norm": jnp.ones((L, H), dtype),
+        "wq": w(next(keys), (L, QD, H)),
+        "wk": w(next(keys), (L, KD, H)),
+        "wv": w(next(keys), (L, KD, H)),
+        "wo": w(next(keys), (L, H, QD)),
+        "w_gate": w(next(keys), (L, I, H)),
+        "w_up": w(next(keys), (L, I, H)),
+        "w_down": w(next(keys), (L, H, I)),
+    }
+    if config.attention_bias:
+        layers["bq"] = jnp.zeros((L, QD), dtype)
+        layers["bk"] = jnp.zeros((L, KD), dtype)
+        layers["bv"] = jnp.zeros((L, KD), dtype)
+    params: Params = {
+        "embed": w(next(keys), (V, H)),
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (V, H))
+    return params
+
+
+_QUANT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = None) -> Params:
+    """Quantize the linear weights of a dense param tree.
+
+    Equivalent of `ggml_convert_low_bit` walking modules (convert.py:1077):
+    norms/biases stay dense; the lm head may use a different (higher) qtype,
+    mirroring the reference's mixed-precision lm-head handling
+    (convert.py:469-750, IPEX_LLM_LAST_LM_HEAD).
+    """
+    spec = resolve_qtype(qtype)
+    if spec.is_dense:
+        return params
+    out = dict(params)
+    out["layers"] = dict(params["layers"])
+    for name in _QUANT_TARGETS:
+        out["layers"][name] = quantize(params["layers"][name], spec.name)
+    if "lm_head" in params:
+        lm_spec = resolve_qtype(lm_head_qtype) if lm_head_qtype else spec
+        if not lm_spec.is_dense:
+            out["lm_head"] = quantize(params["lm_head"], lm_spec.name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _act(name: str, x: jax.Array) -> jax.Array:
+    if name == "silu":
+        return jax.nn.silu(x)
+    if name in ("gelu", "gelu_pytorch_tanh"):
+        return jax.nn.gelu(x, approximate=True)
+    raise NotImplementedError(f"hidden_act {name}")
+
+
+def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
+    if cap is None:
+        return x
+    return jnp.tanh(x / cap) * cap
+
+
+def _lora_delta(x, pair, scale, compute_dtype):
+    """x [.., in] through a LoRA pair {'a': [r, in], 'b': [out, r]}."""
+    a, b = pair["a"], pair["b"]
+    xa = jnp.einsum("...k,rk->...r", x.astype(compute_dtype), a.astype(compute_dtype))
+    return jnp.einsum("...r,or->...o", xa, b.astype(compute_dtype)) * scale
+
+
+def forward(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32
+    cache: Optional[KVCache],
+    mode: str = "prefill",  # static: "prefill" | "decode"
+    compute_dtype=jnp.bfloat16,
+    lora: Optional[Params] = None,  # LoRA adapter tree (see bigdl_tpu.train)
+    start: Optional[jax.Array] = None,  # [B] pad offsets when cache is None
+) -> tuple[jax.Array, Optional[KVCache]]:
+    """Returns (logits [B, T, V] float32, updated cache with pos advanced).
+
+    cache=None runs the cache-free training/scoring path (full block-causal
+    attention, no KV writes) — the path QLoRA finetuning differentiates
+    through.
+    """
+    assert mode in ("prefill", "decode")
+    B, T = tokens.shape
+    Hq, Hkv, D = config.num_attention_heads, config.num_key_value_heads, config.head_dim_
+
+    if cache is None:
+        pos0 = jnp.zeros((), jnp.int32)
+        row_start = jnp.zeros((B,), jnp.int32) if start is None else start
+    else:
+        pos0 = cache.pos
+        row_start = cache.start
+
+    h = params["embed"].astype(compute_dtype)[tokens]
+    if config.scale_embeddings:
+        h = h * jnp.asarray(config.hidden_size**0.5, compute_dtype)
+
+    # Rotary tables: positions are relative to each row's start (left pad).
+    slots = pos0 + jnp.arange(T)[None, :]  # [1, T] global cache slots
+    positions = jnp.maximum(slots - row_start[:, None], 0)  # [B, T]
+    inv_freq = make_inv_freq(D, config.rope_theta, config.rope_scaling_dict)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    # Attention masks (shared by all layers, computed once outside the scan).
+    if cache is None:
+        # cache-free training path: block-local causal
+        tj = jnp.arange(T)
+        mask = (tj[None, :] <= tj[:, None])[None] & (
+            tj[None, None, :] >= row_start[:, None, None]
+        )  # [B, T, T]
+        if config.sliding_window:
+            mask = mask & (tj[None, None, :] > tj[None, :, None] - config.sliding_window)
+    else:
+        # Both prefill and decode attend over the full cache with a validity
+        # mask — chunked prefill (pos > 0) therefore sees earlier chunks.
+        S = cache.max_len
+        sj = jnp.arange(S)
+        q_slot = slots  # [B (broadcast), T]
+        mask = (sj[None, None, :] <= q_slot[..., None]) & (
+            sj[None, None, :] >= row_start[:, None, None]
+        )  # [B, T, S]
+        if config.sliding_window:
+            mask = mask & (sj[None, None, :] > q_slot[..., None] - config.sliding_window)
+    mask = mask[:, None, None]  # [B, 1, 1, T, S'] broadcasts over (Hkv, G)
+
+    lora_scale = lora["scale"] if lora is not None else None
+
+    def proj(x, p, lp, wname, bname=None):
+        y = linear(x, p[wname], p.get(bname) if bname else None, compute_dtype)
+        if lp is not None and wname in lp:
+            y = y + _lora_delta(x, lp[wname], lora_scale, compute_dtype)
+        return y
+
+    def body(carry, xs):
+        hidden, c, idx = carry
+        p, lp = xs if lora is not None else (xs, None)
+
+        x = rms_norm(hidden, p["attn_norm"], config.rms_norm_eps)
+        q = proj(x, p, lp, "wq", "bq").reshape(B, T, Hq, D)
+        k = proj(x, p, lp, "wk", "bk").reshape(B, T, Hkv, D)
+        v = proj(x, p, lp, "wv", "bv").reshape(B, T, Hkv, D)
+        q, k = apply_rotary_emb(q, k, cos, sin)
+
+        if c is not None:
+            c = kvcache.update_layer(c, idx, k, v)
+            k_att, v_att = kvcache.read_layer(c, idx, compute_dtype)
+        else:
+            k_att = k.astype(compute_dtype)
+            v_att = v.astype(compute_dtype)
+
+        attn = attention(q, k_att, v_att, mask)
+        out = proj(attn.reshape(B, T, Hq * D), p, lp, "wo")
+        hidden = hidden + out
+
+        x = rms_norm(hidden, p["mlp_norm"], config.rms_norm_eps)
+        gate = proj(x, p, lp, "w_gate")
+        up = proj(x, p, lp, "w_up")
+        down = proj(_act(config.hidden_act, gate) * up, p, lp, "w_down")
+        hidden = hidden + down
+
+        return (hidden, c, idx + 1), None
+
+    xs = (params["layers"], lora["layers"]) if lora is not None else params["layers"]
+    (h, cache, _), _ = jax.lax.scan(
+        body, (h, cache, jnp.zeros((), jnp.int32)), xs
+    )
+
+    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    lm_head = params.get("lm_head", params["embed"])
+    logits = linear(h, lm_head, None, compute_dtype).astype(jnp.float32)
+    logits = _softcap(logits, config.final_logit_softcap)
+    if cache is not None:
+        cache = kvcache.advance(cache, T)
+    return logits, cache

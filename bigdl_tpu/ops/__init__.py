@@ -1,0 +1,23 @@
+"""Core compute ops.
+
+TPU-native equivalents of the reference's fused native ops surface
+(`xe_linear.forward_new` / `xe_batch.batch_forward` / `xe_addons.{sdp*,
+rms_norm, rotary_*}`, see SURVEY.md §2.1): each op is a jnp function that
+XLA fuses into the surrounding jit graph. Pallas kernel fast paths for
+the hot ops (quantized matmul, flash attention) are planned under
+bigdl_tpu/ops/ and will dispatch by backend once present.
+"""
+
+from bigdl_tpu.ops.linear import linear
+from bigdl_tpu.ops.norms import rms_norm, layer_norm
+from bigdl_tpu.ops.rope import apply_rotary_emb, rope_cos_sin
+from bigdl_tpu.ops.attention import attention
+
+__all__ = [
+    "linear",
+    "rms_norm",
+    "layer_norm",
+    "apply_rotary_emb",
+    "rope_cos_sin",
+    "attention",
+]

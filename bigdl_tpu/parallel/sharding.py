@@ -1,0 +1,98 @@
+"""Sharding rules for model parameter trees.
+
+Megatron-style tensor parallelism, expressed as PartitionSpecs instead of
+the reference's sharded-module detection + explicit all-reduce
+(convert.py:152-234, low_bit_linear.py:675-682):
+
+- q/k/v/gate/up projections: column-parallel (output features on `tp`)
+- o/down projections: row-parallel (input features on `tp`; XLA inserts
+  the psum the reference calls `mp_group.all_reduce`)
+- embedding + lm head: vocab on `tp` (logit psum likewise automatic)
+- norms, biases of row-parallel layers: replicated
+
+A QTensor shards with the SAME spec for codes/scales/mins because all
+three carry the block structure along the same axes; quantization blocks
+(32/64 elems) always divide per-shard contraction dims for real model
+sizes, so no cross-shard block ever straddles a boundary.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.quant import QTensor
+
+# Layer weights have a leading stacked-layer axis (dim 0).
+_COL = P(None, "tp", None)  # [L, out/tp, in]
+_ROW = P(None, None, "tp")  # [L, out, in/tp]
+_REP = P()
+
+
+def layer_specs(config: ModelConfig) -> dict:
+    specs = {
+        "attn_norm": _REP,
+        "mlp_norm": _REP,
+        "wq": _COL,
+        "wk": _COL,
+        "wv": _COL,
+        "wo": _ROW,
+        "w_gate": _COL,
+        "w_up": _COL,
+        "w_down": _ROW,
+    }
+    if config.attention_bias:
+        specs.update({"bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp")})
+    return specs
+
+
+def param_specs(config: ModelConfig, tie_word_embeddings: bool | None = None) -> dict:
+    tie = config.tie_word_embeddings if tie_word_embeddings is None else tie_word_embeddings
+    specs = {
+        "embed": P("tp", None),
+        "layers": layer_specs(config),
+        "final_norm": _REP,
+    }
+    if not tie:
+        specs["lm_head"] = P("tp", None)
+    return specs
+
+
+def lora_specs(config: ModelConfig, targets: tuple[str, ...]) -> dict:
+    """LoRA A is row-sharded like the base weight's contraction axis only
+    when the base is row-parallel; keep both factors replicated except the
+    dimension that matches the base weight's tp axis."""
+    col_targets = {"wq", "wk", "wv", "w_gate", "w_up"}
+    layers = {}
+    for t in targets:
+        if t in col_targets:
+            layers[t] = {"a": _REP, "b": P(None, "tp", None)}  # b: [L, out/tp, r]
+        else:  # row-parallel base: shard A's input dim
+            layers[t] = {"a": P(None, None, "tp"), "b": _REP}
+    return {"layers": layers, "scale": _REP}
+
+
+def sharding_tree(specs: dict, mesh: Mesh, params) -> dict:
+    """Expand a PartitionSpec tree into a NamedSharding tree exactly
+    matching `params` structure (QTensor nodes expand field-wise)."""
+
+    def expand(spec, param):
+        if isinstance(param, QTensor):
+            ns = NamedSharding(mesh, spec)
+            return QTensor(
+                data=ns,
+                scales=ns,
+                mins=None if param.mins is None else ns,
+                qtype=param.qtype,
+            )
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(
+        expand, specs, params, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def shard_params(params, specs: dict, mesh: Mesh):
+    """Place a param tree onto the mesh (host → sharded device buffers)."""
+    return jax.device_put(params, sharding_tree(specs, mesh, params))

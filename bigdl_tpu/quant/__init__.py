@@ -1,0 +1,28 @@
+"""Low-bit quantization core (TPU-native equivalent of the reference's
+ggml/ + low_bit_linear.py layer, see SURVEY.md §2.1)."""
+
+from bigdl_tpu.quant.qtypes import (
+    QTypeSpec,
+    qtype_registry,
+    resolve_qtype,
+)
+from bigdl_tpu.quant.numerics import (
+    dequantize_blockwise,
+    pack_nibbles,
+    quantize_blockwise,
+    unpack_nibbles,
+)
+from bigdl_tpu.quant.qtensor import QTensor, dequantize, quantize
+
+__all__ = [
+    "QTensor",
+    "QTypeSpec",
+    "quantize",
+    "dequantize",
+    "quantize_blockwise",
+    "dequantize_blockwise",
+    "pack_nibbles",
+    "unpack_nibbles",
+    "qtype_registry",
+    "resolve_qtype",
+]

@@ -1,0 +1,183 @@
+"""Blockwise quantize/dequantize numerics, in pure jnp.
+
+These are the TPU-native equivalents of the reference's native entry points
+`ggml_quantize_tensor` / `ggml_dequantize_*` (ctypes surface enumerated in
+/root/reference python/llm/src/ipex_llm/ggml/model/llama/llama_cpp.py:955-1065,
+used from transformers/low_bit_linear.py:104-258). Numerics follow the ggml
+block formats (Q4_0/Q4_1/Q5_0/Q5_1/Q8_0) and the bitsandbytes NF4/FP4
+codebook scheme so that quantized-model quality lands in the same perplexity
+band as the reference's README table.
+
+Everything here is shape-polymorphic jnp and jit-safe: it runs on host CPU
+during checkpoint conversion and on TPU when re-quantizing (e.g. FP8 KV
+cache). Packing layout: 4-bit codes are packed two-per-uint8 along the last
+(contraction) axis — element 2i in the low nibble, 2i+1 in the high nibble.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.quant.qtypes import QTypeSpec, resolve_qtype
+
+_FP8_MAX = {"fp8_e4m3": 448.0, "fp8_e5m2": 57344.0}
+_FP8_DTYPE = {"fp8_e4m3": jnp.float8_e4m3fn, "fp8_e5m2": jnp.float8_e5m2}
+
+
+def _blocked(x: jax.Array, block_size: int) -> jax.Array:
+    k = x.shape[-1]
+    if k % block_size != 0:
+        raise ValueError(
+            f"last dim {k} not divisible by block_size {block_size}; "
+            "pad the weight before quantizing"
+        )
+    return x.reshape(*x.shape[:-1], k // block_size, block_size)
+
+
+def pack_nibbles(codes: jax.Array) -> jax.Array:
+    """[..., K] uint8 codes in [0,16) -> [..., K//2] packed uint8."""
+    lo = codes[..., 0::2]
+    hi = codes[..., 1::2]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_nibbles(packed: jax.Array) -> jax.Array:
+    """[..., K//2] packed uint8 -> [..., K] uint8 codes."""
+    lo = packed & 0xF
+    hi = packed >> 4
+    return jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], -1)
+
+
+def _signed_absmax(xb: jax.Array) -> jax.Array:
+    """Per-block value with the largest magnitude, keeping its sign (ggml Q4_0)."""
+    idx = jnp.argmax(jnp.abs(xb), axis=-1, keepdims=True)
+    return jnp.take_along_axis(xb, idx, axis=-1)[..., 0]
+
+
+def _safe_inv(d: jax.Array) -> jax.Array:
+    return jnp.where(d == 0, 0.0, 1.0 / jnp.where(d == 0, 1.0, d))
+
+
+@functools.lru_cache(maxsize=None)
+def _codebook_tables(qtype_name: str):
+    """(codebook, sorted-order permutation, decision boundaries) as numpy."""
+    spec = resolve_qtype(qtype_name)
+    cb = spec.codebook
+    order = np.argsort(cb)
+    sorted_cb = cb[order]
+    boundaries = (sorted_cb[1:] + sorted_cb[:-1]) / 2.0
+    return cb, order.astype(np.int32), boundaries
+
+
+def quantize_blockwise(x: jax.Array, spec: QTypeSpec):
+    """Quantize x along its last axis. Returns (data, scales, mins|None).
+
+    scales/mins are float16 with shape [..., K // block_size], matching the
+    reference's half-precision block headers.
+    """
+    x = x.astype(jnp.float32)
+    name = spec.name
+
+    if spec.storage.startswith("fp8"):
+        xb = _blocked(x, spec.block_size)
+        absmax = jnp.max(jnp.abs(xb), axis=-1)
+        scale = absmax / _FP8_MAX[name]
+        q = (xb * _safe_inv(scale)[..., None]).astype(_FP8_DTYPE[name])
+        return q.reshape(x.shape), scale.astype(jnp.float16), None
+
+    xb = _blocked(x, spec.block_size)
+
+    if spec.codebook is not None:
+        cb, order, boundaries = _codebook_tables(name)
+        cb_max = float(np.max(np.abs(cb)))
+        absmax = jnp.max(jnp.abs(xb), axis=-1)
+        scale = absmax / cb_max
+        xn = xb * _safe_inv(scale)[..., None]
+        idx_sorted = jnp.searchsorted(jnp.asarray(boundaries), xn)
+        codes = jnp.asarray(order)[idx_sorted]
+        codes = codes.reshape(x.shape)
+        if spec.storage == "packed_u8":
+            data = pack_nibbles(codes.astype(jnp.uint8))
+        else:
+            data = codes.astype(jnp.int8)
+        return data, scale.astype(jnp.float16), None
+
+    if name == "sym_int4":
+        smax = _signed_absmax(xb)
+        d = smax / -8.0
+        q = jnp.clip(jnp.round(xb * _safe_inv(d)[..., None]) + 8.0, 0, 15)
+        data = pack_nibbles(q.reshape(x.shape).astype(jnp.uint8))
+        return data, d.astype(jnp.float16), None
+
+    if name == "asym_int4":
+        mins = jnp.min(xb, axis=-1)
+        d = (jnp.max(xb, axis=-1) - mins) / 15.0
+        q = jnp.clip(jnp.round((xb - mins[..., None]) * _safe_inv(d)[..., None]), 0, 15)
+        data = pack_nibbles(q.reshape(x.shape).astype(jnp.uint8))
+        return data, d.astype(jnp.float16), mins.astype(jnp.float16)
+
+    if name == "sym_int5":
+        smax = _signed_absmax(xb)
+        d = smax / -16.0
+        q = jnp.clip(jnp.round(xb * _safe_inv(d)[..., None]) + 16.0, 0, 31)
+        return q.reshape(x.shape).astype(jnp.int8), d.astype(jnp.float16), None
+
+    if name == "asym_int5":
+        mins = jnp.min(xb, axis=-1)
+        d = (jnp.max(xb, axis=-1) - mins) / 31.0
+        q = jnp.clip(jnp.round((xb - mins[..., None]) * _safe_inv(d)[..., None]), 0, 31)
+        return q.reshape(x.shape).astype(jnp.int8), d.astype(jnp.float16), mins.astype(jnp.float16)
+
+    if name == "sym_int8":
+        d = jnp.max(jnp.abs(xb), axis=-1) / 127.0
+        q = jnp.clip(jnp.round(xb * _safe_inv(d)[..., None]), -127, 127)
+        return q.reshape(x.shape).astype(jnp.int8), d.astype(jnp.float16), None
+
+    raise NotImplementedError(f"quantize: qtype {name}")
+
+
+def dequantize_blockwise(
+    data: jax.Array,
+    scales: jax.Array,
+    mins: jax.Array | None,
+    spec: QTypeSpec,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Inverse of quantize_blockwise; returns [..., K] in `dtype`."""
+    name = spec.name
+
+    if spec.storage.startswith("fp8"):
+        xb = _blocked(data.astype(jnp.float32), spec.block_size)
+        y = xb * scales.astype(jnp.float32)[..., None]
+        return y.reshape(data.shape).astype(dtype)
+
+    if spec.storage == "packed_u8":
+        codes = unpack_nibbles(data)
+    else:
+        codes = data
+
+    if spec.codebook is not None:
+        cb = jnp.asarray(spec.codebook)
+        vals = cb[codes.astype(jnp.int32) & ((1 << max(spec.bits, 4)) - 1)]
+    elif name == "sym_int4":
+        vals = codes.astype(jnp.float32) - 8.0
+    elif name == "asym_int4":
+        vals = codes.astype(jnp.float32)
+    elif name == "sym_int5":
+        vals = codes.astype(jnp.float32) - 16.0
+    elif name == "asym_int5":
+        vals = codes.astype(jnp.float32)
+    elif name == "sym_int8":
+        vals = codes.astype(jnp.float32)
+    else:
+        raise NotImplementedError(f"dequantize: qtype {name}")
+
+    vb = _blocked(vals, spec.block_size)
+    y = vb * scales.astype(jnp.float32)[..., None]
+    if mins is not None:
+        y = y + mins.astype(jnp.float32)[..., None]
+    return y.reshape(vals.shape).astype(dtype)

@@ -1,0 +1,77 @@
+"""QTensor — a quantized tensor as a JAX pytree node.
+
+The TPU-native counterpart of the reference's `FP4Params`
+(/root/reference python/llm/src/ipex_llm/transformers/low_bit_linear.py:312):
+instead of a torch.nn.Parameter subclass holding a ggml byte blob, a QTensor
+is a registered dataclass whose array fields (packed codes, scales, mins)
+are ordinary JAX arrays. That makes quantized weights first-class citizens
+of every JAX transform: they can be donated, sharded with
+`jax.sharding.NamedSharding`, carried through `lax.scan` over stacked
+layers, and saved/restored as pytree leaves.
+
+The logical shape is derived from the storage shape, so a QTensor sliced
+along a leading (layer-stacking) axis by `lax.scan` remains self-consistent
+without any static-metadata surgery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.quant.numerics import dequantize_blockwise, quantize_blockwise
+from bigdl_tpu.quant.qtypes import QTypeSpec, resolve_qtype
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QTensor:
+    data: jax.Array
+    scales: jax.Array
+    mins: Optional[jax.Array]
+    qtype: str = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def spec(self) -> QTypeSpec:
+        return resolve_qtype(self.qtype)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        spec = self.spec
+        if spec.storage == "packed_u8":
+            return (*self.data.shape[:-1], self.data.shape[-1] * 2)
+        return tuple(self.data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self.data.ndim
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        return dequantize_blockwise(self.data, self.scales, self.mins, self.spec, dtype)
+
+    def nbytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize
+        n += self.scales.size * self.scales.dtype.itemsize
+        if self.mins is not None:
+            n += self.mins.size * self.mins.dtype.itemsize
+        return n
+
+
+def quantize(x: jax.Array, qtype: str) -> QTensor:
+    """Quantize `x` along its last axis into a QTensor.
+
+    Equivalent of the reference's `FP4Params.quantize`
+    (low_bit_linear.py:348): blockwise along the contraction axis.
+    """
+    spec = resolve_qtype(qtype)
+    if spec.is_dense:
+        raise ValueError(f"qtype {qtype} is dense; keep the array as-is")
+    data, scales, mins = quantize_blockwise(x, spec)
+    return QTensor(data=data, scales=scales, mins=mins, qtype=spec.name)
+
+
+def dequantize(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return qt.dequantize(dtype)

@@ -1,0 +1,149 @@
+"""Quantization type registry.
+
+Mirrors the reference's qtype enumeration (`ggml/quantize.py:28-57` in
+/root/reference: sym_int4, asym_int4, sym_int8, nf4, fp4, fp8_e4m3,
+fp8_e5m2, fp16, bf16, k-quants, ...), re-designed for TPU storage:
+
+- 4-bit codes are nibble-packed two-per-uint8 along the contraction axis
+  (XLA/Pallas unpack with shifts; HBM footprint = 0.5 byte/weight + scales).
+- int8 codes are stored as int8.
+- fp8 codes are stored as native XLA float8 dtypes (TPU v5 supports them).
+- Scales (and mins for asymmetric types) are float16 per block, matching
+  the reference's ggml half-precision `d`/`m` fields.
+
+Each qtype is described by a `QTypeSpec`; numerics live in
+`bigdl_tpu.quant.numerics`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# 16-entry NormalFloat4 codebook (QLoRA paper / bitsandbytes); the reference
+# consumes the same table inside its native kernels for qtype "nf4".
+NF4_CODEBOOK = np.array(
+    [
+        -1.0,
+        -0.6961928009986877,
+        -0.5250730514526367,
+        -0.39491748809814453,
+        -0.28444138169288635,
+        -0.18477343022823334,
+        -0.09105003625154495,
+        0.0,
+        0.07958029955625534,
+        0.16093020141124725,
+        0.24611230194568634,
+        0.33791524171829224,
+        0.44070982933044434,
+        0.5626170039176941,
+        0.7229568362236023,
+        1.0,
+    ],
+    dtype=np.float32,
+)
+
+# 8-entry NormalFloat3 codebook: quantiles of N(0,1) normalized to [-1, 1],
+# with 0 included (same construction as nf4 with 3 bits).
+NF3_CODEBOOK = np.array(
+    [-1.0, -0.5350227355957031, -0.2469314038753510, 0.0,
+     0.1833375245332718, 0.3819939494132996, 0.6229856610298157, 1.0],
+    dtype=np.float32,
+)
+
+# FP4 (e2m1) magnitudes; sign bit is the top bit of the 4-bit code.
+FP4_MAGNITUDES = np.array([0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0], dtype=np.float32)
+
+# Signed 16-entry fp4 codebook indexed by the raw 4-bit code.
+FP4_CODEBOOK = np.concatenate([FP4_MAGNITUDES, -FP4_MAGNITUDES]).astype(np.float32)
+
+# FP6 (e2m3) magnitudes: 1 sign bit, 2 exponent bits, 3 mantissa bits.
+# Values: for exp e in {0 (subnormal),1,2,3}: subnormals m/8*0.25? We use the
+# standard e2m3 value set with bias 1: subnormal = m * 2**-3 * 2**0? To keep a
+# simple monotone codebook we enumerate all 32 magnitudes below.
+def _fp6_e2m3_magnitudes() -> np.ndarray:
+    vals = []
+    for e in range(4):
+        for m in range(8):
+            if e == 0:
+                vals.append(m / 8.0 * 0.5)  # subnormals, scale 2**(1-bias)=0.5
+            else:
+                vals.append((1.0 + m / 8.0) * (2.0 ** (e - 1)) * 0.5)
+    return np.array(vals, dtype=np.float32)
+
+
+FP6_MAGNITUDES = _fp6_e2m3_magnitudes()
+FP6_CODEBOOK = np.concatenate([FP6_MAGNITUDES, -FP6_MAGNITUDES]).astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class QTypeSpec:
+    name: str
+    bits: int
+    block_size: int  # elements sharing one scale along the contraction axis
+    asymmetric: bool = False  # stores per-block mins in addition to scales
+    codebook: np.ndarray | None = None  # LUT types (nf4/nf3/fp4/fp6)
+    storage: str = "packed_u8"  # packed_u8 | int8 | fp8_e4m3 | fp8_e5m2 | dense
+    # dense == not quantized (fp16/bf16 passthrough kept as plain arrays)
+
+    @property
+    def is_dense(self) -> bool:
+        return self.storage == "dense"
+
+
+_REGISTRY: dict[str, QTypeSpec] = {}
+
+
+def _register(spec: QTypeSpec) -> QTypeSpec:
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+# ggml Q4_0-compatible: block 32, signed scale from the max-|x| element.
+SYM_INT4 = _register(QTypeSpec("sym_int4", bits=4, block_size=32))
+# ggml Q4_1-compatible: block 32, scale + min.
+ASYM_INT4 = _register(QTypeSpec("asym_int4", bits=4, block_size=32, asymmetric=True))
+# ggml Q5_0-compatible numerics, stored as int8 codes for simplicity (round 1).
+SYM_INT5 = _register(QTypeSpec("sym_int5", bits=5, block_size=32, storage="int8"))
+ASYM_INT5 = _register(
+    QTypeSpec("asym_int5", bits=5, block_size=32, asymmetric=True, storage="int8")
+)
+# ggml Q8_0-compatible: block 32, absmax/127.
+SYM_INT8 = _register(QTypeSpec("sym_int8", bits=8, block_size=32, storage="int8"))
+NF4 = _register(QTypeSpec("nf4", bits=4, block_size=64, codebook=NF4_CODEBOOK))
+NF3 = _register(QTypeSpec("nf3", bits=3, block_size=64, codebook=NF3_CODEBOOK, storage="int8"))
+FP4 = _register(QTypeSpec("fp4", bits=4, block_size=64, codebook=FP4_CODEBOOK))
+FP6 = _register(QTypeSpec("fp6", bits=6, block_size=64, codebook=FP6_CODEBOOK, storage="int8"))
+FP8_E4M3 = _register(QTypeSpec("fp8_e4m3", bits=8, block_size=128, storage="fp8_e4m3"))
+FP8_E5M2 = _register(QTypeSpec("fp8_e5m2", bits=8, block_size=128, storage="fp8_e5m2"))
+FP16 = _register(QTypeSpec("fp16", bits=16, block_size=1, storage="dense"))
+BF16 = _register(QTypeSpec("bf16", bits=16, block_size=1, storage="dense"))
+
+# Aliases matching the reference's user-facing spellings
+# (transformers/model.py: load_in_low_bit values).
+_ALIASES = {
+    "int4": "sym_int4",
+    "q4_0": "sym_int4",
+    "q4_1": "asym_int4",
+    "q5_0": "sym_int5",
+    "q5_1": "asym_int5",
+    "int8": "sym_int8",
+    "q8_0": "sym_int8",
+    "fp8": "fp8_e5m2",  # reference maps plain "fp8" to e5m2 on most devices
+}
+
+
+def qtype_registry() -> dict[str, QTypeSpec]:
+    return dict(_REGISTRY)
+
+
+def resolve_qtype(name: str) -> QTypeSpec:
+    key = name.lower()
+    key = _ALIASES.get(key, key)
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"unknown qtype {name!r}; known: {sorted(_REGISTRY) + sorted(_ALIASES)}"
+        )
+    return _REGISTRY[key]

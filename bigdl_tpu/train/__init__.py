@@ -1,0 +1,11 @@
+"""Finetuning on quantized bases (reference L6: qlora.py, relora.py,
+lisa.py — SURVEY.md §2.2)."""
+
+from bigdl_tpu.train.qlora import (
+    init_lora,
+    make_train_step,
+    merge_lora,
+    next_token_loss,
+)
+
+__all__ = ["init_lora", "make_train_step", "merge_lora", "next_token_loss"]

@@ -1,0 +1,142 @@
+"""QLoRA: LoRA adapters over a frozen low-bit base.
+
+Reference: `transformers/qlora.py` (`LoraLowBitLinear`:66-144 — frozen
+LowBitLinear base + bf16 LoRA branch; autograd through the quantized
+matmul via `MatMulLowBit.backward`, low_bit_linear.py:500-541).
+
+TPU design: the base weights are QTensor leaves that are simply not
+differentiated — `jax.grad` w.r.t. the LoRA tree alone gives exactly the
+reference's backward (dequantized W^T participates in the VJP as a
+constant; XLA rematerializes the dequant, no custom autograd class
+needed). One jitted train step covers forward, backward, and the optax
+update, sharded over the same (dp, sp, tp) mesh as inference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bigdl_tpu.models.config import ModelConfig
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _target_dims(config: ModelConfig, name: str) -> tuple[int, int]:
+    H, I = config.hidden_size, config.intermediate_size
+    return {
+        "wq": (config.q_dim, H),
+        "wk": (config.kv_dim, H),
+        "wv": (config.kv_dim, H),
+        "wo": (H, config.q_dim),
+        "w_gate": (I, H),
+        "w_up": (I, H),
+        "w_down": (H, I),
+    }[name]
+
+
+def init_lora(
+    config: ModelConfig,
+    key: jax.Array,
+    rank: int = 8,
+    alpha: float = 16.0,
+    targets: tuple[str, ...] = DEFAULT_TARGETS,
+    dtype=jnp.bfloat16,
+) -> dict:
+    """LoRA tree: {'layers': {target: {'a': [L,r,in], 'b': [L,out,r]}},
+    'scale': alpha/rank}. A ~ N(0, 1/r), B = 0 (standard init: adapter
+    starts as identity)."""
+    L = config.num_hidden_layers
+    layers = {}
+    for t in targets:
+        out_dim, in_dim = _target_dims(config, t)
+        key, k = jax.random.split(key)
+        layers[t] = {
+            "a": (jax.random.normal(k, (L, rank, in_dim), jnp.float32) / rank).astype(dtype),
+            "b": jnp.zeros((L, out_dim, rank), dtype),
+        }
+    return {"layers": layers, "scale": jnp.asarray(alpha / rank, dtype)}
+
+
+def merge_lora(params: dict, lora: dict, requantize: Optional[str] = None) -> dict:
+    """Fold adapters into the base (ReLoRA's merge step, relora.py:64-150).
+
+    Dense bases merge exactly; quantized bases are dequantized, merged,
+    and re-quantized to `requantize` (defaults to their own qtype).
+    """
+    from bigdl_tpu.quant import QTensor, quantize
+
+    out_layers = dict(params["layers"])
+    scale = jnp.asarray(lora["scale"], jnp.float32)
+    for t, pair in lora["layers"].items():
+        base = params["layers"][t]
+        delta = (
+            jnp.einsum("lor,lri->loi", pair["b"].astype(jnp.float32),
+                       pair["a"].astype(jnp.float32)) * scale
+        )
+        if isinstance(base, QTensor):
+            dense = base.dequantize(jnp.float32) + delta
+            out_layers[t] = quantize(dense, requantize or base.qtype)
+        else:
+            out_layers[t] = (base.astype(jnp.float32) + delta).astype(base.dtype)
+    out = dict(params)
+    out["layers"] = out_layers
+    return out
+
+
+def next_token_loss(
+    config: ModelConfig,
+    forward_fn: Callable,
+    params: dict,
+    lora: Optional[dict],
+    tokens: jax.Array,  # [B, T]
+    loss_mask: jax.Array,  # [B, T] 1.0 where the *target* token counts
+) -> jax.Array:
+    """Causal LM cross-entropy: predict tokens[:, 1:] from tokens[:, :-1]."""
+    logits, _ = forward_fn(config, params, tokens[:, :-1], None, lora=lora)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_train_step(
+    config: ModelConfig,
+    forward_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    seq_spec=None,
+):
+    """Returns jittable step(params, lora, opt_state, tokens, loss_mask) ->
+    (lora, opt_state, loss). Only lora['layers'] is trained (the alpha/rank
+    scale stays fixed); init opt_state with optimizer.init(lora['layers']).
+    Donate lora/opt_state at the jit call site.
+
+    seq_spec: optional PartitionSpec (e.g. P('dp', 'sp')) constraining the
+    input token grid — sequence-parallel training: embedding/norm/MLP run
+    on sequence shards, XLA all-gathers around attention. Requires an
+    enclosing `jax.set_mesh`.
+    """
+    inner_forward = forward_fn
+    if seq_spec is not None:
+        def inner_forward(cfg, params, toks, cache, lora=None):
+            toks = jax.lax.with_sharding_constraint(toks, seq_spec)
+            return forward_fn(cfg, params, toks, cache, lora=lora)
+
+    def step(params, lora, opt_state, tokens, loss_mask):
+        scale = lora["scale"]
+        loss, grads = jax.value_and_grad(
+            lambda layers: next_token_loss(
+                config, inner_forward, params,
+                {"layers": layers, "scale": scale}, tokens, loss_mask,
+            )
+        )(lora["layers"])
+        updates, opt_state = optimizer.update(grads, opt_state, lora["layers"])
+        layers = optax.apply_updates(lora["layers"], updates)
+        return {"layers": layers, "scale": scale}, opt_state, loss
+
+    return step

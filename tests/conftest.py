@@ -1,0 +1,39 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference tests only on real self-hosted accelerators (SURVEY.md §4);
+XLA lets us do better — distributed paths compile and execute against
+`--xla_force_host_platform_device_count=8` fake CPU devices, so TP/PP/DP
+shardings are exercised in CI without hardware.
+
+Must set the flags before jax initializes a backend, hence module-level.
+"""
+
+import os
+
+# Force CPU: the session environment pins JAX_PLATFORMS=axon (the real TPU
+# tunnel); tests must NOT claim the chip — they run on fake CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The session sitecustomize force-registers the 'axon' TPU-tunnel plugin and
+# overrides jax_platforms to "axon,cpu" via jax.config — env vars alone do
+# not win. Tests must never claim the (single, serialized) tunnel chip:
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+# This host compiles XLA on one core; cache compiled programs across runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

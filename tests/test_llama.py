@@ -1,0 +1,285 @@
+"""LLaMA-family model tests.
+
+The core pattern mirrors the reference's GPU layer-equivalence tests
+(test_transformers_api_attention.py:44-110 in /root/reference): run the
+same checkpoint through HF transformers (torch CPU) and through our JAX
+implementation, and require logits to agree within tolerance — dense
+first (exact-ish), then quantized (looser).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.generate import (
+    GenerationConfig,
+    generate_tokens,
+    pad_prompts,
+    sample_token,
+)
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS, ModelConfig
+
+CFG = PRESETS["tiny-llama"]
+
+
+def make_params(qtype="bf16"):
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    if qtype != "bf16":
+        params = llama.quantize_params(params, qtype)
+    return params
+
+
+def run_full(params, tokens, start=None):
+    B, T = tokens.shape
+    cache = kvcache.init_cache(
+        CFG.num_hidden_layers, B, T + 8, CFG.num_key_value_heads, CFG.head_dim_
+    )
+    if start is not None:
+        cache = dataclasses.replace(cache, start=jnp.asarray(start, jnp.int32))
+    return llama.forward(CFG, params, tokens, cache, mode="prefill")
+
+
+def test_forward_shapes():
+    params = make_params()
+    tokens = jnp.arange(12, dtype=jnp.int32).reshape(2, 6) % CFG.vocab_size
+    logits, cache = run_full(params, tokens)
+    assert logits.shape == (2, 6, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert int(cache.pos) == 6
+
+
+def test_prefill_then_decode_matches_full_prefill():
+    """Decoding token-by-token must reproduce full-sequence prefill logits."""
+    params = make_params()
+    full = jnp.asarray([[5, 9, 2, 7, 3, 11]], jnp.int32)
+    logits_full, _ = run_full(params, full)
+
+    B, T = 1, 4
+    cache = kvcache.init_cache(
+        CFG.num_hidden_layers, B, 16, CFG.num_key_value_heads, CFG.head_dim_
+    )
+    logits_p, cache = llama.forward(CFG, params, full[:, :T], cache, mode="prefill")
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_full[:, :T]), rtol=2e-2, atol=2e-2
+    )
+    for t in range(T, 6):
+        logits_d, cache = llama.forward(
+            CFG, params, full[:, t : t + 1], cache, mode="decode"
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_d[:, 0]),
+            np.asarray(logits_full[:, t]),
+            rtol=2e-2,
+            atol=2e-2,
+        )
+
+
+def test_left_padding_matches_unpadded():
+    """A left-padded row must produce the same last-token logits as the
+    unpadded prompt (padding masked out of attention and rope)."""
+    params = make_params()
+    prompt = [5, 9, 2, 7]
+    tokens_np, start = pad_prompts([prompt], pad_id=0, bucket=8)
+    logits_pad, _ = run_full(params, jnp.asarray(tokens_np), start)
+    logits_ref, _ = run_full(params, jnp.asarray([prompt], jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(logits_pad[:, -1]),
+        np.asarray(logits_ref[:, -1]),
+        rtol=2e-2,
+        atol=2e-2,
+    )
+
+
+def test_quantized_forward_close_to_dense():
+    params = make_params()
+    qparams = llama.quantize_params(params, "sym_int8")
+    tokens = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    dense, _ = run_full(params, tokens)
+    quant, _ = run_full(qparams, tokens)
+    # int8 weight quantization: logits stay close
+    err = np.abs(np.asarray(dense) - np.asarray(quant)).mean()
+    scale = np.abs(np.asarray(dense)).mean() + 1e-6
+    assert err / scale < 0.12, err / scale
+
+
+def test_fp8_kv_cache_decode_close():
+    params = make_params()
+    full = jnp.asarray([[5, 9, 2, 7, 3, 11, 4, 8]], jnp.int32)
+    logits_ref, _ = run_full(params, full)
+    cache = kvcache.init_cache(
+        CFG.num_hidden_layers, 1, 16, CFG.num_key_value_heads, CFG.head_dim_,
+        quantize_kv=True,
+    )
+    logits_p, cache = llama.forward(CFG, params, full[:, :7], cache, mode="prefill")
+    logits_d, _ = llama.forward(CFG, params, full[:, 7:8], cache, mode="decode")
+    np.testing.assert_allclose(
+        np.asarray(logits_d[:, 0]), np.asarray(logits_ref[:, 7]), rtol=0.15, atol=0.15
+    )
+
+
+def test_generate_greedy_deterministic():
+    params = make_params()
+    tokens_np, start = pad_prompts([[3, 1, 4, 1, 5], [9, 2, 6]], pad_id=0)
+    gen = GenerationConfig(max_new_tokens=8)
+    out = generate_tokens(
+        CFG, params, jnp.asarray(tokens_np), jnp.asarray(start),
+        jax.random.PRNGKey(0), gen, llama.forward,
+        cache_len=tokens_np.shape[1] + 8,
+    )
+    out2 = generate_tokens(
+        CFG, params, jnp.asarray(tokens_np), jnp.asarray(start),
+        jax.random.PRNGKey(1), gen, llama.forward,
+        cache_len=tokens_np.shape[1] + 8,
+    )
+    assert out.shape == (2, 8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    assert np.all(np.asarray(out) >= 0) and np.all(np.asarray(out) < CFG.vocab_size)
+
+
+def test_generate_matches_stepwise_argmax():
+    """generate() greedy must equal manual prefill+decode argmax chain."""
+    params = make_params()
+    prompt = [3, 1, 4, 1, 5]
+    tokens_np, start = pad_prompts([prompt], pad_id=0, bucket=8)
+    gen = GenerationConfig(max_new_tokens=4)
+    out = generate_tokens(
+        CFG, params, jnp.asarray(tokens_np), jnp.asarray(start),
+        jax.random.PRNGKey(0), gen, llama.forward, cache_len=16,
+    )
+    cache = kvcache.init_cache(
+        CFG.num_hidden_layers, 1, 16, CFG.num_key_value_heads, CFG.head_dim_
+    )
+    cache = dataclasses.replace(cache, start=jnp.asarray(start, jnp.int32))
+    logits, cache = llama.forward(
+        CFG, params, jnp.asarray(tokens_np), cache, mode="prefill"
+    )
+    expected = []
+    cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    expected.append(int(cur[0]))
+    for _ in range(3):
+        logits, cache = llama.forward(CFG, params, cur[:, None], cache, mode="decode")
+        cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        expected.append(int(cur[0]))
+    np.testing.assert_array_equal(np.asarray(out)[0], expected)
+
+
+def test_chunked_prefill_matches_full():
+    """Two sequential prefill chunks must see each other through the cache."""
+    params = make_params()
+    full = jnp.asarray([[5, 9, 2, 7, 3, 11, 4, 8]], jnp.int32)
+    logits_full, _ = run_full(params, full)
+    cache = kvcache.init_cache(
+        CFG.num_hidden_layers, 1, 16, CFG.num_key_value_heads, CFG.head_dim_
+    )
+    _, cache = llama.forward(CFG, params, full[:, :5], cache, mode="prefill")
+    logits2, _ = llama.forward(CFG, params, full[:, 5:], cache, mode="prefill")
+    np.testing.assert_allclose(
+        np.asarray(logits2), np.asarray(logits_full[:, 5:]), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_rope_scaled_config_is_jittable():
+    """rope_scaling arrives as a dict from HF config.json; ModelConfig must
+    stay hashable (it is a static jit argument) and llama3 scaling must run."""
+    cfg = dataclasses.replace(
+        CFG,
+        rope_theta=500000.0,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    )
+    hash(cfg)  # static-arg requirement
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens_np, start = pad_prompts([[3, 1, 4]], pad_id=0, bucket=8)
+    out = generate_tokens(
+        cfg, params, jnp.asarray(tokens_np), jnp.asarray(start),
+        jax.random.PRNGKey(0), GenerationConfig(max_new_tokens=4),
+        llama.forward, cache_len=16,
+    )
+    assert out.shape == (1, 4)
+    # json round-trip (save_low_bit path) keeps it hashable too
+    import json as _json
+
+    rs = _json.loads(_json.dumps(dataclasses.asdict(cfg)))["rope_scaling"]
+    hash(dataclasses.replace(cfg, rope_scaling=rs))
+
+
+def test_sampling_topk_topp_valid():
+    logits = jnp.asarray(np.random.default_rng(0).standard_normal((4, 64)), jnp.float32)
+    for gen in [
+        GenerationConfig(do_sample=True, temperature=0.7),
+        GenerationConfig(do_sample=True, top_k=5),
+        GenerationConfig(do_sample=True, top_p=0.9),
+        GenerationConfig(do_sample=True, top_k=8, top_p=0.8, temperature=1.3),
+    ]:
+        tok = sample_token(logits, jax.random.PRNGKey(1), gen)
+        assert tok.shape == (4,)
+        assert np.all(np.asarray(tok) >= 0) and np.all(np.asarray(tok) < 64)
+    # top_k=1 is argmax
+    gen = GenerationConfig(do_sample=True, top_k=1)
+    tok = sample_token(logits, jax.random.PRNGKey(2), gen)
+    np.testing.assert_array_equal(np.asarray(tok), np.asarray(jnp.argmax(logits, -1)))
+
+
+@pytest.mark.parametrize("qtype", ["sym_int4", "nf4"])
+def test_hf_equivalence(qtype):
+    """Dense JAX forward vs HF torch forward on identical tiny weights;
+    quantized forward within the quantization error band."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=CFG.vocab_size,
+        hidden_size=CFG.hidden_size,
+        intermediate_size=CFG.intermediate_size,
+        num_hidden_layers=CFG.num_hidden_layers,
+        num_attention_heads=CFG.num_attention_heads,
+        num_key_value_heads=CFG.num_key_value_heads,
+        max_position_embeddings=CFG.max_position_embeddings,
+        rms_norm_eps=CFG.rms_norm_eps,
+        rope_theta=CFG.rope_theta,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf_model = LlamaForCausalLM(hf_cfg).eval().to(torch.float32)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+
+    from bigdl_tpu.convert import params_from_state_dict
+
+    tokens = np.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens).long()).logits.numpy()
+
+    # dense equivalence (fp32 compute)
+    params = params_from_state_dict(CFG, sd.__getitem__, qtype="bf16", dtype=jnp.float32)
+    cache = kvcache.init_cache(
+        CFG.num_hidden_layers, 1, 16, CFG.num_key_value_heads, CFG.head_dim_,
+        dtype=jnp.float32,
+    )
+    logits, _ = llama.forward(
+        CFG, params, jnp.asarray(tokens), cache, mode="prefill",
+        compute_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-3, atol=2e-3)
+
+    # quantized: compare against HF-with-quantized-weights would need HF
+    # surgery; instead bound the drift from our own dense logits.
+    qparams = params_from_state_dict(CFG, sd.__getitem__, qtype=qtype, dtype=jnp.float32)
+    qlogits, _ = llama.forward(
+        CFG, qparams, jnp.asarray(tokens),
+        kvcache.init_cache(
+            CFG.num_hidden_layers, 1, 16, CFG.num_key_value_heads, CFG.head_dim_,
+            dtype=jnp.float32,
+        ),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    err = np.abs(np.asarray(qlogits) - hf_logits).mean()
+    scale = np.abs(hf_logits).mean() + 1e-6
+    assert err / scale < 0.35, err / scale

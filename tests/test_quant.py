@@ -1,0 +1,132 @@
+"""Quantization numerics tests.
+
+Mirrors the correctness oracle the reference uses (quantized output within
+tolerance of fp32 reference, SURVEY.md §4): round-trip error bounds per
+qtype, blockwise invariants, packing bijectivity.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.quant import (
+    QTensor,
+    dequantize,
+    pack_nibbles,
+    quantize,
+    qtype_registry,
+    resolve_qtype,
+    unpack_nibbles,
+)
+
+QUANT_TYPES = [n for n, s in qtype_registry().items() if not s.is_dense]
+
+# Acceptable relative RMS error (||x - deq(q(x))|| / ||x||) for gaussian data.
+# 4-bit uniform ~ 0.04-0.12, nf4 ~ 0.07, 8-bit ~ 0.004, fp8_e4m3 ~ 0.02.
+_TOL = {
+    "sym_int4": 0.12,
+    "asym_int4": 0.10,
+    "sym_int5": 0.06,
+    "asym_int5": 0.05,
+    "sym_int8": 0.008,
+    "nf4": 0.11,
+    "nf3": 0.25,
+    "fp4": 0.30,
+    "fp6": 0.08,
+    "fp8_e4m3": 0.04,
+    "fp8_e5m2": 0.12,
+}
+
+
+def test_pack_unpack_roundtrip(rng):
+    codes = rng.integers(0, 16, size=(4, 64), dtype=np.uint8)
+    packed = pack_nibbles(jnp.asarray(codes))
+    assert packed.shape == (4, 32)
+    out = unpack_nibbles(packed)
+    np.testing.assert_array_equal(np.asarray(out), codes)
+
+
+@pytest.mark.parametrize("qtype", QUANT_TYPES)
+def test_roundtrip_error(rng, qtype):
+    x = rng.standard_normal((8, 256)).astype(np.float32)
+    qt = quantize(jnp.asarray(x), qtype)
+    assert qt.shape == (8, 256)
+    y = np.asarray(dequantize(qt, dtype=jnp.float32))
+    rel = np.linalg.norm(x - y) / np.linalg.norm(x)
+    assert rel < _TOL[qtype], f"{qtype}: rel rms {rel:.4f}"
+
+
+@pytest.mark.parametrize("qtype", QUANT_TYPES)
+def test_zero_blocks_stay_zero(qtype):
+    x = jnp.zeros((2, 256), jnp.float32)
+    y = np.asarray(dequantize(quantize(x, qtype), dtype=jnp.float32))
+    np.testing.assert_allclose(y, 0.0, atol=1e-6)
+
+
+def test_sym_int4_matches_ggml_q4_0_layout(rng):
+    """One block by hand: scale is signed-max/-8, codes in [0,15]."""
+    x = np.zeros((1, 32), np.float32)
+    x[0, 3] = -4.0  # largest magnitude, negative
+    x[0, 10] = 2.0
+    qt = quantize(jnp.asarray(x), "sym_int4")
+    d = float(np.asarray(qt.scales)[0, 0])
+    assert d == pytest.approx(0.5)  # -(-4)/8
+    codes = np.asarray(unpack_nibbles(qt.data))
+    assert codes[0, 3] == 0  # -4/0.5 + 8 = 0
+    assert codes[0, 10] == 12  # 2/0.5 + 8 = 12
+    y = np.asarray(dequantize(qt, dtype=jnp.float32))
+    assert y[0, 3] == pytest.approx(-4.0)
+    assert y[0, 10] == pytest.approx(2.0)
+
+
+def test_asym_int4_hits_endpoints(rng):
+    x = rng.uniform(5.0, 7.0, size=(4, 64)).astype(np.float32)
+    qt = quantize(jnp.asarray(x), "asym_int4")
+    assert qt.mins is not None
+    y = np.asarray(dequantize(qt, dtype=jnp.float32))
+    # asymmetric quantization must represent an all-positive range well
+    assert np.abs(y - x).max() < (x.max() - x.min()) / 15 * 0.51 + 1e-2
+
+
+def test_nf4_uses_codebook_values(rng):
+    x = rng.standard_normal((1, 64)).astype(np.float32)
+    qt = quantize(jnp.asarray(x), "nf4")
+    spec = resolve_qtype("nf4")
+    y = np.asarray(dequantize(qt, dtype=jnp.float32))
+    scale = np.asarray(qt.scales, np.float32)[0, 0]
+    normalized = y[0] / scale
+    for v in normalized:
+        assert np.min(np.abs(spec.codebook - v)) < 1e-3
+
+
+def test_qtensor_is_pytree():
+    import jax
+
+    x = jnp.ones((4, 64), jnp.float32)
+    qt = quantize(x, "sym_int4")
+    leaves, treedef = jax.tree_util.tree_flatten(qt)
+    assert len(leaves) == 2  # data, scales (mins is None)
+    qt2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(qt2, QTensor) and qt2.qtype == "sym_int4"
+    # works under jit
+    out = jax.jit(lambda q: q.dequantize(jnp.float32))(qt)
+    np.testing.assert_allclose(np.asarray(out), 1.0, atol=0.1)
+
+
+def test_quantize_rejects_bad_block(rng):
+    with pytest.raises(ValueError):
+        quantize(jnp.ones((4, 33)), "sym_int4")
+
+
+@pytest.mark.parametrize("qtype", ["sym_int4", "nf4", "sym_int8", "fp8_e4m3"])
+def test_stacked_layers_slice_consistent(rng, qtype):
+    """QTensor with a leading layer axis stays valid when sliced (lax.scan)."""
+    import jax
+
+    x = rng.standard_normal((3, 8, 128)).astype(np.float32)
+    qt = quantize(jnp.asarray(x), qtype)
+    sliced = jax.tree_util.tree_map(lambda a: a[1], qt)
+    assert sliced.shape == (8, 128)
+    y_full = np.asarray(dequantize(qt, jnp.float32))[1]
+    y_slice = np.asarray(dequantize(sliced, jnp.float32))
+    np.testing.assert_allclose(y_full, y_slice)
