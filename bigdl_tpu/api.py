@@ -98,6 +98,47 @@ class TpuModel:
         return np.asarray(out)
 
 
+    def generate_lookup(
+        self,
+        prompts,
+        max_new_tokens: int = 32,
+        lookahead: int = 4,
+        max_ngram: int = 3,
+        **kw,
+    ) -> np.ndarray:
+        """Prompt-lookup decoding (reference lookup.py:274 /
+        IPEX_LLM_PERFORMANCE_MODE): n-gram candidates, one verify forward."""
+        from bigdl_tpu.decode import lookup_generate
+
+        return lookup_generate(
+            self.config, self.params, prompts, self.family.forward,
+            max_new_tokens=max_new_tokens, lookahead=lookahead,
+            max_ngram=max_ngram, **kw,
+        )
+
+    def generate_speculative(
+        self,
+        prompts,
+        draft_params=None,
+        max_new_tokens: int = 32,
+        draft_k: int = 4,
+        **kw,
+    ) -> np.ndarray:
+        """Self-speculative decoding (reference speculative.py:803). With
+        draft_params=None the draft is a sym_int4 re-quantization of this
+        model's weights (the reference's self-draft, model.py:366-379) —
+        only meaningful when this model holds higher-precision weights."""
+        from bigdl_tpu.decode import speculative_generate
+
+        if draft_params is None:
+            draft_params = optimize_model(self.params, self.config, "sym_int4")
+        return speculative_generate(
+            self.config, self.params, draft_params, prompts,
+            self.family.forward, max_new_tokens=max_new_tokens,
+            draft_k=draft_k, **kw,
+        )
+
+
 class AutoModelForCausalLM:
     """Loader namespace, reference-compatible spelling
     (ipex_llm.transformers.AutoModelForCausalLM)."""

@@ -1,0 +1,15 @@
+"""Decode-time acceleration algorithms.
+
+TPU-native re-design of the reference's L5 layer (SURVEY.md §2.2):
+- self-speculative decoding (`transformers/speculative.py:803` in
+  /root/reference): low-bit draft of the same checkpoint proposes, the
+  full-precision target verifies — here both run inside ONE jitted
+  while_loop, no host round-trips per token;
+- prompt-lookup / lookahead decoding (`transformers/lookup.py:145-457`):
+  n-gram candidates from the token history verified the same way.
+"""
+
+from bigdl_tpu.decode.speculative import speculative_generate
+from bigdl_tpu.decode.lookup import lookup_generate
+
+__all__ = ["speculative_generate", "lookup_generate"]

@@ -1,0 +1,184 @@
+"""Prompt-lookup (n-gram) speculative decoding, fully on-device.
+
+Reference: `PromptLookupCandidateGenerator` + `lookup_generate`
+(lookup.py:145-457 in /root/reference) — candidate continuations come
+from matching the trailing n-gram of the generated text against earlier
+history (great for summarization/RAG where output quotes input), then a
+single target forward verifies them. No draft model needed.
+
+The reference scans for n-gram matches on host per token; here matching
+is a vectorized compare over the (static-size) history buffer inside the
+same jitted while_loop as the verify forward. Acceptance bookkeeping is
+identical to bigdl_tpu.decode.speculative (cap K-1, crop = pos reset),
+and emitted tokens are always the target's choices, so greedy output is
+bit-identical to plain generate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.decode.speculative import _emit, mask_after_eos
+from bigdl_tpu.generate import GenerationConfig, sample_token
+from bigdl_tpu.models.config import ModelConfig
+
+
+def _find_candidate(hist, hist_len, row_start, n: int, k: int):
+    """Most recent earlier occurrence of the trailing n-gram.
+
+    Returns (found [bool], cand [1, k]) — the k tokens following the match.
+    """
+    L = hist.shape[1]
+    idx = jnp.arange(L)
+    last = jax.lax.dynamic_slice(hist, (0, hist_len - n), (1, n))
+    m = jnp.ones((L,), jnp.bool_)
+    for j in range(n):  # n is static and small
+        m = m & (jnp.roll(hist[0], -j) == last[0, j])
+    # p must start at a real token, match inside history, and not be the
+    # trailing n-gram itself; continuation must exist.
+    m = m & (idx >= row_start) & (idx + n < hist_len)
+    found = jnp.any(m)
+    p = jnp.max(jnp.where(m, idx, -1))
+    cand = jax.lax.dynamic_slice(hist, (0, jnp.maximum(p, 0) + n), (1, k))
+    return found, cand
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "config", "gen", "model_forward", "cache_len", "lookahead",
+        "max_ngram", "quantize_kv",
+    ),
+)
+def lookup_tokens(
+    config: ModelConfig,
+    params,
+    tokens: jax.Array,  # [1, T] left-padded prompt
+    start: jax.Array,  # [1]
+    key: jax.Array,
+    gen: GenerationConfig,
+    model_forward,
+    cache_len: int,
+    lookahead: int = 4,
+    max_ngram: int = 3,
+    quantize_kv: bool = False,
+) -> jax.Array:
+    B, T = tokens.shape
+    assert B == 1, "lookup decoding is batch-1 (same as the reference)"
+    K = lookahead
+    max_new = gen.max_new_tokens
+    slack = max_new + K + 1
+    assert cache_len >= T + slack
+
+    cache = kvcache.init_cache(
+        config.num_hidden_layers, B, cache_len, config.num_key_value_heads,
+        config.head_dim_, quantize_kv=quantize_kv,
+    )
+    cache = dataclasses.replace(cache, start=start)
+
+    logits, cache = model_forward(config, params, tokens, cache, mode="prefill")
+    key, k0 = jax.random.split(key)
+    cur = sample_token(logits[:, -1], k0, gen)
+
+    # History buffer: prompt then generated tokens, contiguous from `start`.
+    hist = jnp.zeros((B, T + slack), jnp.int32)
+    hist = jax.lax.dynamic_update_slice(hist, tokens, (0, 0))
+    hist = jax.lax.dynamic_update_slice(hist, cur[:, None], (0, T))
+    hist_len = jnp.asarray(T + 1, jnp.int32)
+
+    out = jnp.full((B, slack), gen.pad_token_id, jnp.int32)
+    out = out.at[:, 0].set(cur)
+    eos = gen.eos_token_id
+    done = cur == eos if eos is not None else jnp.zeros((B,), jnp.bool_)
+
+    def cond(state):
+        n_gen = state[0]
+        done = state[4]
+        return (n_gen < max_new) & ~jnp.all(done)
+
+    def round_fn(state):
+        n_gen, cur, cache, hist, done, out, key, hist_len = state
+
+        # candidate drafts from the longest matching n-gram
+        drafts = jnp.zeros((B, K - 1), jnp.int32)
+        found_any = jnp.zeros((), jnp.bool_)
+        for n in range(max_ngram, 0, -1):  # static unroll, first hit wins
+            found, cand = _find_candidate(hist, hist_len, start[0], n, K - 1)
+            take = found & ~found_any
+            drafts = jnp.where(take, cand, drafts)
+            found_any = found_any | found
+
+        verify_in = jnp.concatenate([cur[:, None], drafts], axis=1)  # [1, K]
+        tlogits, cache = model_forward(
+            config, params, verify_in, cache, mode="prefill"
+        )
+        key, kk = jax.random.split(key)
+        keys = jax.random.split(kk, K)
+        choice = jnp.stack(
+            [sample_token(tlogits[:, i], keys[i], gen) for i in range(K)], axis=1
+        )
+
+        match = drafts == choice[:, : K - 1]
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)[0]
+        # no candidate found -> plain decode step (bonus token only)
+        n_acc = jnp.where(found_any, n_acc, 0)
+
+        out = _emit(out, choice, n_acc, n_gen, K)
+        hist = _emit(hist, choice, n_acc, hist_len, K)
+        cur = jax.lax.dynamic_slice(choice, (0, n_acc), (1, 1))[:, 0]
+
+        cache = dataclasses.replace(cache, pos=cache.pos - K + n_acc + 1)
+        hist_len = hist_len + n_acc + 1
+
+        if eos is not None:
+            emitted = jax.lax.dynamic_slice(out, (0, n_gen), (1, K))
+            idx = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
+            done = done | jnp.any((emitted == eos) & (idx <= n_acc), axis=1)
+        return (n_gen + n_acc + 1, cur, cache, hist, done, out, key, hist_len)
+
+    state = (jnp.ones((), jnp.int32), cur, cache, hist, done, out, key, hist_len)
+    state = jax.lax.while_loop(cond, round_fn, state)
+    out = state[5]
+    return out[:, :max_new]
+
+
+def lookup_generate(
+    config: ModelConfig,
+    params,
+    prompts,
+    model_forward,
+    max_new_tokens: int = 32,
+    lookahead: int = 4,
+    max_ngram: int = 3,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k=None,
+    top_p=None,
+    eos_token_id=None,
+    pad_token_id: int = 0,
+    seed: int = 0,
+    quantize_kv: bool = False,
+) -> np.ndarray:
+    """Host entry point mirroring `lookup_generate` (lookup.py:274)."""
+    from bigdl_tpu.generate import pad_prompts
+
+    tokens, start = pad_prompts(prompts, pad_token_id)
+    gen = GenerationConfig(
+        max_new_tokens=max_new_tokens, do_sample=do_sample,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+    )
+    need = tokens.shape[1] + max_new_tokens + lookahead + 1
+    cache_len = ((need + 63) // 64) * 64
+    out = lookup_tokens(
+        config, params, jnp.asarray(tokens), jnp.asarray(start),
+        jax.random.PRNGKey(seed), gen, model_forward, cache_len=cache_len,
+        lookahead=lookahead, max_ngram=max_ngram, quantize_kv=quantize_kv,
+    )
+    return mask_after_eos(np.asarray(out), eos_token_id, pad_token_id)

@@ -1,0 +1,206 @@
+"""Self-speculative decoding, fully on-device.
+
+Reference algorithm (`speculative.py:803` in /root/reference): draft K
+tokens autoregressively with a sym_int4 copy of the model, verify all of
+them with one target forward, accept the longest matching prefix plus
+one bonus token. The reference runs this as a host Python loop over
+eager kernels; here the whole draft→verify→accept round is one XLA
+program iterated by `lax.while_loop`, so the accept bookkeeping costs
+nothing on host.
+
+Cache discipline (static-shape version of the reference's
+`_crop_past_key_values`, speculative.py:478): acceptance is capped at
+K-1 drafts so that after every round
+    target.pos = draft.pos = P + n_acc + 1
+with all entries below pos written with the true token sequence —
+"cropping" is just resetting `pos`, since slots above it are
+overwritten before they can be attended.
+
+Emitted tokens are always the TARGET's choices, so greedy speculative
+output is bit-identical to greedy `generate_tokens` regardless of draft
+quality — that invariant is the correctness test.
+
+Batch size 1 (like the reference's speculative path): per-row accept
+counts would need per-row cache positions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.generate import GenerationConfig, sample_token
+from bigdl_tpu.models.config import ModelConfig
+
+
+def _emit(out, choice, n_acc, n_gen, max_k):
+    """out[0, n_gen + t] = choice[0, t] for t <= n_acc (K static)."""
+    def body(t, out):
+        val = jax.lax.dynamic_slice(choice, (0, t), (1, 1))
+        upd = jax.lax.dynamic_update_slice(out, val, (0, n_gen + t))
+        return jnp.where(t <= n_acc, upd, out)
+
+    return jax.lax.fori_loop(0, max_k, body, out)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "config", "gen", "model_forward", "cache_len", "draft_k", "quantize_kv"
+    ),
+)
+def speculative_tokens(
+    config: ModelConfig,
+    target_params,
+    draft_params,
+    tokens: jax.Array,  # [1, T] left-padded prompt
+    start: jax.Array,  # [1]
+    key: jax.Array,
+    gen: GenerationConfig,
+    model_forward,
+    cache_len: int,
+    draft_k: int = 4,
+    quantize_kv: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (out [1, max_new_tokens], n_rounds) — n_rounds counts
+    verify forwards, for the acceptance-rate diagnostic."""
+    B, T = tokens.shape
+    assert B == 1, "speculative decoding is batch-1 (same as the reference)"
+    K = draft_k
+    max_new = gen.max_new_tokens
+    slack = max_new + K + 1
+    assert cache_len >= T + slack
+
+    def new_cache():
+        c = kvcache.init_cache(
+            config.num_hidden_layers, B, cache_len, config.num_key_value_heads,
+            config.head_dim_, quantize_kv=quantize_kv,
+        )
+        return dataclasses.replace(c, start=start)
+
+    tcache, dcache = new_cache(), new_cache()
+
+    # Prefill both models on the prompt; first token comes from the target.
+    tlogits, tcache = model_forward(config, target_params, tokens, tcache, mode="prefill")
+    _, dcache = model_forward(config, draft_params, tokens, dcache, mode="prefill")
+    key, k0 = jax.random.split(key)
+    cur = sample_token(tlogits[:, -1], k0, gen)  # [1]
+
+    out = jnp.full((B, slack), gen.pad_token_id, jnp.int32)
+    out = out.at[:, 0].set(cur)
+    eos = gen.eos_token_id
+    done = cur == eos if eos is not None else jnp.zeros((B,), jnp.bool_)
+
+    def cond(state):
+        n_gen, _, _, _, done, _, _, _ = state
+        return (n_gen < max_new) & ~jnp.all(done)
+
+    def round_fn(state):
+        n_gen, cur, tcache, dcache, done, out, key, n_rounds = state
+
+        # --- draft K tokens greedily (writes K KV entries: cur, d0..d_{K-2})
+        def draft_step(i, carry):
+            tok, dcache, drafts = carry
+            logits, dcache = model_forward(
+                config, draft_params, tok[:, None], dcache, mode="decode"
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            drafts = jax.lax.dynamic_update_slice(drafts, nxt[:, None], (0, i))
+            return (nxt, dcache, drafts)
+
+        drafts0 = jnp.zeros((B, K), jnp.int32)
+        _, dcache, drafts = jax.lax.fori_loop(
+            0, K, draft_step, (cur, dcache, drafts0)
+        )
+
+        # --- verify: one target forward over [cur, d0..d_{K-2}]  (T = K)
+        verify_in = jnp.concatenate([cur[:, None], drafts[:, : K - 1]], axis=1)
+        tlogits, tcache = model_forward(
+            config, target_params, verify_in, tcache, mode="prefill"
+        )
+        key, kk = jax.random.split(key)
+        keys = jax.random.split(kk, K)
+        choice = jnp.stack(
+            [sample_token(tlogits[:, i], keys[i], gen) for i in range(K)], axis=1
+        )  # [1, K] target's token for each position
+
+        # --- longest matching prefix, capped at K-1 (cache discipline)
+        match = drafts[:, : K - 1] == choice[:, : K - 1]  # [1, K-1]
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)[0]
+
+        out = _emit(out, choice, n_acc, n_gen, K)
+        cur = jax.lax.dynamic_slice(choice, (0, n_acc), (1, 1))[:, 0]
+
+        # crop both caches to the accepted length
+        new_pos = tcache.pos - K + n_acc + 1
+        tcache = dataclasses.replace(tcache, pos=new_pos)
+        dcache = dataclasses.replace(dcache, pos=new_pos)
+
+        if eos is not None:
+            emitted = jax.lax.dynamic_slice(out, (0, n_gen), (1, K))
+            idx = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
+            hit = (emitted == eos) & (idx <= n_acc)
+            done = done | jnp.any(hit, axis=1)
+        return (n_gen + n_acc + 1, cur, tcache, dcache, done, out, key, n_rounds + 1)
+
+    state = (
+        jnp.ones((), jnp.int32), cur, tcache, dcache, done, out, key,
+        jnp.zeros((), jnp.int32),
+    )
+    n_gen, _, _, _, _, out, _, n_rounds = jax.lax.while_loop(cond, round_fn, state)
+    return out[:, :max_new], n_rounds
+
+
+def mask_after_eos(out: np.ndarray, eos: int | None, pad: int) -> np.ndarray:
+    """Host-side cleanup: tokens after the first EOS become pad (rounds can
+    emit a few tokens past EOS before the loop notices)."""
+    if eos is None:
+        return out
+    out = np.array(out)
+    for b in range(out.shape[0]):
+        hits = np.nonzero(out[b] == eos)[0]
+        if hits.size:
+            out[b, hits[0] + 1:] = pad
+    return out
+
+
+def speculative_generate(
+    config: ModelConfig,
+    target_params,
+    draft_params,
+    prompts,
+    model_forward,
+    max_new_tokens: int = 32,
+    draft_k: int = 4,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k=None,
+    top_p=None,
+    eos_token_id=None,
+    pad_token_id: int = 0,
+    seed: int = 0,
+    quantize_kv: bool = False,
+) -> np.ndarray:
+    """Host entry point mirroring `speculative_generate` (speculative.py:803)."""
+    from bigdl_tpu.generate import pad_prompts
+
+    tokens, start = pad_prompts(prompts, pad_token_id)
+    gen = GenerationConfig(
+        max_new_tokens=max_new_tokens, do_sample=do_sample,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+    )
+    need = tokens.shape[1] + max_new_tokens + draft_k + 1
+    cache_len = ((need + 63) // 64) * 64
+    out, _ = speculative_tokens(
+        config, target_params, draft_params,
+        jnp.asarray(tokens), jnp.asarray(start), jax.random.PRNGKey(seed),
+        gen, model_forward, cache_len=cache_len, draft_k=draft_k,
+        quantize_kv=quantize_kv,
+    )
+    return mask_after_eos(np.asarray(out), eos_token_id, pad_token_id)

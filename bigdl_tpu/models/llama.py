@@ -97,8 +97,11 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
     out = dict(params)
     out["layers"] = dict(params["layers"])
     for name in _QUANT_TARGETS:
-        out["layers"][name] = quantize(params["layers"][name], spec.name)
-    if "lm_head" in params:
+        w = params["layers"][name]
+        if isinstance(w, QTensor):  # idempotent: already low-bit
+            continue
+        out["layers"][name] = quantize(w, spec.name)
+    if "lm_head" in params and not isinstance(params["lm_head"], QTensor):
         lm_spec = resolve_qtype(lm_head_qtype) if lm_head_qtype else spec
         if not lm_spec.is_dense:
             out["lm_head"] = quantize(params["lm_head"], lm_spec.name)
@@ -167,8 +170,18 @@ def forward(
     inv_freq = make_inv_freq(D, config.rope_theta, config.rope_scaling_dict)
     cos, sin = rope_cos_sin(positions, inv_freq)
 
+    # Prefill goes through the Pallas flash-attention kernel (no [T,S]
+    # score matrix in HBM); decode and the differentiable cache-free
+    # training path use the fused XLA attention. Mirrors the reference's
+    # sdp_causal vs sdp dispatch (models/common.py:222-258).
+    from bigdl_tpu.ops.pallas import use_pallas
+
+    use_flash = cache is not None and mode == "prefill" and T > 1 and use_pallas()
+
     # Attention masks (shared by all layers, computed once outside the scan).
-    if cache is None:
+    if use_flash:
+        mask = None
+    elif cache is None:
         # cache-free training path: block-local causal
         tj = jnp.arange(T)
         mask = (tj[None, :] <= tj[:, None])[None] & (
@@ -187,7 +200,8 @@ def forward(
         )  # [B, T, S]
         if config.sliding_window:
             mask = mask & (sj[None, None, :] > q_slot[..., None] - config.sliding_window)
-    mask = mask[:, None, None]  # [B, 1, 1, T, S'] broadcasts over (Hkv, G)
+    if mask is not None:
+        mask = mask[:, None, None]  # [B, 1, 1, T, S'] broadcasts over (Hkv, G)
 
     lora_scale = lora["scale"] if lora is not None else None
 
@@ -214,7 +228,15 @@ def forward(
             k_att = k.astype(compute_dtype)
             v_att = v.astype(compute_dtype)
 
-        attn = attention(q, k_att, v_att, mask)
+        if use_flash:
+            from bigdl_tpu.ops.pallas import flash_attention
+
+            attn = flash_attention(
+                q, k_att, v_att, start=row_start, q_offset=pos0,
+                window=config.sliding_window, softcap=config.attn_logit_softcap,
+            )
+        else:
+            attn = attention(q, k_att, v_att, mask, softcap=config.attn_logit_softcap)
         out = proj(attn.reshape(B, T, Hq * D), p, lp, "wo")
         hidden = hidden + out
 

@@ -27,6 +27,7 @@ def attention(
     v: jax.Array,
     mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """q [B,T,Hq,D]; k,v [B,S,Hkv,D]; mask broadcastable to [B,Hkv,G,T,S]
     (bool: True = attend). Returns [B,T,Hq,D] in q.dtype.
@@ -46,6 +47,8 @@ def attention(
         "bthgd,bshd->bhgts", qg, k, preferred_element_type=jnp.float32
     )
     scores = scores.astype(jnp.float32) * scale
+    if softcap is not None:
+        scores = jnp.tanh(scores / softcap) * softcap
     if mask is not None:
         if mask.dtype == jnp.bool_:
             scores = jnp.where(mask, scores, _NEG_INF)

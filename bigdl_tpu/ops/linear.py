@@ -2,10 +2,11 @@
 
 Equivalent of `LowBitLinear.forward` in the reference
 (low_bit_linear.py:606-716): one entry point that dispatches on weight
-type and shape. On TPU the prefill/decode split the reference implements
-with two SYCL kernels (`xe_linear.forward_new` vs `xe_batch.batch_forward`)
-is handled by XLA specializing the same fused dequant+matmul graph per
-input shape; a Pallas kernel path covers the memory-bound decode GEMV.
+type and shape. The prefill/decode split the reference implements with
+two SYCL kernels (`xe_linear.forward_new` vs `xe_batch.batch_forward`)
+maps to: decode-shaped (few rows) sym_int4 matmuls go to the Pallas
+fused dequant-GEMV kernel (packed weights cross HBM as nibbles); other
+shapes use an in-graph dequant that XLA fuses into the matmul.
 """
 
 from __future__ import annotations
@@ -16,6 +17,29 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.quant import QTensor
+
+# Decode GEMV threshold, same role as the reference's `use_batch_forward`
+# heuristic (low_bit_linear.py:272-309): below this many rows the matmul
+# is weight-bandwidth-bound and the packed kernel wins.
+_GEMV_MAX_ROWS = 32
+
+
+def _rows(shape) -> int:
+    n = 1
+    for d in shape[:-1]:
+        n *= d
+    return n
+
+
+def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
+    from bigdl_tpu.ops.pallas import use_pallas
+
+    if w.qtype != "sym_int4" or w.data.ndim != 2:
+        return False
+    out, kh = w.data.shape
+    if out % 128 != 0 or (kh * 2) % 32 != 0:
+        return False
+    return _rows(x.shape) <= _GEMV_MAX_ROWS and use_pallas()
 
 
 def linear(
@@ -31,6 +55,17 @@ def linear(
     in HBM.
     """
     if isinstance(w, QTensor):
+        if _use_qgemv(x, w):
+            from bigdl_tpu.ops.pallas import qmatmul_int4
+
+            block_o = 256 if w.data.shape[0] % 256 == 0 else 128
+            y = qmatmul_int4(
+                x.astype(compute_dtype), w.data, w.scales,
+                out_dtype=compute_dtype, block_o=block_o,
+            )
+            if bias is not None:
+                y = y + bias.astype(compute_dtype)
+            return y
         wd = w.dequantize(compute_dtype)
     else:
         wd = w.astype(compute_dtype)

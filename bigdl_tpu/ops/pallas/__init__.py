@@ -1,0 +1,44 @@
+"""Pallas TPU kernels — the framework's native kernel layer.
+
+These are the TPU counterparts of the reference's prebuilt SYCL/C++ kernel
+wheels (`bigdl-core-xe*` / `xe_linear` / `xe_addons`, SURVEY.md §2.1): real
+on-chip kernels for the hot ops, not Python stand-ins. Unlike the
+reference (which ships opaque binaries), the kernels are source in-tree
+and compile through Mosaic for the local chip.
+
+Dispatch policy (`use_pallas()`):
+- on TPU backends the kernels are used automatically;
+- on CPU they run only when `BIGDL_TPU_PALLAS=interpret` (tests exercise
+  the kernel logic via the Pallas interpreter);
+- `BIGDL_TPU_PALLAS=0` force-disables (XLA fallback everywhere).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def _mode() -> str:
+    return os.environ.get("BIGDL_TPU_PALLAS", "auto")
+
+
+def use_pallas() -> bool:
+    mode = _mode()
+    if mode == "0":
+        return False
+    if mode == "interpret":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """Run kernels through the Pallas interpreter (CPU testing)."""
+    return _mode() == "interpret" or jax.default_backend() != "tpu"
+
+
+from bigdl_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
+from bigdl_tpu.ops.pallas.qmatmul import qmatmul_int4  # noqa: E402
+
+__all__ = ["use_pallas", "interpret_mode", "flash_attention", "qmatmul_int4"]

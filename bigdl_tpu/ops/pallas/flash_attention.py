@@ -1,0 +1,235 @@
+"""Pallas flash attention (TPU) with online softmax.
+
+TPU-native replacement for the reference's fused SDP kernels
+(`xe_addons.sdp / sdp_causal / sdp_non_causal`, call sites
+models/common.py:222-258 in /root/reference): one kernel covers causal
+attention over a left-padded KV cache, GQA head grouping, optional
+sliding window and logit softcap (gemma2), without ever materializing
+the [T, S] score matrix in HBM.
+
+Layout: q [B, T, Hq, D]; k, v [B, S, Hkv, D] (the KV-cache layout).
+`start[b]` is the first valid cache slot of row b (left padding);
+`q_offset` is the global cache slot of q position 0 (= cache.pos at
+entry). Query slot t attends kv slot j iff
+    start[b] <= j <= q_offset + t          (causal)
+    and j > q_offset + t - window          (if sliding window).
+
+Grid is (B, Hq, nQ, nK) with the K axis innermost ("arbitrary"
+semantics); m/l/acc accumulators live in VMEM scratch and the output
+block is written once on the last K step. K blocks entirely above the
+causal diagonal are skipped via `pl.when`, so causal costs ~half of
+full attention, matching a hand-scheduled kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _kernel(
+    start_ref,  # SMEM [B] int32: per-row pad offsets (indexed by program_id)
+    qoff_ref,  # SMEM [1] int32: global slot of q position 0
+    q_ref,  # VMEM [1, 1, BQ, D]
+    k_ref,  # VMEM [1, 1, BK, D]
+    v_ref,  # VMEM [1, 1, BK, D]
+    o_ref,  # VMEM [1, 1, BQ, D]
+    m_scr,  # VMEM [BQ, LANES] f32
+    l_scr,  # VMEM [BQ, LANES] f32
+    acc_scr,  # VMEM [BQ, D] f32
+    *,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+    window: Optional[int],
+    softcap: Optional[float],
+):
+    b = pl.program_id(0)
+    i, j = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qoff = qoff_ref[0]
+    row_max = qoff + (i + 1) * block_q - 1  # largest global q slot in block
+    # K block is live unless entirely above the causal diagonal / outside
+    # the sliding window of every query row in this Q block.
+    live = jnp.bool_(True)
+    if causal:
+        live = live & (j * block_k <= row_max)
+    if window is not None:
+        row_min = qoff + i * block_q
+        live = live & ((j + 1) * block_k - 1 > row_min - window)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+
+        rows = qoff + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = cols >= start_ref[b]
+        if causal:
+            valid = valid & (cols <= rows)
+        if window is not None:
+            valid = valid & (cols > rows - window)
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [BQ, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # exp(-1e30 - (-1e30)) = 1 on fully-masked rows; zero explicitly.
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # [BQ, BK]
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0, 0].astype(jnp.float32)  # [BK, D]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        out = acc_scr[:] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal", "window", "softcap", "scale", "block_q", "block_k", "interpret"
+    ),
+)
+def _flash(
+    q, k, v, start, q_offset,
+    causal: bool, window: Optional[int], softcap: Optional[float],
+    scale: float, block_q: int, block_k: int, interpret: bool,
+):
+    B, Hq, T, D = q.shape
+    _, Hkv, S, _ = k.shape
+    group = Hq // Hkv
+    n_q, n_k = T // block_q, S // block_k
+
+    grid = (B, Hq, n_q, n_k)
+    kernel = functools.partial(
+        _kernel,
+        scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, window=window, softcap=softcap,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B,), lambda b, h, i, j: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), lambda b, h, i, j: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, i, j: (b, h // group, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, i, j: (b, h // group, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(start, q_offset, q, k, v)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, T, Hq, D]
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,  # [B, S, Hkv, D]
+    start: Optional[jax.Array] = None,  # [B] int32 left-pad offsets
+    q_offset: Optional[jax.Array] = None,  # scalar int32 global slot of q[0]
+    causal: bool = True,
+    window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Returns [B, T, Hq, D] in q.dtype. Pads T/S/D to tile multiples
+    internally; padding key slots are excluded by the causal mask (they
+    lie beyond every query's global slot)."""
+    from bigdl_tpu.ops.pallas import interpret_mode
+
+    B, T, Hq, D = q.shape
+    _, S, Hkv, _ = k.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if interpret is None:
+        interpret = interpret_mode()
+    if start is None:
+        start = jnp.zeros((B,), jnp.int32)
+    if q_offset is None:
+        q_offset = jnp.zeros((), jnp.int32)
+    assert causal, "non-causal path uses ops.attention (bidirectional encoders)"
+
+    block_q = min(block_q, _round_up(T, 16))
+    block_k = min(block_k, _round_up(S, 16))
+    Tp, Sp, Dp = _round_up(T, block_q), _round_up(S, block_k), _round_up(D, _LANES)
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, Hq, T, D]
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    qt = jnp.pad(qt, ((0, 0), (0, 0), (0, Tp - T), (0, Dp - D)))
+    kt = jnp.pad(kt, ((0, 0), (0, 0), (0, Sp - S), (0, Dp - D)))
+    vt = jnp.pad(vt, ((0, 0), (0, 0), (0, Sp - S), (0, Dp - D)))
+
+    out = _flash(
+        qt, kt, vt,
+        start.astype(jnp.int32),
+        q_offset.astype(jnp.int32).reshape(1),
+        causal, window, softcap, scale, block_q, block_k, interpret,
+    )
+    return jnp.transpose(out[:, :, :T, :D], (0, 2, 1, 3))

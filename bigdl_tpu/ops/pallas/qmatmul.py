@@ -1,0 +1,127 @@
+"""Pallas fused dequant-matmul for packed int4 weights.
+
+TPU-native counterpart of the reference's low-bit GEMM/GEMV kernels
+(`xe_linear.forward_new` for prefill, `xe_batch.batch_forward` for
+decode; dispatch in low_bit_linear.py:606-716 of /root/reference).
+
+The decode step is HBM-bandwidth-bound: y = x @ W^T with x [M, K],
+M <= ~32. The win over the XLA fallback (dequantize to bf16, then
+matmul) is that W crosses HBM as packed nibbles — 0.5 byte/weight + one
+f16 scale per 32 — i.e. ~4x less weight traffic than bf16, which is the
+entire cost of a GEMV.
+
+Nibble layout trick: QTensor packs elements (2i, 2i+1) into one byte
+(low, high nibble). Instead of re-interleaving inside the kernel (an
+awkward layout change on TPU), the caller splits x into its even and odd
+K columns once (x is tiny), and the kernel computes
+    y = x_even @ dq(lo).T + x_odd @ dq(hi).T
+so unpacked nibbles are used in the layout they already have.
+
+Scales: one f16 per 32 contiguous weights -> per 16 packed bytes. The
+kernel expands them with a broadcast+reshape (VMEM-local, no HBM cost).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 32  # quant block (elements per scale), fixed for sym_int4
+_PACKED_PER_SCALE = BLOCK // 2
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref, *, block_o: int, kh: int):
+    """One O-tile: o_ref[M, block_o] = xe @ lo^T + xo @ hi^T, dequantized."""
+    # Mosaic can't cast uint8 directly to float; widen to int32 first.
+    w = w_ref[:].astype(jnp.int32)  # [block_o, kh]
+    lo = ((w & 0xF) - 8).astype(jnp.float32)
+    hi = ((w >> 4) - 8).astype(jnp.float32)
+
+    s = s_ref[:].astype(jnp.float32)  # [block_o, kh // 16]
+    s = jnp.broadcast_to(
+        s[:, :, None], (block_o, kh // _PACKED_PER_SCALE, _PACKED_PER_SCALE)
+    ).reshape(block_o, kh)
+
+    wl = (lo * s).astype(jnp.bfloat16)
+    wh = (hi * s).astype(jnp.bfloat16)
+    xe = xe_ref[:].astype(jnp.bfloat16)  # [M, kh]
+    xo = xo_ref[:].astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        xe, wl, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    acc += jax.lax.dot_general(
+        xo, wh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "interpret")
+)
+def _qmm(xe, xo, w, s, out_dtype, block_o: int, interpret: bool):
+    M, kh = xe.shape
+    O = w.shape[0]
+    grid = (O // block_o,)
+    return pl.pallas_call(
+        functools.partial(_kernel, block_o=block_o, kh=kh),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, kh), lambda o: (o, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (block_o, kh // _PACKED_PER_SCALE), lambda o: (o, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(xe, xo, w, s)
+
+
+def qmatmul_int4(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K // 2] packed uint8 (sym_int4)
+    scales: jax.Array,  # [O, K // 32] f16
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """y[..., O] = x @ dequant(W)^T for a sym_int4 QTensor's fields."""
+    from bigdl_tpu.ops.pallas import interpret_mode
+
+    if interpret is None:
+        interpret = interpret_mode()
+    *lead, K = x.shape
+    O, kh = data.shape
+    assert kh * 2 == K and K % BLOCK == 0
+
+    M = 1
+    for d in lead:
+        M *= d
+    x2 = x.reshape(M, K)
+    xe, xo = x2[:, 0::2], x2[:, 1::2]  # [M, K//2] each; tiny, XLA-side
+
+    Mp = _round_up(max(M, 1), 8)
+    xe = jnp.pad(xe, ((0, Mp - M), (0, 0)))
+    xo = jnp.pad(xo, ((0, Mp - M), (0, 0)))
+
+    block_o = min(block_o, O)
+    assert O % block_o == 0, f"O={O} not divisible by block_o={block_o}"
+
+    y = _qmm(xe, xo, data, scales, jnp.dtype(out_dtype), block_o, interpret)
+    return y[:M].reshape(*lead, O)
