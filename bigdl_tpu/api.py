@@ -66,9 +66,15 @@ class TpuModel:
         pad_token_id: int = 0,
         seed: int = 0,
         quantize_kv: bool = False,
+        compress_kv: Optional[int] = None,  # SnapKV budget (slots kept)
+        compress_window: int = 32,
     ) -> np.ndarray:
         """prompts: ragged list of token-id lists (or [B, T] array).
-        Returns [B, max_new_tokens] generated ids."""
+        Returns [B, max_new_tokens] generated ids.
+
+        quantize_kv is the reference's IPEX_LLM_QUANTIZE_KV_CACHE (FP8 KV);
+        compress_kv the reference's IPEX_LLM_COMPRESS_KV_CACHE (SnapKV) —
+        applied only when the prompt is longer than the budget."""
         if isinstance(prompts, np.ndarray):
             prompts = [list(row) for row in prompts]
         tokens, start = pad_prompts(prompts, pad_token_id)
@@ -81,9 +87,12 @@ class TpuModel:
             eos_token_id=eos_token_id,
             pad_token_id=pad_token_id,
         )
-        # cache sized to a 64-slot multiple: few distinct compiled programs
-        need = tokens.shape[1] + max_new_tokens
-        cache_len = ((need + 63) // 64) * 64
+        from bigdl_tpu.utils import cache_len_for
+
+        cache_len = cache_len_for(tokens.shape[1], max_new_tokens)
+        budget = 0
+        if compress_kv is not None and tokens.shape[1] > compress_kv:
+            budget = compress_kv
         out = generate_tokens(
             self.config,
             self.params,
@@ -94,6 +103,8 @@ class TpuModel:
             self.family.forward,
             cache_len=cache_len,
             quantize_kv=quantize_kv,
+            compress_budget=budget,
+            compress_window=min(compress_window, max(budget - 1, 1)),
         )
         return np.asarray(out)
 
@@ -127,11 +138,26 @@ class TpuModel:
         """Self-speculative decoding (reference speculative.py:803). With
         draft_params=None the draft is a sym_int4 re-quantization of this
         model's weights (the reference's self-draft, model.py:366-379) —
-        only meaningful when this model holds higher-precision weights."""
+        only meaningful when this model holds higher-precision weights.
+        The self-draft is built once and cached on the model."""
         from bigdl_tpu.decode import speculative_generate
 
         if draft_params is None:
-            draft_params = optimize_model(self.params, self.config, "sym_int4")
+            from bigdl_tpu.quant.qtypes import resolve_qtype
+
+            if not resolve_qtype(self.qtype).is_dense:
+                # re-quantizing already-quantized weights is a no-op
+                # (quantize_params skips QTensor leaves) — the "draft" would
+                # be weight-identical to the target: all cost, no speedup.
+                raise ValueError(
+                    f"model qtype {self.qtype!r} is already quantized; a "
+                    "sym_int4 self-draft would equal the target. Pass "
+                    "explicit draft_params or load the target as fp16/bf16."
+                )
+            draft_params = getattr(self, "_draft_params", None)
+            if draft_params is None:
+                draft_params = optimize_model(self.params, self.config, "sym_int4")
+                object.__setattr__(self, "_draft_params", draft_params)
         return speculative_generate(
             self.config, self.params, draft_params, prompts,
             self.family.forward, max_new_tokens=max_new_tokens,

@@ -4,14 +4,16 @@ transformers/model.py — `from_pretrained`, `save_low_bit`, `load_low_bit`)."""
 from bigdl_tpu.convert.hf import (
     params_from_state_dict,
     load_hf_checkpoint,
-    state_dict_mapping,
+    layer_tensors,
+    top_tensors,
 )
 from bigdl_tpu.convert.low_bit import save_low_bit, load_low_bit
 
 __all__ = [
     "params_from_state_dict",
     "load_hf_checkpoint",
-    "state_dict_mapping",
+    "layer_tensors",
+    "top_tensors",
     "save_low_bit",
     "load_low_bit",
 ]

@@ -2,10 +2,20 @@
 
 Equivalent of the reference load path (`transformers/model.py:111`
 `from_pretrained` → `load_convert` → `ggml_convert_low_bit`,
-SURVEY.md §3.1), TPU-shaped: safetensors shards are streamed tensor by
-tensor, each layer's weights are quantized immediately (peak host memory
-~ one layer in fp32), and per-layer results are stacked along the leading
-axis for `lax.scan`.
+SURVEY.md §3.1) plus the weight-level prep `_optimize_pre` does per
+architecture (convert.py:886-1076: qkv merges/splits, NormHead→Linear,
+fused gate_up handling), TPU-shaped: safetensors shards are streamed
+tensor by tensor, each layer's weights are quantized immediately (peak
+host memory ~ one layer in fp32), and per-layer results are stacked
+along the leading axis for `lax.scan`.
+
+Per-model_type weight translation lives in the `_FAMILY_*` tables below —
+the weights-side counterpart of the config translation in
+bigdl_tpu/models/config.py. Where the reference merges separate q/k/v
+into one fused linear for kernel efficiency (merge_qkv,
+models/common.py:22-53), we keep q/k/v separate (XLA fuses the three
+matmuls reading one activation), and instead *split* checkpoints that
+ship fused (phi3 qkv_proj/gate_up_proj, baichuan W_pack, internlm2 wqkv).
 
 Shards are read via safetensors' torch framework (robust bf16/fp16
 handling); torch is imported lazily and only by this ingest path —
@@ -25,46 +35,304 @@ from bigdl_tpu.models.config import ModelConfig
 from bigdl_tpu.quant import QTensor, quantize
 from bigdl_tpu.quant.qtypes import resolve_qtype
 
-# our layer-param name -> HF per-layer suffix
-_LAYER_MAP = {
-    "attn_norm": "input_layernorm.weight",
-    "mlp_norm": "post_attention_layernorm.weight",
-    "wq": "self_attn.q_proj.weight",
-    "wk": "self_attn.k_proj.weight",
-    "wv": "self_attn.v_proj.weight",
-    "wo": "self_attn.o_proj.weight",
-    "w_gate": "mlp.gate_proj.weight",
-    "w_up": "mlp.up_proj.weight",
-    "w_down": "mlp.down_proj.weight",
-    "bq": "self_attn.q_proj.bias",
-    "bk": "self_attn.k_proj.bias",
-    "bv": "self_attn.v_proj.bias",
+_QUANT_TARGETS = {
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    "w_gate_e", "w_up_e", "w_down_e", "w_gate_s", "w_up_s", "w_down_s",
 }
 
-_QUANT_TARGETS = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+Get = Callable[[str], np.ndarray]
 
 
-def state_dict_mapping(config: ModelConfig) -> dict[str, list[str]]:
-    """our param path -> list of HF tensor names (one per layer for stacked)."""
-    L = config.num_hidden_layers
-    mapping: dict[str, list[str]] = {
-        "embed": ["model.embed_tokens.weight"],
-        "final_norm": ["model.norm.weight"],
+# ---------------------------------------------------------------------------
+# per-family layer/top tensor builders
+# ---------------------------------------------------------------------------
+
+def _llama_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    p = f"model.layers.{i}."
+    out = {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "w_gate": get(p + "mlp.gate_proj.weight"),
+        "w_up": get(p + "mlp.up_proj.weight"),
+        "w_down": get(p + "mlp.down_proj.weight"),
+    }
+    if config.attention_bias:
+        out["bq"] = get(p + "self_attn.q_proj.bias")
+        out["bk"] = get(p + "self_attn.k_proj.bias")
+        out["bv"] = get(p + "self_attn.v_proj.bias")
+    if config.attention_out_bias:
+        out["bo"] = get(p + "self_attn.o_proj.bias")
+    if config.norm_bias:
+        out["attn_norm_b"] = get(p + "input_layernorm.bias")
+        out["mlp_norm_b"] = get(p + "post_attention_layernorm.bias")
+    return out
+
+
+def _llama_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    out = {
+        "embed": get("model.embed_tokens.weight"),
+        "final_norm": get("model.norm.weight"),
+    }
+    if config.norm_bias:
+        out["final_norm_b"] = get("model.norm.bias")
+    if not config.tie_word_embeddings:
+        out["lm_head"] = get("lm_head.weight")
+    return out
+
+
+def _gemma2_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    p = f"model.layers.{i}."
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "post_attn_norm": get(p + "post_attention_layernorm.weight"),
+        "mlp_norm": get(p + "pre_feedforward_layernorm.weight"),
+        "post_mlp_norm": get(p + "post_feedforward_layernorm.weight"),
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "w_gate": get(p + "mlp.gate_proj.weight"),
+        "w_up": get(p + "mlp.up_proj.weight"),
+        "w_down": get(p + "mlp.down_proj.weight"),
+    }
+
+
+def _phi3_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """phi3 ships fused qkv_proj [QD+2*KD, H] and gate_up_proj [2I, H]
+    (reference models/phi3.py attention path); split for our layout."""
+    p = f"model.layers.{i}."
+    qkv = get(p + "self_attn.qkv_proj.weight")
+    QD, KD = config.q_dim, config.kv_dim
+    gate_up = get(p + "mlp.gate_up_proj.weight")
+    I = gate_up.shape[0] // 2
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wq": qkv[:QD],
+        "wk": qkv[QD:QD + KD],
+        "wv": qkv[QD + KD:],
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "w_gate": gate_up[:I],
+        "w_up": gate_up[I:],
+        "w_down": get(p + "mlp.down_proj.weight"),
+    }
+
+
+def _baichuan_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """baichuan W_pack [3*H, H] fused qkv (reference models/baichuan.py
+    pre-optimization splits it the same way)."""
+    p = f"model.layers.{i}."
+    pack = get(p + "self_attn.W_pack.weight")
+    H = config.hidden_size
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wq": pack[:H],
+        "wk": pack[H:2 * H],
+        "wv": pack[2 * H:],
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "w_gate": get(p + "mlp.gate_proj.weight"),
+        "w_up": get(p + "mlp.up_proj.weight"),
+        "w_down": get(p + "mlp.down_proj.weight"),
+    }
+
+
+def _baichuan_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    out = {
+        "embed": get("model.embed_tokens.weight"),
+        "final_norm": get("model.norm.weight"),
     }
     if not config.tie_word_embeddings:
-        mapping["lm_head"] = ["lm_head.weight"]
-    for ours, suffix in _LAYER_MAP.items():
-        if ours.startswith("b") and not config.attention_bias:
-            continue
-        mapping[f"layers.{ours}"] = [
-            f"model.layers.{i}.{suffix}" for i in range(L)
-        ]
-    return mapping
+        # NormHead: lm-head rows are L2-normalized at inference; the
+        # reference converts NormHead→Linear with normalized weights
+        # (convert.py:886 _optimize_pre); we bake it in at ingest.
+        w = get("lm_head.weight").astype(np.float32)
+        norms = np.linalg.norm(w, axis=1, keepdims=True)
+        out["lm_head"] = w / np.maximum(norms, 1e-12)
+    return out
+
+
+def _internlm2_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """internlm2 grouped wqkv [(Hkv*(g+2))*D, H]: per kv group g q-heads
+    then one k and one v head."""
+    p = f"model.layers.{i}."
+    D = config.head_dim_
+    Hkv = config.num_key_value_heads
+    g = config.num_attention_heads // Hkv
+    wqkv = get(p + "attention.wqkv.weight")
+    H = wqkv.shape[-1]
+    grouped = wqkv.reshape(Hkv, g + 2, D, H)
+    return {
+        "attn_norm": get(p + "attention_norm.weight"),
+        "mlp_norm": get(p + "ffn_norm.weight"),
+        "wq": grouped[:, :g].reshape(Hkv * g * D, H),
+        "wk": grouped[:, g].reshape(Hkv * D, H),
+        "wv": grouped[:, g + 1].reshape(Hkv * D, H),
+        "wo": get(p + "attention.wo.weight"),
+        "w_gate": get(p + "feed_forward.w1.weight"),
+        "w_up": get(p + "feed_forward.w3.weight"),
+        "w_down": get(p + "feed_forward.w2.weight"),
+    }
+
+
+def _internlm2_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    out = {
+        "embed": get("model.tok_embeddings.weight"),
+        "final_norm": get("model.norm.weight"),
+    }
+    if not config.tie_word_embeddings:
+        out["lm_head"] = get("output.weight")
+    return out
+
+
+def _starcoder2_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    p = f"model.layers.{i}."
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "attn_norm_b": get(p + "input_layernorm.bias"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "mlp_norm_b": get(p + "post_attention_layernorm.bias"),
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "bq": get(p + "self_attn.q_proj.bias"),
+        "bk": get(p + "self_attn.k_proj.bias"),
+        "bv": get(p + "self_attn.v_proj.bias"),
+        "bo": get(p + "self_attn.o_proj.bias"),
+        "w_up": get(p + "mlp.c_fc.weight"),
+        "b_up": get(p + "mlp.c_fc.bias"),
+        "w_down": get(p + "mlp.c_proj.weight"),
+        "b_down": get(p + "mlp.c_proj.bias"),
+    }
+
+
+def _glm_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """HF 'glm' (glm-4 family): separate q/k/v with bias, fused gate_up."""
+    p = f"model.layers.{i}."
+    gate_up = get(p + "mlp.gate_up_proj.weight")
+    I = gate_up.shape[0] // 2
+    out = {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "w_gate": gate_up[:I],
+        "w_up": gate_up[I:],
+        "w_down": get(p + "mlp.down_proj.weight"),
+    }
+    if config.attention_bias:
+        out["bq"] = get(p + "self_attn.q_proj.bias")
+        out["bk"] = get(p + "self_attn.k_proj.bias")
+        out["bv"] = get(p + "self_attn.v_proj.bias")
+    return out
+
+
+def _mixtral_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    p = f"model.layers.{i}."
+    E = config.num_experts
+    out = {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "router": get(p + "block_sparse_moe.gate.weight"),
+        "w_gate_e": np.stack(
+            [get(p + f"block_sparse_moe.experts.{e}.w1.weight") for e in range(E)]
+        ),
+        "w_up_e": np.stack(
+            [get(p + f"block_sparse_moe.experts.{e}.w3.weight") for e in range(E)]
+        ),
+        "w_down_e": np.stack(
+            [get(p + f"block_sparse_moe.experts.{e}.w2.weight") for e in range(E)]
+        ),
+    }
+    return out
+
+
+def _qwen2_moe_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    p = f"model.layers.{i}."
+    E = config.num_experts
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "bq": get(p + "self_attn.q_proj.bias"),
+        "bk": get(p + "self_attn.k_proj.bias"),
+        "bv": get(p + "self_attn.v_proj.bias"),
+        "router": get(p + "mlp.gate.weight"),
+        "w_gate_e": np.stack(
+            [get(p + f"mlp.experts.{e}.gate_proj.weight") for e in range(E)]
+        ),
+        "w_up_e": np.stack(
+            [get(p + f"mlp.experts.{e}.up_proj.weight") for e in range(E)]
+        ),
+        "w_down_e": np.stack(
+            [get(p + f"mlp.experts.{e}.down_proj.weight") for e in range(E)]
+        ),
+        "w_gate_s": get(p + "mlp.shared_expert.gate_proj.weight"),
+        "w_up_s": get(p + "mlp.shared_expert.up_proj.weight"),
+        "w_down_s": get(p + "mlp.shared_expert.down_proj.weight"),
+        "shared_gate": get(p + "mlp.shared_expert_gate.weight"),
+    }
+
+
+_FAMILY_LAYER = {
+    "gemma2": _gemma2_layer,
+    "phi3": _phi3_layer,
+    "baichuan": _baichuan_layer,
+    "internlm2": _internlm2_layer,
+    "starcoder2": _starcoder2_layer,
+    "glm": _glm_layer,
+    "mixtral": _mixtral_layer,
+    "qwen2_moe": _qwen2_moe_layer,
+}
+
+_FAMILY_TOP = {
+    "baichuan": _baichuan_top,
+    "internlm2": _internlm2_top,
+}
+
+
+def layer_tensors(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    fn = _FAMILY_LAYER.get(config.model_type, _llama_layer)
+    return fn(config, i, get)
+
+
+def top_tensors(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    fn = _FAMILY_TOP.get(config.model_type, _llama_top)
+    return fn(config, get)
+
+
+# ---------------------------------------------------------------------------
+# tree assembly
+# ---------------------------------------------------------------------------
+
+def _stack_qtensors(qs: list[QTensor]) -> QTensor:
+    return QTensor(
+        data=jnp.stack([q.data for q in qs]),
+        scales=jnp.stack([q.scales for q in qs]),
+        mins=(
+            jnp.stack([q.mins for q in qs]) if qs[0].mins is not None else None
+        ),
+        qtype=qs[0].qtype,
+    )
 
 
 def params_from_state_dict(
     config: ModelConfig,
-    get_tensor: Callable[[str], np.ndarray],
+    get_tensor: Get,
     qtype: str = "sym_int4",
     dtype=jnp.bfloat16,
 ) -> dict:
@@ -72,45 +340,32 @@ def params_from_state_dict(
 
     `get_tensor` returns a numpy array for an HF tensor name (backed by a
     dict for tests, or by lazy safetensors shards for real checkpoints).
+    Weights are quantized layer by layer as they stream in, then stacked
+    along the leading (scan) axis.
     """
     spec = resolve_qtype(qtype)
-    params: dict = {"layers": {}}
 
-    def put(path: str, value):
-        parts = path.split(".")
-        node = params
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = value
+    def maybe_quant(name: str, arr: np.ndarray):
+        if (not spec.is_dense) and (name in _QUANT_TARGETS or name == "lm_head"):
+            return quantize(jnp.asarray(arr, jnp.float32), spec.name)
+        return jnp.asarray(arr).astype(dtype)
 
-    for path, names in state_dict_mapping(config).items():
-        leaf = path.split(".")[-1]
-        quantize_it = (not spec.is_dense) and (
-            leaf in _QUANT_TARGETS or path == "lm_head"
-        )
-        per_layer = []
-        for name in names:
-            arr = np.asarray(get_tensor(name))
-            if quantize_it:
-                per_layer.append(quantize(jnp.asarray(arr, jnp.float32), spec.name))
-            else:
-                per_layer.append(jnp.asarray(arr).astype(dtype))
-        if len(per_layer) == 1:
-            put(path, per_layer[0])
-        elif isinstance(per_layer[0], QTensor):
-            stacked = QTensor(
-                data=jnp.stack([q.data for q in per_layer]),
-                scales=jnp.stack([q.scales for q in per_layer]),
-                mins=(
-                    jnp.stack([q.mins for q in per_layer])
-                    if per_layer[0].mins is not None
-                    else None
-                ),
-                qtype=per_layer[0].qtype,
-            )
-            put(path, stacked)
+    # per-layer dicts -> stacked leaves
+    per_layer: list[dict] = []
+    for i in range(config.num_hidden_layers):
+        tensors = layer_tensors(config, i, get_tensor)
+        per_layer.append({k: maybe_quant(k, v) for k, v in tensors.items()})
+    layers = {}
+    for k in per_layer[0]:
+        vals = [d[k] for d in per_layer]
+        if isinstance(vals[0], QTensor):
+            layers[k] = _stack_qtensors(vals)
         else:
-            put(path, jnp.stack(per_layer))
+            layers[k] = jnp.stack(vals)
+
+    params: dict = {"layers": layers}
+    for k, v in top_tensors(config, get_tensor).items():
+        params[k] = maybe_quant(k, v)
     return params
 
 
@@ -125,9 +380,10 @@ def load_hf_checkpoint(
     import torch  # lazy: only the ingest path touches torch
     from safetensors import safe_open  # lazy: heavy import
 
+    with open(os.path.join(model_path, "config.json")) as f:
+        hf_config = json.load(f)
     if config is None:
-        with open(os.path.join(model_path, "config.json")) as f:
-            config = ModelConfig.from_hf_config(json.load(f))
+        config = ModelConfig.from_hf_config(hf_config)
 
     index_path = os.path.join(model_path, "model.safetensors.index.json")
     if os.path.exists(index_path):

@@ -174,8 +174,9 @@ def lookup_generate(
         temperature=temperature, top_k=top_k, top_p=top_p,
         eos_token_id=eos_token_id, pad_token_id=pad_token_id,
     )
-    need = tokens.shape[1] + max_new_tokens + lookahead + 1
-    cache_len = ((need + 63) // 64) * 64
+    from bigdl_tpu.utils import cache_len_for
+
+    cache_len = cache_len_for(tokens.shape[1], max_new_tokens + lookahead + 1)
     out = lookup_tokens(
         config, params, jnp.asarray(tokens), jnp.asarray(start),
         jax.random.PRNGKey(seed), gen, model_forward, cache_len=cache_len,

@@ -195,8 +195,9 @@ def speculative_generate(
         temperature=temperature, top_k=top_k, top_p=top_p,
         eos_token_id=eos_token_id, pad_token_id=pad_token_id,
     )
-    need = tokens.shape[1] + max_new_tokens + draft_k + 1
-    cache_len = ((need + 63) // 64) * 64
+    from bigdl_tpu.utils import cache_len_for
+
+    cache_len = cache_len_for(tokens.shape[1], max_new_tokens + draft_k + 1)
     out, _ = speculative_tokens(
         config, target_params, draft_params,
         jnp.asarray(tokens), jnp.asarray(start), jax.random.PRNGKey(seed),

@@ -84,7 +84,10 @@ def pad_prompts(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "gen", "model_forward", "cache_len", "quantize_kv"),
+    static_argnames=(
+        "config", "gen", "model_forward", "cache_len", "quantize_kv",
+        "compress_budget", "compress_window", "compress_kernel",
+    ),
     donate_argnames=(),
 )
 def generate_tokens(
@@ -97,11 +100,21 @@ def generate_tokens(
     model_forward,  # static: the family forward fn (models.llama.forward)
     cache_len: int,
     quantize_kv: bool = False,
+    compress_budget: int = 0,  # SnapKV: compress prompt KV to this many slots
+    compress_window: int = 32,
+    compress_kernel: int = 7,
 ) -> jax.Array:
     """One compiled program: prefill + full decode loop.
 
+    With compress_budget > 0 the prompt KV is SnapKV-compressed after
+    prefill (reference DynamicCompressCache, kv.py:246-375) and the decode
+    loop runs on the compact cache — less HBM traffic per token and a
+    cache whose size is independent of prompt length.
+
     Returns [B, max_new_tokens] generated ids (pad_token_id after EOS).
     """
+    from bigdl_tpu.utils import cache_len_for
+
     B, T = tokens.shape
     assert cache_len >= T + gen.max_new_tokens
     cache = kvcache.init_cache(
@@ -110,7 +123,19 @@ def generate_tokens(
     )
     cache = dataclasses.replace(cache, start=start)
 
-    logits, cache = model_forward(config, params, tokens, cache, mode="prefill")
+    if compress_budget:
+        assert compress_budget > compress_window
+        logits, cache, obs = model_forward(
+            config, params, tokens, cache, mode="prefill",
+            collect_obs=compress_window,
+        )
+        out_len = cache_len_for(compress_budget, gen.max_new_tokens)
+        cache = kvcache.compress(
+            cache, obs, compress_budget, out_len,
+            window=compress_window, kernel=compress_kernel,
+        )
+    else:
+        logits, cache = model_forward(config, params, tokens, cache, mode="prefill")
     key, k0 = jax.random.split(key)
     first = sample_token(logits[:, -1], k0, gen)
 

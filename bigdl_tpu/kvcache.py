@@ -2,12 +2,13 @@
 
 TPU-native re-design of the reference's cache classes
 (transformers/kv.py: `DynamicNormalCache` block-preallocated cache,
-`DynamicFp8Cache` FP8-quantized cache, `DynamicCompressCache` SnapKV
-compression). Under XLA everything is static-shape: the cache is
-preallocated at `max_len` (the reference's KV_CACHE_ALLOC_BLOCK_LENGTH
-growth policy becomes bucketed prefill lengths + a fixed decode budget),
-lives in the jit carry, and is updated with `lax.dynamic_update_slice`
-which XLA performs in place when the buffer is donated.
+`DynamicFp8Cache` FP8-quantized cache, `DynamicCompressCache` /
+`DynamicCompressFp8Cache` SnapKV compression). Under XLA everything is
+static-shape: the cache is preallocated at `max_len` (the reference's
+KV_CACHE_ALLOC_BLOCK_LENGTH growth policy becomes bucketed prefill
+lengths + a fixed decode budget), lives in the jit carry, and is updated
+with `lax.dynamic_update_slice` which XLA performs in place when the
+buffer is donated.
 
 Batch rows are **left-padded**: `start[b]` marks the first valid slot so
 attention masks and rotary positions are exact per row.
@@ -16,6 +17,13 @@ FP8 mode stores k/v as float8_e5m2 with one float16 scale per (token,
 head) vector — the same granularity as the reference's
 `xe_addons.quantize_key_value` (kv.py:32-77) — halving cache HBM and
 doubling effective context length.
+
+SnapKV compression (`compress`, reference kv.py:171-245): after prefill,
+the last `window` queries score every earlier key; scores are
+average-pooled and the top `budget - window` slots per kv head are kept
+(plus the observation window), producing a compact cache for decode.
+Because keys are stored rotated, compressed slots no longer equal rope
+positions — `rope_base` carries each row's true next rope position.
 """
 
 from __future__ import annotations
@@ -27,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 _FP8_MAX = 57344.0  # float8_e5m2 finite max
+_NEG_INF = -1e30
 
 
 @jax.tree_util.register_dataclass
@@ -38,6 +47,10 @@ class KVCache:
     v_scale: Optional[jax.Array]
     pos: jax.Array  # scalar int32: next write slot (shared across batch)
     start: jax.Array  # [B] int32: first valid slot per row (left padding)
+    # [B] int32 rope position of the token written at slot `pos`, when it
+    # differs from (pos - start) — i.e. after SnapKV compression. None =
+    # derived (pos - start).
+    rope_base: Optional[jax.Array] = None
 
     @property
     def max_len(self) -> int:
@@ -46,6 +59,18 @@ class KVCache:
     @property
     def quantized(self) -> bool:
         return self.k_scale is not None
+
+    def next_positions(self, t: int) -> jax.Array:
+        """[B, T] rope positions for the next t tokens.
+
+        Derived case: position of slot s is max(s - start, 0) — the clamp
+        must apply per slot (not to a per-row base) so that left-padded
+        prefill rows get positions 0..len-1 for their real tokens and the
+        later decode positions continue them exactly."""
+        step = jnp.arange(t, dtype=jnp.int32)[None, :]
+        if self.rope_base is not None:
+            return self.rope_base[:, None] + step
+        return jnp.maximum(self.pos + step - self.start[:, None], 0)
 
 
 def init_cache(
@@ -124,4 +149,130 @@ def read_layer(
 
 
 def advance(cache: KVCache, n: int) -> KVCache:
-    return dataclasses.replace(cache, pos=cache.pos + n)
+    rope_base = cache.rope_base
+    if rope_base is not None:
+        rope_base = rope_base + n
+    return dataclasses.replace(cache, pos=cache.pos + n, rope_base=rope_base)
+
+
+# ---------------------------------------------------------------------------
+# SnapKV-style compression (reference kv.py:171-375)
+# ---------------------------------------------------------------------------
+
+def _avg_pool_1d(x: jax.Array, kernel: int) -> jax.Array:
+    """Mean pool with 'same' padding over the last axis (SnapKV smoothing;
+    the reference uses F.avg_pool1d on the summed score vector)."""
+    if kernel <= 1:
+        return x
+    pad = kernel // 2
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1,) * (x.ndim - 1) + (kernel,),
+        (1,) * x.ndim, [(0, 0)] * (x.ndim - 1) + [(pad, kernel - 1 - pad)],
+    )
+    return summed / kernel
+
+
+def compress(
+    cache: KVCache,
+    q_obs: jax.Array,  # [L, B, W, Hq, D]: last-window queries per layer
+    budget: int,
+    out_len: int,
+    window: int = 32,
+    kernel: int = 7,
+) -> KVCache:
+    """SnapKV: keep, per kv head, the `budget - window` highest-attention
+    prefix slots plus the `window` observation slots; write them compacted
+    into a fresh cache of length `out_len` (budget + decode headroom).
+
+    Equivalent of the reference's `compress_kv` (kv.py:171-245): softmax
+    scores of the observation-window queries over the prefix, summed over
+    the window and the query group, average-pooled, top-k per kv head.
+    Selection is per kv head (head h's kept slots differ from head h'),
+    which is fine because attention reads heads independently; the
+    per-row validity boundary `start` is head-independent.
+
+    Returns a cache with pos=budget, start = budget - kept(b), and
+    rope_base = the row's true next rope position (slot indices no longer
+    encode positions).
+    """
+    L, B, S, Hkv, D = cache.k.shape
+    W = q_obs.shape[2]
+    Hq = q_obs.shape[3]
+    G = Hq // Hkv
+    keep_k = budget - W
+    assert keep_k > 0, "budget must exceed the observation window"
+
+    P = cache.pos  # prompt end (next slot)
+    start = cache.start
+    scale = 1.0 / (D ** 0.5)
+
+    # deq keys once per layer: [L,B,S,Hkv,D]
+    if cache.quantized:
+        kf = cache.k.astype(jnp.float32) * cache.k_scale.astype(jnp.float32)[..., None]
+    else:
+        kf = cache.k.astype(jnp.float32)
+
+    qg = q_obs.astype(jnp.float32).reshape(L, B, W, Hkv, G, D)
+    scores = jnp.einsum("lbwhgd,lbshd->lbhgws", qg, kf) * scale
+
+    sj = jnp.arange(S)
+    obs_start = P - W
+    # prefix slots only: valid rows of the prompt, before the obs window
+    prefix = (sj[None, :] >= start[:, None]) & (sj[None, :] < obs_start)  # [B,S]
+    # causal within the obs window is irrelevant: all prefix slots precede
+    # every obs query.
+    scores = jnp.where(prefix[None, :, None, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # zero fully-masked (softmax of all -inf ~ uniform garbage): re-mask
+    probs = jnp.where(prefix[None, :, None, None, None, :], probs, 0.0)
+    vote = probs.sum(axis=(3, 4))  # [L,B,Hkv,S] summed over group+window
+    vote = _avg_pool_1d(vote, kernel)
+    vote = jnp.where(prefix[None, :, None, :], vote, _NEG_INF)
+
+    _, idx = jax.lax.top_k(vote, keep_k)  # [L,B,Hkv,keep_k]
+    valid_sel = jnp.take_along_axis(
+        jnp.broadcast_to(prefix[None, :, None, :], vote.shape), idx, axis=-1
+    )
+    # temporal order with invalid slots pushed left (they land in the pad
+    # region delimited by the new start)
+    order_key = jnp.where(valid_sel, idx, -1)
+    perm = jnp.argsort(order_key, axis=-1)
+    idx = jnp.take_along_axis(idx, perm, axis=-1)
+
+    def gather_sel(x):  # x [L,B,S,Hkv,*feat]
+        xt = jnp.moveaxis(x, 3, 2)  # [L,B,Hkv,S,*]
+        expand = idx.reshape(idx.shape + (1,) * (xt.ndim - 4))
+        sel = jnp.take_along_axis(xt, jnp.broadcast_to(expand, idx.shape + xt.shape[4:]), axis=3)
+        return jnp.moveaxis(sel, 2, 3)  # [L,B,keep_k,Hkv,*]
+
+    def gather_obs(x):  # last W slots before P
+        return jax.lax.dynamic_slice_in_dim(x, obs_start, W, axis=2)
+
+    def compact(x):
+        sel = gather_sel(x)
+        obs = gather_obs(x)
+        merged = jnp.concatenate([sel, obs], axis=2)  # [L,B,budget,Hkv,*]
+        pad = [(0, 0)] * x.ndim
+        pad[2] = (0, out_len - budget)
+        return jnp.pad(merged, pad)
+
+    new_k = compact(cache.k)
+    new_v = compact(cache.v)
+    new_ks = compact(cache.k_scale) if cache.quantized else None
+    new_vs = compact(cache.v_scale) if cache.quantized else None
+
+    avail = jnp.maximum(obs_start - start, 0)  # prefix tokens per row
+    kept = jnp.minimum(avail, keep_k)
+    # invalid selected slots are left-packed; rows shorter than the obs
+    # window additionally carry pad slots at the FRONT of the obs region
+    # (pads are the leftmost slots), so they extend the same contiguous
+    # invalid region past keep_k.
+    pad_in_obs = jnp.maximum(start - obs_start, 0)
+    new_start = (keep_k - kept + pad_in_obs).astype(jnp.int32)
+    rope_base = jnp.maximum(P - start, 0).astype(jnp.int32)  # next position
+
+    return KVCache(
+        k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs,
+        pos=jnp.asarray(budget, jnp.int32), start=new_start,
+        rope_base=rope_base,
+    )

@@ -12,13 +12,27 @@ from __future__ import annotations
 from bigdl_tpu.models.config import ModelConfig, PRESETS
 from bigdl_tpu.models import llama
 
-# model_type -> module implementing init_params / quantize_params / forward
+# model_type -> module implementing init_params / quantize_params / forward.
+# One decoder-family implementation covers every llama-shaped architecture
+# via ModelConfig flags (bigdl_tpu/models/llama.py docstring lists them).
 _FAMILIES = {
     "llama": llama,
     "mistral": llama,
     "qwen2": llama,
-    # gemma2 intentionally absent until softcap/post-norms/(1+w)-rmsnorm are
-    # implemented — registering it would silently produce wrong logits.
+    "gemma": llama,
+    "gemma2": llama,
+    "phi3": llama,
+    "baichuan": llama,
+    "internlm2": llama,
+    "starcoder2": llama,
+    "stablelm": llama,
+    "minicpm": llama,
+    "glm": llama,
+    # chatglm (THUDM trust_remote_code schema) needs its own config/weights
+    # translator before it can be registered — not silently aliased to glm.
+    "mixtral": llama,
+    "qwen2_moe": llama,
+    "yi": llama,
 }
 
 

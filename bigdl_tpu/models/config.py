@@ -2,10 +2,12 @@
 
 One frozen dataclass covers the decoder-family architectures the
 reference optimizes per-file in `transformers/models/` (llama, mistral,
-qwen2, ...; SURVEY.md §2.2 "Model zoo"): the differences the reference
-encodes as separate patched forwards (qkv bias, tied embeddings, rope
-scaling, sliding window, logit softcap) are config flags here, resolved
-once at trace time — dead branches compile away under jit.
+qwen2, gemma2, phi3, baichuan, starcoder2, stablelm, glm, minicpm, ...;
+SURVEY.md §2.2 "Model zoo"): the differences the reference encodes as
+separate patched forwards (qkv bias, tied embeddings, rope scaling,
+sliding window, logit softcap, partial rotary, pre/post norms, ALiBi,
+MoE routing) are config flags here, resolved once at trace time — dead
+branches compile away under jit.
 """
 
 from __future__ import annotations
@@ -30,15 +32,41 @@ class ModelConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # qwen2-style qkv bias
+    attention_out_bias: bool = False  # starcoder2: o_proj bias too
     mlp_bias: bool = False
     sliding_window: Optional[int] = None  # mistral-style local attention
+    # gemma2/gemma3: layer l uses sliding attention iff (l+1) % pattern != 0
+    # (None = every layer sliding when sliding_window is set, like mistral)
+    sliding_window_pattern: Optional[int] = None
     attn_logit_softcap: Optional[float] = None  # gemma2
     final_logit_softcap: Optional[float] = None  # gemma2
+    # attention scale override (gemma2 query_pre_attn_scalar**-0.5); None =
+    # 1/sqrt(head_dim)
+    attn_scale: Optional[float] = None
     hidden_act: str = "silu"
-    # gemma-style normalizations
-    scale_embeddings: bool = False  # multiply embed output by sqrt(hidden)
-    post_attn_norm: bool = False  # gemma2 extra norms around blocks
+    gated_mlp: bool = True  # False: plain fc->act->proj (starcoder2, gpt2)
+    # normalization
+    norm_type: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    norm_bias: bool = False  # layernorm bias (starcoder2, stablelm)
     rms_norm_offset: bool = False  # gemma (1+w) rmsnorm weights
+    post_attn_norm: bool = False  # gemma2 extra norms after attn/mlp blocks
+    qk_norm: bool = False  # per-head RMSNorm on q/k (qwen3-style)
+    # gemma-style embedding scale
+    scale_embeddings: bool = False  # multiply embed output by sqrt(hidden)
+    embedding_scale: Optional[float] = None  # minicpm scale_emb multiplier
+    # minicpm residual scaling: hidden += scale_depth/sqrt(L) * block_out
+    residual_scale: Optional[float] = None
+    logit_scale: Optional[float] = None  # minicpm/cohere: logits *= scale
+    # positions
+    partial_rotary_factor: float = 1.0  # stablelm 0.25, glm 0.5
+    rope_interleaved: bool = False  # GPT-NeoX/GLM pair-interleaved rope
+    alibi: bool = False  # baichuan-13b/bloom attention-bias positions
+    # MoE (mixtral / qwen2_moe); 0 experts = dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: Optional[int] = None
+    shared_expert_intermediate_size: Optional[int] = None  # qwen2_moe
+    norm_topk_prob: bool = False  # renormalize top-k router weights
 
     def __post_init__(self):
         # ModelConfig is a static jit argument and must hash; rope_scaling
@@ -46,9 +74,9 @@ class ModelConfig:
         # JSON round-trip through save_low_bit) — normalize to a tuple.
         rs = self.rope_scaling
         if isinstance(rs, dict):
-            rs = tuple(sorted(rs.items()))
+            rs = tuple(sorted((k, _hashable(v)) for k, v in rs.items()))
         elif isinstance(rs, (list, tuple)):
-            rs = tuple(tuple(kv) for kv in rs)
+            rs = tuple((k, _hashable(v)) for k, v in rs)
         object.__setattr__(self, "rope_scaling", rs)
 
     @property
@@ -67,6 +95,24 @@ class ModelConfig:
     def kv_dim(self) -> int:
         return self.num_key_value_heads * self.head_dim_
 
+    @property
+    def rotary_dim(self) -> int:
+        # keep even (rope rotates dim/2 pairs)
+        r = int(self.head_dim_ * self.partial_rotary_factor)
+        return r - (r % 2)
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def layer_is_sliding(self, layer_idx: int) -> bool:
+        """Static per-layer attention kind (gemma2 alternation)."""
+        if self.sliding_window is None:
+            return False
+        if self.sliding_window_pattern is None:
+            return True
+        return (layer_idx + 1) % self.sliding_window_pattern != 0
+
     @classmethod
     def from_hf_config(cls, hf: dict[str, Any]) -> "ModelConfig":
         """Build from a HuggingFace config.json dict (the ingest path the
@@ -78,24 +124,140 @@ class ModelConfig:
             "head_dim", "rms_norm_eps", "rope_theta", "rope_scaling",
             "max_position_embeddings", "tie_word_embeddings", "sliding_window",
             "hidden_act", "attention_bias", "mlp_bias",
+            "partial_rotary_factor",
         }
         kwargs = {k: hf[k] for k in known if k in hf and hf[k] is not None}
         kwargs["model_type"] = model_type
-        if model_type == "qwen2":
-            # qwen2 has qkv bias but no o/mlp bias; HF config lacks the flag
-            kwargs.setdefault("attention_bias", True)
+        builder = _HF_BUILDERS.get(model_type)
+        if builder is not None:
+            builder(hf, kwargs)
         if "num_key_value_heads" not in kwargs:
             kwargs["num_key_value_heads"] = kwargs.get(
                 "num_attention_heads", cls.num_attention_heads
             )
-        if model_type == "gemma2":
-            kwargs["attn_logit_softcap"] = hf.get("attn_logit_softcapping", 50.0)
-            kwargs["final_logit_softcap"] = hf.get("final_logit_softcapping", 30.0)
-            kwargs["scale_embeddings"] = True
-            kwargs["post_attn_norm"] = True
-            kwargs["rms_norm_offset"] = True
-            kwargs.setdefault("tie_word_embeddings", True)
         return cls(**kwargs)
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(v)
+    return v
+
+
+# --- per-model_type config translation -------------------------------------
+# The reference's per-arch knowledge lives in ~70 `model_type` branches of
+# `_optimize_post` (convert.py:1251-2027); here it is a table of small
+# config builders (weights-side counterparts live in bigdl_tpu/convert/hf.py).
+
+def _hf_qwen2(hf, kw):
+    # qwen2 has qkv bias but no o/mlp bias; HF config lacks the flag
+    kw.setdefault("attention_bias", True)
+
+
+def _hf_gemma(hf, kw):
+    kw["scale_embeddings"] = True
+    kw["rms_norm_offset"] = True
+    kw.setdefault("tie_word_embeddings", True)
+    kw.setdefault("hidden_act", hf.get("hidden_activation", "gelu_pytorch_tanh"))
+
+
+def _hf_gemma2(hf, kw):
+    _hf_gemma(hf, kw)
+    kw["attn_logit_softcap"] = hf.get("attn_logit_softcapping", 50.0)
+    kw["final_logit_softcap"] = hf.get("final_logit_softcapping", 30.0)
+    kw["post_attn_norm"] = True
+    kw["sliding_window_pattern"] = 2
+    if "query_pre_attn_scalar" in hf:
+        kw["attn_scale"] = hf["query_pre_attn_scalar"] ** -0.5
+
+
+def _hf_phi3(hf, kw):
+    # phi3 ships fused qkv/gate_up; split at ingest (convert/hf.py)
+    kw.setdefault("tie_word_embeddings", hf.get("tie_word_embeddings", False))
+
+
+def _hf_stablelm(hf, kw):
+    kw["norm_type"] = "layernorm"
+    kw["norm_bias"] = True
+    kw["attention_bias"] = hf.get("use_qkv_bias", False)
+    kw.setdefault("partial_rotary_factor", hf.get("partial_rotary_factor", 0.25))
+    kw["rms_norm_eps"] = hf.get("layer_norm_eps", 1e-5)
+
+
+def _hf_starcoder2(hf, kw):
+    kw["norm_type"] = "layernorm"
+    kw["norm_bias"] = True
+    kw["attention_bias"] = hf.get("use_bias", True)
+    kw["attention_out_bias"] = hf.get("use_bias", True)
+    kw["mlp_bias"] = hf.get("use_bias", True)
+    kw["gated_mlp"] = False
+    kw["rms_norm_eps"] = hf.get("norm_epsilon", 1e-5)
+    kw.setdefault("tie_word_embeddings", hf.get("tie_word_embeddings", True))
+
+
+def _hf_baichuan(hf, kw):
+    # 7B is rope llama-shaped; 13B (no rope, 40 heads, alibi) detected by
+    # position embeddings absence → model_max_length + alibi
+    if hf.get("num_attention_heads", 32) >= 40 and "rope_theta" not in hf:
+        kw["alibi"] = True
+    kw.setdefault(
+        "max_position_embeddings",
+        hf.get("model_max_length", hf.get("max_position_embeddings", 4096)),
+    )
+
+
+def _hf_internlm2(hf, kw):
+    kw.setdefault("attention_bias", hf.get("bias", False))
+
+
+def _hf_minicpm(hf, kw):
+    L = kw.get("num_hidden_layers", 32)
+    kw["residual_scale"] = hf.get("scale_depth", 1.0) / (L ** 0.5)
+    # runtime multiplier, NOT folded into weights: with tied embeddings the
+    # lm head shares the matrix and must stay unscaled
+    kw["embedding_scale"] = hf.get("scale_emb", 1.0)
+    if "dim_model_base" in hf and hf.get("hidden_size"):
+        kw["logit_scale"] = 1.0 / (hf["hidden_size"] / hf["dim_model_base"])
+
+
+def _hf_glm(hf, kw):
+    kw.setdefault("partial_rotary_factor", hf.get("partial_rotary_factor", 0.5))
+    kw["rope_interleaved"] = True
+    kw["attention_bias"] = hf.get("attention_bias", True)
+    kw.setdefault("head_dim", hf.get("head_dim"))
+
+
+def _hf_mixtral(hf, kw):
+    kw["num_experts"] = hf.get("num_local_experts", 8)
+    kw["num_experts_per_tok"] = hf.get("num_experts_per_tok", 2)
+    kw["norm_topk_prob"] = True
+
+
+def _hf_qwen2_moe(hf, kw):
+    kw.setdefault("attention_bias", True)
+    kw["num_experts"] = hf.get("num_experts", 60)
+    kw["num_experts_per_tok"] = hf.get("num_experts_per_tok", 4)
+    kw["moe_intermediate_size"] = hf.get("moe_intermediate_size", 1408)
+    kw["shared_expert_intermediate_size"] = hf.get(
+        "shared_expert_intermediate_size", 5632
+    )
+    kw["norm_topk_prob"] = hf.get("norm_topk_prob", False)
+
+
+_HF_BUILDERS = {
+    "qwen2": _hf_qwen2,
+    "gemma": _hf_gemma,
+    "gemma2": _hf_gemma2,
+    "phi3": _hf_phi3,
+    "stablelm": _hf_stablelm,
+    "starcoder2": _hf_starcoder2,
+    "baichuan": _hf_baichuan,
+    "internlm2": _hf_internlm2,
+    "minicpm": _hf_minicpm,
+    "glm": _hf_glm,
+    "mixtral": _hf_mixtral,
+    "qwen2_moe": _hf_qwen2_moe,
+}
 
 
 # Canonical shapes for tests and benchmarks (no checkpoints needed).
@@ -125,5 +287,28 @@ PRESETS: dict[str, ModelConfig] = {
         intermediate_size=18944, num_hidden_layers=28,
         num_attention_heads=28, num_key_value_heads=4,
         attention_bias=True, rope_theta=1000000.0,
+    ),
+    "gemma2-9b": ModelConfig(
+        model_type="gemma2", vocab_size=256000, hidden_size=3584,
+        intermediate_size=14336, num_hidden_layers=42,
+        num_attention_heads=16, num_key_value_heads=8, head_dim=256,
+        scale_embeddings=True, rms_norm_offset=True, post_attn_norm=True,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        sliding_window=4096, sliding_window_pattern=2,
+        attn_scale=224.0 ** -0.5, tie_word_embeddings=True,
+        hidden_act="gelu_pytorch_tanh",
+    ),
+    "phi3-mini": ModelConfig(
+        model_type="phi3", vocab_size=32064, hidden_size=3072,
+        intermediate_size=8192, num_hidden_layers=32,
+        num_attention_heads=32, num_key_value_heads=32,
+        max_position_embeddings=4096,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        model_type="mixtral", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_hidden_layers=32,
+        num_attention_heads=32, num_key_value_heads=8,
+        rope_theta=1000000.0, num_experts=8, num_experts_per_tok=2,
+        norm_topk_prob=True,
     ),
 }

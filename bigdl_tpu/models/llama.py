@@ -1,20 +1,26 @@
-"""LLaMA-family decoder (covers llama/llama2/llama3, mistral, qwen2, ...).
+"""Decoder-family model (llama/llama2/llama3, mistral, qwen2, gemma/gemma2,
+phi3, baichuan2, starcoder2, stablelm, internlm2, minicpm, glm, and the MoE
+variants mixtral/qwen2-moe).
 
 TPU-native re-design of the reference's patched forwards
-(`models/llama.py:56-200`, `models/mistral.py`, `models/qwen2.py` in
-/root/reference): instead of monkey-patching HF modules, the model is a
-pure function over a parameter pytree whose linear-layer leaves may be
-`QTensor` (packed low-bit). Layers are **stacked along a leading axis and
-iterated with `lax.scan`**, which keeps compile time O(1) in depth and
-gives the pipeline axis a natural sharding target.
+(`models/llama.py:56-200`, `models/mistral.py`, `models/qwen2.py`,
+`models/gemma2.py`, `models/phi3.py`, `models/baichuan.py`,
+`models/starcoder2.py`, `models/stablelm.py`, `models/mixtral.py`,
+`models/qwen2_moe.py` in /root/reference): instead of monkey-patching HF
+modules per architecture, one pure function over a parameter pytree reads
+architecture differences from `ModelConfig` flags; dead branches compile
+away under jit. Linear-layer leaves may be `QTensor` (packed low-bit).
+Layers are **stacked along a leading axis and iterated with `lax.scan`**,
+which keeps compile time O(1) in depth and gives the pipeline axis a
+natural sharding target.
 
 With a cache, attention always runs over the full cache [0, max_len)
 under a validity mask derived from (start, pos) — so multi-chunk prefill
 and decode share one code path and chunked prefill sees earlier chunks.
 The `mode` argument only labels the jit specialization (prefill T>1 vs
 decode T=1), mirroring the reference's prefill/decode kernel split
-(low_bit_linear.py:606-716); a Pallas flash-attention prefill fast path
-will key off it.
+(low_bit_linear.py:606-716); the Pallas flash-attention prefill fast path
+keys off it.
 
 Batch rows are left-padded (see bigdl_tpu/kvcache.py).
 """
@@ -30,11 +36,14 @@ from bigdl_tpu import kvcache
 from bigdl_tpu.kvcache import KVCache
 from bigdl_tpu.models.config import ModelConfig
 from bigdl_tpu.ops import apply_rotary_emb, attention, linear, rms_norm, rope_cos_sin
-from bigdl_tpu.ops.rope import make_inv_freq
+from bigdl_tpu.ops.norms import layer_norm
+from bigdl_tpu.ops.rope import alibi_slopes, make_inv_freq_scaled
 from bigdl_tpu.quant import QTensor, quantize
 from bigdl_tpu.quant.qtypes import resolve_qtype
 
 Params = dict[str, Any]
+
+_NEG_INF = -1e30
 
 
 # ---------------------------------------------------------------------------
@@ -50,45 +59,84 @@ def init_params(
     """Random dense init (tests/benchmarks run without checkpoints)."""
     L, H, I = config.num_hidden_layers, config.hidden_size, config.intermediate_size
     V, QD, KD = config.vocab_size, config.q_dim, config.kv_dim
-    keys = iter(jax.random.split(key, 16))
+    keys = iter(jax.random.split(key, 32))
 
-    def w(k, shape):
-        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+    def w(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
 
     layers = {
         "attn_norm": jnp.ones((L, H), dtype),
         "mlp_norm": jnp.ones((L, H), dtype),
-        "wq": w(next(keys), (L, QD, H)),
-        "wk": w(next(keys), (L, KD, H)),
-        "wv": w(next(keys), (L, KD, H)),
-        "wo": w(next(keys), (L, H, QD)),
-        "w_gate": w(next(keys), (L, I, H)),
-        "w_up": w(next(keys), (L, I, H)),
-        "w_down": w(next(keys), (L, H, I)),
+        "wq": w((L, QD, H)),
+        "wk": w((L, KD, H)),
+        "wv": w((L, KD, H)),
+        "wo": w((L, H, QD)),
     }
+    if config.is_moe:
+        E = config.num_experts
+        EI = config.moe_intermediate_size or I
+        layers["router"] = w((L, E, H))
+        layers["w_gate_e"] = w((L, E, EI, H))
+        layers["w_up_e"] = w((L, E, EI, H))
+        layers["w_down_e"] = w((L, E, H, EI))
+        if config.shared_expert_intermediate_size:
+            S = config.shared_expert_intermediate_size
+            layers["w_gate_s"] = w((L, S, H))
+            layers["w_up_s"] = w((L, S, H))
+            layers["w_down_s"] = w((L, H, S))
+            layers["shared_gate"] = w((L, 1, H))
+    elif config.gated_mlp:
+        layers["w_gate"] = w((L, I, H))
+        layers["w_up"] = w((L, I, H))
+        layers["w_down"] = w((L, H, I))
+    else:
+        layers["w_up"] = w((L, I, H))
+        layers["w_down"] = w((L, H, I))
     if config.attention_bias:
         layers["bq"] = jnp.zeros((L, QD), dtype)
         layers["bk"] = jnp.zeros((L, KD), dtype)
         layers["bv"] = jnp.zeros((L, KD), dtype)
+    if config.attention_out_bias:
+        layers["bo"] = jnp.zeros((L, H), dtype)
+    if config.mlp_bias:
+        if config.gated_mlp:
+            layers["b_gate"] = jnp.zeros((L, I), dtype)
+        layers["b_up"] = jnp.zeros((L, I), dtype)
+        layers["b_down"] = jnp.zeros((L, H), dtype)
+    if config.norm_bias:
+        layers["attn_norm_b"] = jnp.zeros((L, H), dtype)
+        layers["mlp_norm_b"] = jnp.zeros((L, H), dtype)
+    if config.post_attn_norm:
+        layers["post_attn_norm"] = jnp.ones((L, H), dtype)
+        layers["post_mlp_norm"] = jnp.ones((L, H), dtype)
+    if config.qk_norm:
+        D = config.head_dim_
+        layers["q_norm"] = jnp.ones((L, D), dtype)
+        layers["k_norm"] = jnp.ones((L, D), dtype)
     params: Params = {
-        "embed": w(next(keys), (V, H)),
+        "embed": w((V, H)),
         "layers": layers,
         "final_norm": jnp.ones((H,), dtype),
     }
+    if config.norm_bias:
+        params["final_norm_b"] = jnp.zeros((H,), dtype)
     if not config.tie_word_embeddings:
-        params["lm_head"] = w(next(keys), (V, H))
+        params["lm_head"] = w((V, H))
     return params
 
 
-_QUANT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+_QUANT_TARGETS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    "w_gate_e", "w_up_e", "w_down_e", "w_gate_s", "w_up_s", "w_down_s",
+)
 
 
 def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = None) -> Params:
     """Quantize the linear weights of a dense param tree.
 
     Equivalent of `ggml_convert_low_bit` walking modules (convert.py:1077):
-    norms/biases stay dense; the lm head may use a different (higher) qtype,
-    mirroring the reference's mixed-precision lm-head handling
+    norms/biases/router stay dense; the lm head may use a different (higher)
+    qtype, mirroring the reference's mixed-precision lm-head handling
     (convert.py:469-750, IPEX_LLM_LAST_LM_HEAD).
     """
     spec = resolve_qtype(qtype)
@@ -97,8 +145,8 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
     out = dict(params)
     out["layers"] = dict(params["layers"])
     for name in _QUANT_TARGETS:
-        w = params["layers"][name]
-        if isinstance(w, QTensor):  # idempotent: already low-bit
+        w = params["layers"].get(name)
+        if w is None or isinstance(w, QTensor):  # absent or already low-bit
             continue
         out["layers"][name] = quantize(w, spec.name)
     if "lm_head" in params and not isinstance(params["lm_head"], QTensor):
@@ -115,8 +163,10 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
 def _act(name: str, x: jax.Array) -> jax.Array:
     if name == "silu":
         return jax.nn.silu(x)
-    if name in ("gelu", "gelu_pytorch_tanh"):
+    if name in ("gelu", "gelu_new", "gelu_pytorch_tanh", "gelu_tanh"):
         return jax.nn.gelu(x, approximate=True)
+    if name == "relu":
+        return jax.nn.relu(x)
     raise NotImplementedError(f"hidden_act {name}")
 
 
@@ -133,6 +183,61 @@ def _lora_delta(x, pair, scale, compute_dtype):
     return jnp.einsum("...r,or->...o", xa, b.astype(compute_dtype)) * scale
 
 
+def _deq(w, compute_dtype):
+    return w.dequantize(compute_dtype) if isinstance(w, QTensor) else w.astype(compute_dtype)
+
+
+def _moe_mlp(config: ModelConfig, x: jax.Array, p: Params, compute_dtype) -> jax.Array:
+    """Mixture-of-experts MLP (reference models/mixtral.py, qwen2_moe.py +
+    `xe_linear.get_moe_indexes`): top-k routing with softmax weights.
+
+    TPU-dense formulation: every expert computes every token and the
+    router weights (zero for unrouted experts) combine them — all-matmul,
+    no gather/scatter, MXU-friendly and exactly differentiable. Efficient
+    at mixtral scale (E=8, k=2 → 4x active FLOPs on tiny MLP blocks);
+    a capacity-based ragged dispatch is the planned upgrade for E>>k.
+    """
+    B, T, H = x.shape
+    xc = x.astype(compute_dtype)
+    router_logits = jnp.einsum(
+        "bth,eh->bte", xc, p["router"].astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.float32)
+
+    # softmax over all experts, then top-k; mixtral renormalizes the top-k
+    # weights (norm_topk_prob=True via config), qwen2_moe per its flag
+    probs_all = jax.nn.softmax(router_logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs_all, config.num_experts_per_tok)
+    if config.norm_topk_prob:
+        topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-20)
+    # scatter top-k weights back to a dense [B,T,E] combine matrix
+    onehot = jax.nn.one_hot(topi, config.num_experts, dtype=jnp.float32)
+    combine = jnp.einsum("btk,btke->bte", topv, onehot)
+
+    wg = _deq(p["w_gate_e"], compute_dtype)  # [E, I, H]
+    wu = _deq(p["w_up_e"], compute_dtype)
+    wd = _deq(p["w_down_e"], compute_dtype)  # [E, H, I]
+    g = jnp.einsum("bth,eih->btei", xc, wg, preferred_element_type=compute_dtype)
+    u = jnp.einsum("bth,eih->btei", xc, wu, preferred_element_type=compute_dtype)
+    z = _act(config.hidden_act, g) * u
+    d = jnp.einsum("btei,ehi->bteh", z, wd, preferred_element_type=compute_dtype)
+    out = jnp.einsum("bteh,bte->bth", d, combine.astype(compute_dtype))
+
+    if config.shared_expert_intermediate_size:
+        # qwen2_moe shared expert, sigmoid-gated (models/qwen2_moe.py)
+        sg = jnp.einsum("bth,ih->bti", xc, _deq(p["w_gate_s"], compute_dtype))
+        su = jnp.einsum("bth,ih->bti", xc, _deq(p["w_up_s"], compute_dtype))
+        sd = jnp.einsum(
+            "bti,hi->bth", _act(config.hidden_act, sg) * su,
+            _deq(p["w_down_s"], compute_dtype),
+        )
+        gate = jax.nn.sigmoid(
+            jnp.einsum("bth,oh->bto", xc, p["shared_gate"].astype(compute_dtype))
+        )
+        out = out + sd * gate
+    return out
+
+
 def forward(
     config: ModelConfig,
     params: Params,
@@ -142,16 +247,27 @@ def forward(
     compute_dtype=jnp.bfloat16,
     lora: Optional[Params] = None,  # LoRA adapter tree (see bigdl_tpu.train)
     start: Optional[jax.Array] = None,  # [B] pad offsets when cache is None
+    collect_obs: int = 0,  # static: stash the last-N rotated queries per layer
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache with pos advanced).
 
     cache=None runs the cache-free training/scoring path (full block-causal
     attention, no KV writes) — the path QLoRA finetuning differentiates
     through.
+
+    collect_obs=W > 0 (prefill only) additionally returns the observation
+    window queries [L, B, W, Hq, D] for SnapKV compression
+    (kvcache.compress) as a third element.
     """
     assert mode in ("prefill", "decode")
     B, T = tokens.shape
     Hq, Hkv, D = config.num_attention_heads, config.num_key_value_heads, config.head_dim_
+    eps = config.rms_norm_eps
+
+    def norm(x, w, b=None):
+        if config.norm_type == "layernorm":
+            return layer_norm(x, w, b, eps)
+        return rms_norm(x, w, eps, offset=config.rms_norm_offset)
 
     if cache is None:
         pos0 = jnp.zeros((), jnp.int32)
@@ -163,12 +279,28 @@ def forward(
     h = params["embed"].astype(compute_dtype)[tokens]
     if config.scale_embeddings:
         h = h * jnp.asarray(config.hidden_size**0.5, compute_dtype)
+    if config.embedding_scale:
+        h = h * jnp.asarray(config.embedding_scale, compute_dtype)
 
-    # Rotary tables: positions are relative to each row's start (left pad).
+    # Rotary tables: positions are relative to each row's start (left pad);
+    # after SnapKV compression slots ≠ positions and the cache carries the
+    # true next position in rope_base.
     slots = pos0 + jnp.arange(T)[None, :]  # [1, T] global cache slots
-    positions = jnp.maximum(slots - row_start[:, None], 0)  # [B, T]
-    inv_freq = make_inv_freq(D, config.rope_theta, config.rope_scaling_dict)
-    cos, sin = rope_cos_sin(positions, inv_freq)
+    if cache is not None:
+        positions = cache.next_positions(T)  # [B, T]
+    else:
+        positions = jnp.maximum(slots - row_start[:, None], 0)  # [B, T]
+    if config.alibi:
+        cos = sin = None
+    else:
+        inv_freq, att_scale = make_inv_freq_scaled(
+            config.rotary_dim, config.rope_theta, config.rope_scaling_dict,
+            seq_len=(cache.max_len if cache is not None else T),
+        )
+        cos, sin = rope_cos_sin(
+            positions, inv_freq, interleaved=config.rope_interleaved,
+            scale=att_scale,
+        )
 
     # Prefill goes through the Pallas flash-attention kernel (no [T,S]
     # score matrix in HBM); decode and the differentiable cache-free
@@ -176,32 +308,54 @@ def forward(
     # sdp_causal vs sdp dispatch (models/common.py:222-258).
     from bigdl_tpu.ops.pallas import use_pallas
 
-    use_flash = cache is not None and mode == "prefill" and T > 1 and use_pallas()
+    uniform_window = config.sliding_window_pattern is None
+    use_flash = (
+        cache is not None and mode == "prefill" and T > 1 and use_pallas()
+        and uniform_window and not config.alibi
+    )
 
     # Attention masks (shared by all layers, computed once outside the scan).
+    # With sliding-window alternation (gemma2) both the global and the
+    # sliding mask are built; the scan body selects per layer index.
+    def build_masks():
+        if cache is None:
+            tj = jnp.arange(T)
+            base = (tj[None, :] <= tj[:, None])[None] & (
+                tj[None, None, :] >= row_start[:, None, None]
+            )  # [B, T, T]
+            k_slot = tj[None, None, :]
+            q_slot = tj[None, :, None]
+        else:
+            S = cache.max_len
+            sj = jnp.arange(S)
+            base = (sj[None, None, :] <= slots[..., None]) & (
+                sj[None, None, :] >= row_start[:, None, None]
+            )  # [B, T, S]
+            k_slot = sj[None, None, :]
+            q_slot = slots[..., None]
+        if config.sliding_window:
+            sliding = base & (k_slot > q_slot - config.sliding_window)
+        else:
+            sliding = base
+        return base, sliding, k_slot, q_slot
+
     if use_flash:
-        mask = None
-    elif cache is None:
-        # cache-free training path: block-local causal
-        tj = jnp.arange(T)
-        mask = (tj[None, :] <= tj[:, None])[None] & (
-            tj[None, None, :] >= row_start[:, None, None]
-        )  # [B, T, T]
-        if config.sliding_window:
-            mask = mask & (tj[None, None, :] > tj[None, :, None] - config.sliding_window)
+        mask_global = mask_sliding = None
+        alibi_bias = None
     else:
-        # Both prefill and decode attend over the full cache with a validity
-        # mask — chunked prefill (pos > 0) therefore sees earlier chunks.
-        S = cache.max_len
-        sj = jnp.arange(S)
-        q_slot = slots  # [B (broadcast), T]
-        mask = (sj[None, None, :] <= q_slot[..., None]) & (
-            sj[None, None, :] >= row_start[:, None, None]
-        )  # [B, T, S]
-        if config.sliding_window:
-            mask = mask & (sj[None, None, :] > q_slot[..., None] - config.sliding_window)
-    if mask is not None:
-        mask = mask[:, None, None]  # [B, 1, 1, T, S'] broadcasts over (Hkv, G)
+        mask_global, mask_sliding, k_slot, q_slot = build_masks()
+        if config.alibi:
+            # additive float bias: slope_h * (k_pos - q_pos), 0 on diagonal
+            # (start offsets cancel in the difference)
+            slopes = alibi_slopes(Hq).reshape(Hkv, Hq // Hkv)
+            dist = (k_slot - q_slot).astype(jnp.float32)  # [B, T, S]
+            alibi_bias = (
+                slopes[None, :, :, None, None] * dist[:, None, None]
+            )  # [B, Hkv, G, T, S]
+        else:
+            alibi_bias = None
+        mask_global = mask_global[:, None, None]  # [B,1,1,T,S]
+        mask_sliding = mask_sliding[:, None, None]
 
     lora_scale = lora["scale"] if lora is not None else None
 
@@ -211,15 +365,25 @@ def forward(
             y = y + _lora_delta(x, lp[wname], lora_scale, compute_dtype)
         return y
 
+    # per-layer static sliding flags, as a traced vector for the scan body
+    sliding_flags = jnp.asarray(
+        [config.layer_is_sliding(l) for l in range(config.num_hidden_layers)],
+        jnp.bool_,
+    )
+
     def body(carry, xs):
         hidden, c, idx = carry
         p, lp = xs if lora is not None else (xs, None)
 
-        x = rms_norm(hidden, p["attn_norm"], config.rms_norm_eps)
+        x = norm(hidden, p["attn_norm"], p.get("attn_norm_b"))
         q = proj(x, p, lp, "wq", "bq").reshape(B, T, Hq, D)
         k = proj(x, p, lp, "wk", "bk").reshape(B, T, Hkv, D)
         v = proj(x, p, lp, "wv", "bv").reshape(B, T, Hkv, D)
-        q, k = apply_rotary_emb(q, k, cos, sin)
+        if config.qk_norm:
+            q = rms_norm(q, p["q_norm"], eps, offset=config.rms_norm_offset)
+            k = rms_norm(k, p["k_norm"], eps, offset=config.rms_norm_offset)
+        if not config.alibi:
+            q, k = apply_rotary_emb(q, k, cos, sin, config.rope_interleaved)
 
         if c is not None:
             c = kvcache.update_layer(c, idx, k, v)
@@ -234,29 +398,53 @@ def forward(
             attn = flash_attention(
                 q, k_att, v_att, start=row_start, q_offset=pos0,
                 window=config.sliding_window, softcap=config.attn_logit_softcap,
+                scale=config.attn_scale,
             )
         else:
-            attn = attention(q, k_att, v_att, mask, softcap=config.attn_logit_softcap)
-        out = proj(attn.reshape(B, T, Hq * D), p, lp, "wo")
-        hidden = hidden + out
+            is_sliding = sliding_flags[idx]
+            mask = jnp.where(is_sliding, mask_sliding, mask_global)
+            if alibi_bias is not None:
+                mask = jnp.where(mask, alibi_bias, _NEG_INF)
+            attn = attention(
+                q, k_att, v_att, mask,
+                scale=config.attn_scale, softcap=config.attn_logit_softcap,
+            )
+        out = proj(attn.reshape(B, T, Hq * D), p, lp, "wo", "bo")
+        if config.post_attn_norm:
+            out = norm(out, p["post_attn_norm"])
+        rs = config.residual_scale
+        hidden = hidden + (out * rs if rs else out)
 
-        x = rms_norm(hidden, p["mlp_norm"], config.rms_norm_eps)
-        gate = proj(x, p, lp, "w_gate")
-        up = proj(x, p, lp, "w_up")
-        down = proj(_act(config.hidden_act, gate) * up, p, lp, "w_down")
-        hidden = hidden + down
+        x = norm(hidden, p["mlp_norm"], p.get("mlp_norm_b"))
+        if config.is_moe:
+            down = _moe_mlp(config, x, p, compute_dtype)
+        elif config.gated_mlp:
+            gate = proj(x, p, lp, "w_gate", "b_gate")
+            up = proj(x, p, lp, "w_up", "b_up")
+            down = proj(_act(config.hidden_act, gate) * up, p, lp, "w_down", "b_down")
+        else:
+            up = proj(x, p, lp, "w_up", "b_up")
+            down = proj(_act(config.hidden_act, up), p, lp, "w_down", "b_down")
+        if config.post_attn_norm:
+            down = norm(down, p["post_mlp_norm"])
+        hidden = hidden + (down * rs if rs else down)
 
-        return (hidden, c, idx + 1), None
+        ys = q[:, T - collect_obs:] if collect_obs else None
+        return (hidden, c, idx + 1), ys
 
     xs = (params["layers"], lora["layers"]) if lora is not None else params["layers"]
-    (h, cache, _), _ = jax.lax.scan(
+    (h, cache, _), obs = jax.lax.scan(
         body, (h, cache, jnp.zeros((), jnp.int32)), xs
     )
 
-    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    h = norm(h, params["final_norm"], params.get("final_norm_b"))
     lm_head = params.get("lm_head", params["embed"])
     logits = linear(h, lm_head, None, compute_dtype).astype(jnp.float32)
+    if config.logit_scale:
+        logits = logits * config.logit_scale
     logits = _softcap(logits, config.final_logit_softcap)
     if cache is not None:
         cache = kvcache.advance(cache, T)
+    if collect_obs:
+        return logits, cache, obs
     return logits, cache

@@ -8,11 +8,17 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float = 1e-6, offset: bool = False
+) -> jax.Array:
+    """offset=True is the gemma convention: scale by (1 + w)."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     y = xf * jax.lax.rsqrt(var + eps)
-    return (y * weight.astype(jnp.float32)).astype(x.dtype)
+    w = weight.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w
+    return (y * w).astype(x.dtype)
 
 
 def layer_norm(

@@ -32,12 +32,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bigdl_tpu.utils import round_up
+
 _NEG_INF = -1e30
 _LANES = 128
-
-
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
 
 
 def _kernel(
@@ -215,9 +213,9 @@ def flash_attention(
         q_offset = jnp.zeros((), jnp.int32)
     assert causal, "non-causal path uses ops.attention (bidirectional encoders)"
 
-    block_q = min(block_q, _round_up(T, 16))
-    block_k = min(block_k, _round_up(S, 16))
-    Tp, Sp, Dp = _round_up(T, block_q), _round_up(S, block_k), _round_up(D, _LANES)
+    block_q = min(block_q, round_up(T, 16))
+    block_k = min(block_k, round_up(S, 16))
+    Tp, Sp, Dp = round_up(T, block_q), round_up(S, block_k), round_up(D, _LANES)
 
     qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, Hq, T, D]
     kt = jnp.transpose(k, (0, 2, 1, 3))

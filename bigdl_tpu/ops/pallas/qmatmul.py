@@ -30,12 +30,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bigdl_tpu.utils import round_up
+
 BLOCK = 32  # quant block (elements per scale), fixed for sym_int4
 _PACKED_PER_SCALE = BLOCK // 2
-
-
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
 
 
 def _kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref, *, block_o: int, kh: int):
@@ -116,7 +114,7 @@ def qmatmul_int4(
     x2 = x.reshape(M, K)
     xe, xo = x2[:, 0::2], x2[:, 1::2]  # [M, K//2] each; tiny, XLA-side
 
-    Mp = _round_up(max(M, 1), 8)
+    Mp = round_up(max(M, 1), 8)
     xe = jnp.pad(xe, ((0, Mp - M), (0, 0)))
     xo = jnp.pad(xo, ((0, Mp - M), (0, 0)))
 

@@ -3,10 +3,13 @@
 Equivalent of the reference's fused `xe_addons.rotary_half_inplaced` /
 `rotary_two_inplaced` kernels (models/llama.py:154-167 and ~30 other call
 sites). "half" is the HF-LLaMA rotate-half convention (contiguous halves),
-"two" is the GPT-NeoX interleaved-pairs convention; both are provided.
+"two" is the GPT-NeoX/GLM interleaved-pairs convention; both are provided
+(`interleaved=True`). Partial rotary (stablelm/phi/glm) rotates only the
+leading `rotary_dim` lanes of each head.
 
 Supports the HF `rope_scaling` schemes used by the reference model zoo:
-linear, dynamic-NTK, and llama3 frequency smoothing.
+linear, dynamic-NTK, llama3 frequency smoothing, yarn, and
+longrope/su (phi3).
 """
 
 from __future__ import annotations
@@ -46,36 +49,136 @@ def llama3_scaled_inv_freq(
     return jnp.where(mid, smoothed, out)
 
 
-def make_inv_freq(head_dim: int, theta: float, rope_scaling: Optional[dict]) -> jax.Array:
+def yarn_scaled_inv_freq(
+    inv_freq: jax.Array,
+    head_dim: int,
+    theta: float,
+    factor: float = 1.0,
+    beta_fast: float = 32.0,
+    beta_slow: float = 1.0,
+    original_max_position: int = 4096,
+) -> tuple[jax.Array, float]:
+    """YaRN (deepseek/qwen long-context): NTK-by-parts interpolation plus an
+    attention temperature (returned as mscale; multiply cos/sin by it)."""
+
+    def find_dim(num_rot):
+        return (
+            head_dim
+            * math.log(original_max_position / (num_rot * 2 * math.pi))
+        ) / (2 * math.log(theta))
+
+    low = max(math.floor(find_dim(beta_fast)), 0)
+    high = min(math.ceil(find_dim(beta_slow)), head_dim // 2 - 1)
+    ramp = jnp.clip(
+        (jnp.arange(head_dim // 2, dtype=jnp.float32) - low) / max(high - low, 1),
+        0.0,
+        1.0,
+    )
+    interp = inv_freq / factor  # fully interpolated (long range)
+    inv = interp * ramp + inv_freq * (1 - ramp)
+    mscale = 0.1 * math.log(factor) + 1.0 if factor > 1.0 else 1.0
+    return inv, mscale
+
+
+def make_inv_freq(
+    head_dim: int, theta: float, rope_scaling: Optional[dict]
+) -> jax.Array:
+    inv, _ = make_inv_freq_scaled(head_dim, theta, rope_scaling, seq_len=None)
+    return inv
+
+
+def make_inv_freq_scaled(
+    head_dim: int,
+    theta: float,
+    rope_scaling: Optional[dict],
+    seq_len: Optional[int] = None,
+) -> tuple[jax.Array, float]:
+    """Returns (inv_freq [head_dim//2], attention_scale) where cos/sin must be
+    multiplied by attention_scale (yarn mscale / longrope factor)."""
     inv_freq = default_inv_freq(head_dim, theta)
     if not rope_scaling:
-        return inv_freq
+        return inv_freq, 1.0
     rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
     if rope_type in ("default", None):
-        return inv_freq
+        return inv_freq, 1.0
     if rope_type == "linear":
-        return inv_freq / rope_scaling.get("factor", 1.0)
+        return inv_freq / rope_scaling.get("factor", 1.0), 1.0
+    if rope_type == "dynamic":
+        # dynamic NTK: theta grows with the in-use seq len; at trace time we
+        # pin to the configured max (the conservative long-context setting).
+        factor = rope_scaling.get("factor", 1.0)
+        orig = rope_scaling.get("original_max_position_embeddings") or rope_scaling.get(
+            "max_position_embeddings", 4096
+        )
+        use_len = seq_len or int(orig * factor)
+        if use_len > orig:
+            adj = theta * (
+                (factor * use_len / orig) - (factor - 1)
+            ) ** (head_dim / (head_dim - 2))
+            return default_inv_freq(head_dim, adj), 1.0
+        return inv_freq, 1.0
     if rope_type == "llama3":
-        return llama3_scaled_inv_freq(
+        return (
+            llama3_scaled_inv_freq(
+                inv_freq,
+                factor=rope_scaling.get("factor", 8.0),
+                low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
+                high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
+                original_max_position=rope_scaling.get(
+                    "original_max_position_embeddings", 8192
+                ),
+            ),
+            1.0,
+        )
+    if rope_type == "yarn":
+        return yarn_scaled_inv_freq(
             inv_freq,
-            factor=rope_scaling.get("factor", 8.0),
-            low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
-            high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
+            head_dim,
+            theta,
+            factor=rope_scaling.get("factor", 1.0),
+            beta_fast=rope_scaling.get("beta_fast", 32.0),
+            beta_slow=rope_scaling.get("beta_slow", 1.0),
             original_max_position=rope_scaling.get(
-                "original_max_position_embeddings", 8192
+                "original_max_position_embeddings", 4096
             ),
         )
+    if rope_type in ("longrope", "su"):
+        # phi3 long/short per-frequency factors
+        # (HF _compute_longrope_parameters)
+        orig = rope_scaling.get("original_max_position_embeddings", 4096)
+        maxp = rope_scaling.get("max_position_embeddings", orig)
+        long_ctx = (seq_len or maxp) > orig
+        key = "long_factor" if long_ctx else "short_factor"
+        ext = jnp.asarray(rope_scaling[key], jnp.float32)
+        scale = maxp / orig
+        if scale <= 1.0:
+            att = 1.0
+        else:
+            att = math.sqrt(1 + math.log(scale) / math.log(orig))
+        return inv_freq / ext, att
     raise NotImplementedError(f"rope_scaling type {rope_type!r}")
 
 
 def rope_cos_sin(
-    positions: jax.Array, inv_freq: jax.Array, dtype=jnp.float32
+    positions: jax.Array,
+    inv_freq: jax.Array,
+    dtype=jnp.float32,
+    interleaved: bool = False,
+    scale: float = 1.0,
 ) -> tuple[jax.Array, jax.Array]:
-    """positions [..., T] int -> cos/sin [..., T, head_dim] (halves duplicated,
-    HF convention)."""
-    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, D/2]
-    angles = jnp.concatenate([angles, angles], axis=-1)
-    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+    """positions [..., T] int -> cos/sin [..., T, rotary_dim].
+
+    Layout matches the convention `apply_rotary_emb` consumes: halves
+    duplicated (HF) or pairs repeated (interleaved/neox)."""
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, R/2]
+    if interleaved:
+        angles = jnp.repeat(angles, 2, axis=-1)
+    else:
+        angles = jnp.concatenate([angles, angles], axis=-1)
+    return (
+        (jnp.cos(angles) * scale).astype(dtype),
+        (jnp.sin(angles) * scale).astype(dtype),
+    )
 
 
 def _rotate_half(x: jax.Array) -> jax.Array:
@@ -83,20 +186,66 @@ def _rotate_half(x: jax.Array) -> jax.Array:
     return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
 
 
+def _rotate_pairs(x: jax.Array) -> jax.Array:
+    """Even/odd pair rotation — HF modeling_glm redefines rotate_half this
+    way (x[0::2]/x[1::2] stacked), unlike the llama contiguous-halves
+    convention."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def _apply_one(x, cos, sin, interleaved):
+    rot = _rotate_pairs(x) if interleaved else _rotate_half(x)
+    return x * cos + rot * sin
+
+
 def apply_rotary_emb(
     q: jax.Array,
     k: jax.Array,
     cos: jax.Array,
     sin: jax.Array,
+    interleaved: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
-    """q [B,T,Hq,D], k [B,T,Hk,D], cos/sin [B,T,D] -> rotated (q, k).
+    """q [B,T,Hq,D], k [B,T,Hk,D], cos/sin [B,T,R] with R <= D -> rotated.
 
-    rotate-half convention, computed in fp32 and cast back (the reference
-    kernel also computes the rotation at full precision in-register).
+    R < D is partial rotary (stablelm/phi/glm): only the first R lanes of
+    each head rotate. interleaved=True is the GLM/ChatGLM convention:
+    angles repeated pairwise (`rope_cos_sin(interleaved=True)`) and lanes
+    rotated as even/odd pairs. Computed in fp32 and cast back (the
+    reference kernel also computes the rotation at full precision
+    in-register).
     """
+    R = cos.shape[-1]
+    D = q.shape[-1]
     cos = cos[..., None, :]
     sin = sin[..., None, :]
     qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
-    q_out = qf * cos + _rotate_half(qf) * sin
-    k_out = kf * cos + _rotate_half(kf) * sin
+    if R < D:
+        q_rot = _apply_one(qf[..., :R], cos, sin, interleaved)
+        k_rot = _apply_one(kf[..., :R], cos, sin, interleaved)
+        q_out = jnp.concatenate([q_rot, qf[..., R:]], axis=-1)
+        k_out = jnp.concatenate([k_rot, kf[..., R:]], axis=-1)
+    else:
+        q_out = _apply_one(qf, cos, sin, interleaved)
+        k_out = _apply_one(kf, cos, sin, interleaved)
     return q_out.astype(q.dtype), k_out.astype(k.dtype)
+
+
+def alibi_slopes(num_heads: int) -> jax.Array:
+    """ALiBi per-head slopes (baichuan-13b/bloom; reference
+    models/baichuan.py `baichuan_13b_get_alibi_mask`). Standard construction:
+    powers of 2^(-8/n) for the nearest power-of-two head count, interpolated
+    for the rest."""
+    import numpy as np
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    n = 2 ** math.floor(math.log2(num_heads))
+    slopes = pow2_slopes(n)
+    if n < num_heads:
+        extra = pow2_slopes(2 * n)
+        slopes += extra[0::2][: num_heads - n]
+    return jnp.asarray(np.asarray(slopes, np.float32))

@@ -38,12 +38,40 @@ def layer_specs(config: ModelConfig) -> dict:
         "wk": _COL,
         "wv": _COL,
         "wo": _ROW,
-        "w_gate": _COL,
-        "w_up": _COL,
-        "w_down": _ROW,
     }
+    if config.is_moe:
+        # experts sharded over 'tp' (expert parallelism: each shard holds
+        # E/tp full experts; the combine einsum psums over the axis)
+        specs.update({
+            "router": _REP,
+            "w_gate_e": P(None, "tp", None, None),
+            "w_up_e": P(None, "tp", None, None),
+            "w_down_e": P(None, "tp", None, None),
+        })
+        if config.shared_expert_intermediate_size:
+            specs.update({
+                "w_gate_s": _COL, "w_up_s": _COL, "w_down_s": _ROW,
+                "shared_gate": _REP,
+            })
+    elif config.gated_mlp:
+        specs.update({"w_gate": _COL, "w_up": _COL, "w_down": _ROW})
+    else:
+        specs.update({"w_up": _COL, "w_down": _ROW})
     if config.attention_bias:
         specs.update({"bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp")})
+    if config.attention_out_bias:
+        specs["bo"] = _REP
+    if config.mlp_bias:
+        if config.gated_mlp:
+            specs["b_gate"] = P(None, "tp")
+        specs["b_up"] = P(None, "tp")
+        specs["b_down"] = _REP
+    if config.norm_bias:
+        specs.update({"attn_norm_b": _REP, "mlp_norm_b": _REP})
+    if config.post_attn_norm:
+        specs.update({"post_attn_norm": _REP, "post_mlp_norm": _REP})
+    if config.qk_norm:
+        specs.update({"q_norm": _REP, "k_norm": _REP})
     return specs
 
 
@@ -54,6 +82,8 @@ def param_specs(config: ModelConfig, tie_word_embeddings: bool | None = None) ->
         "layers": layer_specs(config),
         "final_norm": _REP,
     }
+    if config.norm_bias:
+        specs["final_norm_b"] = _REP
     if not tie:
         specs["lm_head"] = P("tp", None)
     return specs

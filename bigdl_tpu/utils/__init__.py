@@ -1,0 +1,20 @@
+"""Shared utilities (reference: `ipex_llm/utils/` — here kept minimal;
+logging/error helpers live in bigdl_tpu.utils.common, env flags in
+bigdl_tpu.utils.flags)."""
+
+from __future__ import annotations
+
+
+def round_up(x: int, m: int) -> int:
+    """Smallest multiple of m that is >= x."""
+    return (x + m - 1) // m * m
+
+
+# KV caches are sized to 64-slot multiples so only a few distinct XLA
+# programs are ever compiled per model — the TPU-shaped replacement for the
+# reference's KV_CACHE_ALLOC_BLOCK_LENGTH growth policy (models/utils.py:39).
+CACHE_SLOT_QUANTUM = 64
+
+
+def cache_len_for(prompt_len: int, max_new_tokens: int) -> int:
+    return round_up(prompt_len + max_new_tokens, CACHE_SLOT_QUANTUM)

@@ -1,0 +1,164 @@
+"""SnapKV-style KV compression tests (reference DynamicCompressCache,
+kv.py:171-375).
+
+Correctness oracle: with a budget large enough to keep every prompt
+token, compression is a pure re-layout — decode logits must match the
+uncompressed path almost exactly (gather + rope_base bookkeeping only).
+With a tight budget the output stays finite and the cache shrinks.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.generate import GenerationConfig, generate_tokens, pad_prompts
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS
+
+CFG = PRESETS["tiny-llama"]
+
+
+def _params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _prefill_with_obs(params, tokens, start, window, cache_len=64, quantize_kv=False):
+    cache = kvcache.init_cache(
+        CFG.num_hidden_layers, tokens.shape[0], cache_len,
+        CFG.num_key_value_heads, CFG.head_dim_, quantize_kv=quantize_kv,
+    )
+    cache = dataclasses.replace(cache, start=jnp.asarray(start, jnp.int32))
+    return llama.forward(
+        CFG, params, jnp.asarray(tokens), cache, mode="prefill",
+        collect_obs=window,
+    )
+
+
+def test_lossless_when_budget_covers_prompt():
+    """budget >= prompt: compression only re-lays-out the cache; the next
+    decode step must match the uncompressed path to float tolerance."""
+    params = _params()
+    prompts = [[5, 9, 2, 7, 3, 11, 4, 8, 6, 1], [9, 2, 6, 4, 8, 1, 3]]
+    tokens, start = pad_prompts(prompts, pad_id=0, bucket=16)
+    W = 4
+
+    logits, cache, obs = _prefill_with_obs(params, tokens, start, W)
+    assert obs.shape == (CFG.num_hidden_layers, 2, W, CFG.num_attention_heads, CFG.head_dim_)
+
+    ref_logits, ref_cache = _prefill_with_obs(params, tokens, start, W)[:2]
+    nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
+
+    comp = kvcache.compress(cache, obs, budget=16 + W, out_len=32, window=W)
+    assert int(comp.pos) == 16 + W
+    np.testing.assert_array_equal(
+        np.asarray(comp.rope_base), 16 - start
+    )
+
+    d_ref, _ = llama.forward(CFG, params, nxt, ref_cache, mode="decode")
+    d_comp, _ = llama.forward(CFG, params, nxt, comp, mode="decode")
+    np.testing.assert_allclose(
+        np.asarray(d_comp), np.asarray(d_ref), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_tight_budget_drops_tokens_but_stays_sane():
+    params = _params()
+    prompts = [list(range(1, 25))]  # 24 tokens
+    tokens, start = pad_prompts(prompts, pad_id=0, bucket=32)
+    W = 4
+    logits, cache, obs = _prefill_with_obs(params, tokens, start, W)
+    comp = kvcache.compress(cache, obs, budget=8, out_len=16, window=W)
+    # kept = budget slots, all valid (24 real tokens > budget)
+    assert int(comp.start[0]) == 0
+    assert comp.max_len == 16
+    nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
+    d, c2 = llama.forward(CFG, params, nxt, comp, mode="decode")
+    assert np.all(np.isfinite(np.asarray(d)))
+    assert int(c2.pos) == 9 and int(c2.rope_base[0]) == 25
+
+
+def test_short_row_partial_validity():
+    """A row with fewer prefix tokens than the keep-budget gets left-padded
+    inside the compressed cache (new start > 0)."""
+    params = _params()
+    prompts = [[5, 9, 2, 7, 3, 11]]  # 6 tokens, W=4 → only 2 prefix slots
+    tokens, start = pad_prompts(prompts, pad_id=0, bucket=8)
+    W = 4
+    logits, cache, obs = _prefill_with_obs(params, tokens, start, W)
+    comp = kvcache.compress(cache, obs, budget=W + 6, out_len=16, window=W)
+    # keep_k = 6, avail = 2 → start = 4
+    assert int(comp.start[0]) == 4
+    nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
+    d_ref, _ = llama.forward(CFG, params, nxt, cache, mode="decode")
+    d, _ = llama.forward(CFG, params, nxt, comp, mode="decode")
+    # every real token kept → lossless here too
+    np.testing.assert_allclose(
+        np.asarray(d), np.asarray(d_ref), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_row_shorter_than_obs_window():
+    """A ragged batch where one row has fewer tokens than the observation
+    window: its obs-region pad slots must fall behind the new start
+    boundary (regression: they were attended as garbage)."""
+    params = _params()
+    prompts = [list(range(1, 25)), [7, 3, 9]]  # 24 and 3 tokens
+    tokens, start = pad_prompts(prompts, pad_id=0, bucket=32)
+    W = 8
+    logits, cache, obs = _prefill_with_obs(params, tokens, start, W)
+    comp = kvcache.compress(cache, obs, budget=12, out_len=32, window=W)
+    # short row: avail=0 prefix, pad_in_obs = start - (32-8) = 29-24 = 5
+    assert int(comp.start[1]) == (12 - W) + 5
+    assert int(comp.rope_base[1]) == 3
+    # the short row must decode identically to its uncompressed cache
+    # (every real token survives: 3 tokens < window)
+    nxt = jnp.argmax(logits[:, -1], -1)[:, None].astype(jnp.int32)
+    d_ref, _ = llama.forward(CFG, params, nxt, cache, mode="decode")
+    d, _ = llama.forward(CFG, params, nxt, comp, mode="decode")
+    np.testing.assert_allclose(
+        np.asarray(d[1]), np.asarray(d_ref[1]), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_fp8_cache_compression():
+    params = _params()
+    prompts = [list(range(1, 17))]
+    tokens, start = pad_prompts(prompts, pad_id=0, bucket=16)
+    W = 4
+    logits, cache, obs = _prefill_with_obs(
+        params, tokens, start, W, quantize_kv=True
+    )
+    comp = kvcache.compress(cache, obs, budget=8, out_len=16, window=W)
+    assert comp.quantized and comp.k_scale.shape == (2, 1, 16, 2)
+    nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
+    d, _ = llama.forward(CFG, params, nxt, comp, mode="decode")
+    assert np.all(np.isfinite(np.asarray(d)))
+
+
+def test_generate_with_compression_end_to_end():
+    params = _params()
+    prompts = [list(range(1, 40))]
+    tokens, start = pad_prompts(prompts, pad_id=0)
+    gen = GenerationConfig(max_new_tokens=8)
+    out_plain = generate_tokens(
+        CFG, params, jnp.asarray(tokens), jnp.asarray(start),
+        jax.random.PRNGKey(0), gen, llama.forward, cache_len=128,
+    )
+    out_comp = generate_tokens(
+        CFG, params, jnp.asarray(tokens), jnp.asarray(start),
+        jax.random.PRNGKey(0), gen, llama.forward, cache_len=128,
+        compress_budget=48, compress_window=8,
+    )
+    # budget 48 > prompt 39: lossless → identical greedy tokens
+    np.testing.assert_array_equal(np.asarray(out_plain), np.asarray(out_comp))
+
+    out_tight = generate_tokens(
+        CFG, params, jnp.asarray(tokens), jnp.asarray(start),
+        jax.random.PRNGKey(0), gen, llama.forward, cache_len=128,
+        compress_budget=16, compress_window=8,
+    )
+    arr = np.asarray(out_tight)
+    assert arr.shape == (1, 8) and np.all(arr >= 0) and np.all(arr < CFG.vocab_size)

@@ -95,6 +95,38 @@ def test_left_padding_matches_unpadded():
     )
 
 
+def test_left_padded_decode_matches_unpadded():
+    """Rope positions must CONTINUE across the prefill→decode boundary for
+    left-padded rows (regression: a per-row base clamp shifted prompt key
+    positions by the pad length, which cancels inside prefill by rope
+    translation-invariance but breaks the first decode step)."""
+    params = make_params()
+    prompt = [5, 9, 2, 7]
+    # padded path
+    tokens_np, start = pad_prompts([prompt], pad_id=0, bucket=16)
+    cache = kvcache.init_cache(
+        CFG.num_hidden_layers, 1, 32, CFG.num_key_value_heads, CFG.head_dim_
+    )
+    cache = dataclasses.replace(cache, start=jnp.asarray(start, jnp.int32))
+    logits, cache = llama.forward(
+        CFG, params, jnp.asarray(tokens_np), cache, mode="prefill"
+    )
+    nxt = jnp.argmax(logits[:, -1], -1)[:, None].astype(jnp.int32)
+    d_pad, _ = llama.forward(CFG, params, nxt, cache, mode="decode")
+
+    # unpadded reference
+    cache2 = kvcache.init_cache(
+        CFG.num_hidden_layers, 1, 32, CFG.num_key_value_heads, CFG.head_dim_
+    )
+    logits2, cache2 = llama.forward(
+        CFG, params, jnp.asarray([prompt], jnp.int32), cache2, mode="prefill"
+    )
+    d_ref, _ = llama.forward(CFG, params, nxt, cache2, mode="decode")
+    np.testing.assert_allclose(
+        np.asarray(d_pad), np.asarray(d_ref), rtol=2e-2, atol=2e-2
+    )
+
+
 def test_quantized_forward_close_to_dense():
     params = make_params()
     qparams = llama.quantize_params(params, "sym_int8")
