@@ -145,7 +145,11 @@ class TpuModel:
         if draft_params is None:
             from bigdl_tpu.quant.qtypes import resolve_qtype
 
-            if not resolve_qtype(self.qtype).is_dense:
+            try:
+                is_dense = resolve_qtype(self.qtype).is_dense
+            except ValueError:  # e.g. "gguf_native" mixed trees
+                is_dense = False
+            if not is_dense:
                 # re-quantizing already-quantized weights is a no-op
                 # (quantize_params skips QTensor leaves) — the "draft" would
                 # be weight-identical to the target: all cost, no speedup.
@@ -180,7 +184,7 @@ class AutoModelForCausalLM:
         from bigdl_tpu.convert import load_hf_checkpoint
 
         qtype = "sym_int4" if load_in_4bit else load_in_low_bit
-        config, params = load_hf_checkpoint(model_path, qtype=qtype)
+        config, params, qtype = load_hf_checkpoint(model_path, qtype=qtype)
         return TpuModel(config=config, params=params, qtype=qtype)
 
     @classmethod
@@ -189,3 +193,13 @@ class AutoModelForCausalLM:
 
         config, params, qtype = load_low_bit(path)
         return TpuModel(config=config, params=params, qtype=qtype)
+
+    @classmethod
+    def from_gguf(cls, path: str, qtype: Optional[str] = None) -> TpuModel:
+        """Load a llama.cpp GGUF file (reference transformers/model.py:391
+        `from_gguf`). qtype=None keeps the file's native low-bit formats
+        (q4_0→sym_int4 etc., repacked without dequantization)."""
+        from bigdl_tpu.convert.gguf import load_gguf
+
+        config, params = load_gguf(path, qtype=qtype)
+        return TpuModel(config=config, params=params, qtype=qtype or "gguf_native")

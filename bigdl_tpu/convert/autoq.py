@@ -1,0 +1,180 @@
+"""AWQ / GPTQ checkpoint import.
+
+Equivalent of the reference's GPTQ/AWQ ingest
+(`transformers/convert.py:379-455` convert_gptq unpack→requant to ggml
+asym_int4; `transformers/awq/` layer replacement in /root/reference),
+TPU-shaped: the int32-packed codes are unpacked with numpy and mapped
+**exactly** into our asym_int4 QTensor when the quantization group size
+is a multiple of our 32-element block (the usual 128): per-group
+(scale, zero) become per-block (d, m) with
+
+    gptq/awq:  w = (code - zero) * scale
+    asym_int4: w = code * d + m        →  d = scale, m = -zero * scale
+
+so codes are carried bit-for-bit. Non-divisible group sizes or
+activation-ordered (g_idx-shuffled) checkpoints fall back to fp32
+dequantization + requantization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _unpack_int32_nibbles(packed: np.ndarray, axis: int, order: np.ndarray) -> np.ndarray:
+    """int32 array → uint8 4-bit codes expanded 8x along `axis`, nibble
+    positions read in `order`."""
+    shifts = (order * 4).astype(np.uint32)
+    p = packed.astype(np.uint32)
+    p = np.expand_dims(p, axis + 1 if axis >= 0 else packed.ndim + axis + 1)
+    shape = [1] * p.ndim
+    shape[axis + 1 if axis >= 0 else p.ndim + axis] = 8
+    codes = (p >> shifts.reshape(shape)) & 0xF
+    new_shape = list(packed.shape)
+    new_shape[axis] *= 8
+    return codes.reshape(new_shape).astype(np.uint8)
+
+
+_GPTQ_ORDER = np.arange(8)  # sequential nibbles
+_AWQ_ORDER = np.array([0, 4, 1, 5, 2, 6, 3, 7])  # AWQ interleaved packing
+
+
+def unpack_gptq(
+    qweight: np.ndarray,  # int32 [in/8, out]
+    qzeros: np.ndarray,  # int32 [groups, out/8]
+    scales: np.ndarray,  # fp16/fp32 [groups, out]
+    bits: int = 4,
+    v1_zero_offset: bool = True,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (codes [out, in] uint8, scales [out, groups] f32,
+    zeros [out, groups] f32). GPTQ v1 stores zeros-1 (the +1 is re-added
+    here); v2 ('checkpoint_format: gptq_v2') stores them raw."""
+    assert bits == 4, "only 4-bit GPTQ supported"
+    codes = _unpack_int32_nibbles(qweight, axis=0, order=_GPTQ_ORDER)  # [in, out]
+    zeros = _unpack_int32_nibbles(qzeros, axis=1, order=_GPTQ_ORDER)  # [groups, out]
+    z = zeros.astype(np.float32)
+    if v1_zero_offset:
+        z = z + 1.0
+    return codes.T, scales.astype(np.float32).T, z.T
+
+
+def unpack_awq(
+    qweight: np.ndarray,  # int32 [in, out/8]
+    qzeros: np.ndarray,  # int32 [in/group, out/8]
+    scales: np.ndarray,  # fp16 [in/group, out]
+    bits: int = 4,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    assert bits == 4, "only 4-bit AWQ supported"
+    codes = _unpack_int32_nibbles(qweight, axis=1, order=_AWQ_ORDER)  # [in, out]
+    zeros = _unpack_int32_nibbles(qzeros, axis=1, order=_AWQ_ORDER)  # [groups, out]
+    return codes.T, scales.astype(np.float32).T, zeros.astype(np.float32).T
+
+
+def codes_to_qtensor(
+    codes: np.ndarray,  # [out, in] uint8 4-bit
+    scales: np.ndarray,  # [out, groups] f32
+    zeros: np.ndarray,  # [out, groups] f32
+    group_size: int,
+):
+    """Exact mapping into asym_int4 (block 32) when group_size % 32 == 0."""
+    from jax import numpy as jnp
+
+    from bigdl_tpu.quant import QTensor
+    from bigdl_tpu.quant.numerics import pack_nibbles
+
+    out, k = codes.shape
+    assert group_size % 32 == 0 and k % group_size == 0
+    rep = group_size // 32
+    d = np.repeat(scales, rep, axis=1).astype(np.float16)  # [out, k/32]
+    m = np.repeat(-zeros * scales, rep, axis=1).astype(np.float16)
+    data = np.asarray(pack_nibbles(jnp.asarray(codes)))
+    return QTensor(
+        data=jnp.asarray(data), scales=jnp.asarray(d),
+        mins=jnp.asarray(m), qtype="asym_int4",
+    )
+
+
+def dequantize_to_fp32(
+    codes: np.ndarray, scales: np.ndarray, zeros: np.ndarray, group_size: int,
+    g_idx: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """[out, in] fp32; honors act-order g_idx when present."""
+    out, k = codes.shape
+    if g_idx is not None:
+        g = np.asarray(g_idx)
+    else:
+        g = np.arange(k) // group_size
+    return (codes.astype(np.float32) - zeros[:, g]) * scales[:, g]
+
+
+def _trivial_g_idx(g_idx: Optional[np.ndarray], group_size: int, k: int) -> bool:
+    if g_idx is None:
+        return True
+    return bool(np.array_equal(np.asarray(g_idx), np.arange(k) // group_size))
+
+
+class QuantCheckpointAdapter:
+    """Makes a GPTQ/AWQ safetensors checkpoint look like a dense one.
+
+    `get_weight(name)` returns an exact QTensor for 1:1-mapped linear
+    weights when possible, else a dequantized fp32 array; `get(name)`
+    always returns fp32 (for family builders that slice/merge tensors,
+    e.g. phi3 fused qkv).
+    """
+
+    def __init__(self, get_tensor, quant_config: dict):
+        self._get = get_tensor
+        self.method = quant_config.get("quant_method", "gptq")
+        self.bits = quant_config.get("bits", quant_config.get("w_bit", 4))
+        self.group_size = quant_config.get(
+            "group_size", quant_config.get("q_group_size", 128)
+        )
+        self.v1_offset = quant_config.get("checkpoint_format", "gptq") != "gptq_v2"
+        if self.method not in ("gptq", "awq"):
+            raise NotImplementedError(f"quant_method {self.method!r}")
+        if self.bits != 4:
+            raise NotImplementedError(f"{self.method} bits={self.bits} (need 4)")
+
+    def _unpack(self, base: str):
+        qweight = self._get(base + ".qweight")
+        qzeros = self._get(base + ".qzeros")
+        scales = self._get(base + ".scales")
+        try:
+            g_idx = self._get(base + ".g_idx")
+        except KeyError:
+            g_idx = None
+        if self.method == "gptq":
+            c, s, z = unpack_gptq(
+                qweight, qzeros, scales, self.bits, self.v1_offset
+            )
+        else:
+            c, s, z = unpack_awq(qweight, qzeros, scales, self.bits)
+        return c, s, z, g_idx
+
+    def is_quantized(self, name: str) -> bool:
+        """name is '<module>.weight' of a packed linear?"""
+        base = name.removesuffix(".weight")
+        try:
+            self._get(base + ".qweight")
+            return True
+        except KeyError:
+            return False
+
+    def get_weight(self, name: str):
+        """QTensor (exact) or fp32 ndarray for '<module>.weight'."""
+        base = name.removesuffix(".weight")
+        c, s, z, g_idx = self._unpack(base)
+        if self.group_size % 32 == 0 and _trivial_g_idx(
+            g_idx, self.group_size, c.shape[1]
+        ):
+            return codes_to_qtensor(c, s, z, self.group_size)
+        return dequantize_to_fp32(c, s, z, self.group_size, g_idx)
+
+    def get(self, name: str) -> np.ndarray:
+        base = name.removesuffix(".weight")
+        if name.endswith(".weight") and self.is_quantized(name):
+            c, s, z, g_idx = self._unpack(base)
+            return dequantize_to_fp32(c, s, z, self.group_size, g_idx)
+        return self._get(name)

@@ -345,8 +345,17 @@ def params_from_state_dict(
     """
     spec = resolve_qtype(qtype)
 
-    def maybe_quant(name: str, arr: np.ndarray):
+    def maybe_quant(name: str, arr):
+        if isinstance(arr, QTensor):  # exact GPTQ/AWQ repack (autoq.py)
+            return arr
         if (not spec.is_dense) and (name in _QUANT_TARGETS or name == "lm_head"):
+            from bigdl_tpu import native
+
+            # native C++ packer (csrc/) for the ingest hot loop; bit-equal
+            # jnp fallback otherwise
+            qt = native.quantize_to_qtensor(np.asarray(arr, np.float32), spec.name)
+            if qt is not None:
+                return qt
             return quantize(jnp.asarray(arr, jnp.float32), spec.name)
         return jnp.asarray(arr).astype(dtype)
 
@@ -374,9 +383,13 @@ def load_hf_checkpoint(
     qtype: str = "sym_int4",
     dtype=jnp.bfloat16,
     config: Optional[ModelConfig] = None,
-) -> tuple[ModelConfig, dict]:
+) -> tuple[ModelConfig, dict, str]:
     """Load an HF-format local checkpoint directory (config.json +
-    *.safetensors) into a quantized param tree."""
+    *.safetensors) into a quantized param tree.
+
+    Returns (config, params, effective_qtype) — the effective qtype can
+    differ from the request for GPTQ/AWQ checkpoints, whose packed codes
+    live in asym_int4 (see _wrap_quantized)."""
     import torch  # lazy: only the ingest path touches torch
     from safetensors import safe_open  # lazy: heavy import
 
@@ -400,6 +413,8 @@ def load_hf_checkpoint(
         if name not in weight_map and name == "lm_head.weight":
             # some checkpoints tie without the flag; fall back to embeddings
             name = "model.embed_tokens.weight"
+        if name not in weight_map:
+            raise KeyError(name)
         shard = weight_map[name]
         if shard not in handles:
             # torch framework: robust bf16/fp16 handling without ml_dtypes
@@ -409,5 +424,36 @@ def load_hf_checkpoint(
         t = handles[shard].get_tensor(name)
         return t.to(dtype=torch.float32).numpy()
 
+    quant_config = hf_config.get("quantization_config")
+    if quant_config:
+        get_tensor, qtype = _wrap_quantized(
+            get_tensor, quant_config, config.model_type, qtype
+        )
     params = params_from_state_dict(config, get_tensor, qtype, dtype)
-    return config, params
+    return config, params, qtype
+
+
+# families whose layer builders slice/merge raw arrays (fused checkpoints) —
+# they must receive fp32, never packed QTensors
+_SPLIT_FAMILIES = {"phi3", "baichuan", "internlm2", "glm"}
+
+
+def _wrap_quantized(get_tensor, quant_config: dict, model_type: str, qtype: str):
+    """GPTQ/AWQ checkpoint: serve packed linears as exact asym_int4
+    QTensors where possible (reference convert.py:379-455 requantizes; the
+    exact mapping is lossless). Returns (getter, effective_qtype)."""
+    from bigdl_tpu.convert.autoq import QuantCheckpointAdapter
+
+    adapter = QuantCheckpointAdapter(get_tensor, quant_config)
+    # the packed codes live in asym_int4; the default sym_int4 request is
+    # upgraded to the exact container, any other explicit qtype requantizes
+    if qtype == "sym_int4":
+        qtype = "asym_int4"
+    exact = qtype == "asym_int4" and model_type not in _SPLIT_FAMILIES
+
+    def getter(name: str):
+        if exact and name.endswith(".weight") and adapter.is_quantized(name):
+            return adapter.get_weight(name)
+        return adapter.get(name)
+
+    return getter, qtype

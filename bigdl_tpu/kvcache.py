@@ -206,60 +206,75 @@ def compress(
     start = cache.start
     scale = 1.0 / (D ** 0.5)
 
-    # deq keys once per layer: [L,B,S,Hkv,D]
-    if cache.quantized:
-        kf = cache.k.astype(jnp.float32) * cache.k_scale.astype(jnp.float32)[..., None]
-    else:
-        kf = cache.k.astype(jnp.float32)
-
-    qg = q_obs.astype(jnp.float32).reshape(L, B, W, Hkv, G, D)
-    scores = jnp.einsum("lbwhgd,lbshd->lbhgws", qg, kf) * scale
-
     sj = jnp.arange(S)
     obs_start = P - W
-    # prefix slots only: valid rows of the prompt, before the obs window
+    # prefix slots only: valid rows of the prompt, before the obs window.
+    # Causal masking within the obs window is irrelevant: all prefix slots
+    # precede every obs query.
     prefix = (sj[None, :] >= start[:, None]) & (sj[None, :] < obs_start)  # [B,S]
-    # causal within the obs window is irrelevant: all prefix slots precede
-    # every obs query.
-    scores = jnp.where(prefix[None, :, None, None, None, :], scores, _NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    # zero fully-masked (softmax of all -inf ~ uniform garbage): re-mask
-    probs = jnp.where(prefix[None, :, None, None, None, :], probs, 0.0)
-    vote = probs.sum(axis=(3, 4))  # [L,B,Hkv,S] summed over group+window
-    vote = _avg_pool_1d(vote, kernel)
-    vote = jnp.where(prefix[None, :, None, :], vote, _NEG_INF)
 
-    _, idx = jax.lax.top_k(vote, keep_k)  # [L,B,Hkv,keep_k]
-    valid_sel = jnp.take_along_axis(
-        jnp.broadcast_to(prefix[None, :, None, :], vote.shape), idx, axis=-1
-    )
-    # temporal order with invalid slots pushed left (they land in the pad
-    # region delimited by the new start)
-    order_key = jnp.where(valid_sel, idx, -1)
-    perm = jnp.argsort(order_key, axis=-1)
-    idx = jnp.take_along_axis(idx, perm, axis=-1)
+    def one_layer(xs):
+        """Score, select, and compact a single layer — mapped over L so the
+        fp32 transients ([B,Hkv,G,W,S] scores + dequantized K) stay at 1/L
+        of the whole-cache footprint (the long-prompt regime this feature
+        targets; the reference also compresses layer by layer)."""
+        k_l, v_l, ks_l, vs_l, q_l = xs
+        if ks_l is not None:
+            kf = k_l.astype(jnp.float32) * ks_l.astype(jnp.float32)[..., None]
+        else:
+            kf = k_l.astype(jnp.float32)
+        qg = q_l.astype(jnp.float32).reshape(B, W, Hkv, G, D)
+        scores = jnp.einsum("bwhgd,bshd->bhgws", qg, kf) * scale
+        scores = jnp.where(prefix[:, None, None, None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        # zero fully-masked rows (softmax of all -inf ~ uniform garbage)
+        probs = jnp.where(prefix[:, None, None, None, :], probs, 0.0)
+        vote = probs.sum(axis=(2, 3))  # [B,Hkv,S] summed over group+window
+        vote = _avg_pool_1d(vote, kernel)
+        vote = jnp.where(prefix[:, None, :], vote, _NEG_INF)
 
-    def gather_sel(x):  # x [L,B,S,Hkv,*feat]
-        xt = jnp.moveaxis(x, 3, 2)  # [L,B,Hkv,S,*]
-        expand = idx.reshape(idx.shape + (1,) * (xt.ndim - 4))
-        sel = jnp.take_along_axis(xt, jnp.broadcast_to(expand, idx.shape + xt.shape[4:]), axis=3)
-        return jnp.moveaxis(sel, 2, 3)  # [L,B,keep_k,Hkv,*]
+        _, idx = jax.lax.top_k(vote, keep_k)  # [B,Hkv,keep_k]
+        valid_sel = jnp.take_along_axis(
+            jnp.broadcast_to(prefix[:, None, :], vote.shape), idx, axis=-1
+        )
+        # temporal order with invalid slots pushed left (they land in the
+        # pad region delimited by the new start)
+        order_key = jnp.where(valid_sel, idx, -1)
+        perm = jnp.argsort(order_key, axis=-1)
+        idx_sorted = jnp.take_along_axis(idx, perm, axis=-1)
 
-    def gather_obs(x):  # last W slots before P
-        return jax.lax.dynamic_slice_in_dim(x, obs_start, W, axis=2)
+        def compact(x):  # x [B,S,Hkv,*feat]
+            xt = jnp.moveaxis(x, 2, 1)  # [B,Hkv,S,*]
+            expand = idx_sorted.reshape(idx_sorted.shape + (1,) * (xt.ndim - 3))
+            sel = jnp.take_along_axis(
+                xt,
+                jnp.broadcast_to(expand, idx_sorted.shape + xt.shape[3:]),
+                axis=2,
+            )
+            sel = jnp.moveaxis(sel, 1, 2)  # [B,keep_k,Hkv,*]
+            obs = jax.lax.dynamic_slice_in_dim(x, obs_start, W, axis=1)
+            merged = jnp.concatenate([sel, obs], axis=1)  # [B,budget,Hkv,*]
+            pad = [(0, 0)] * x.ndim
+            pad[1] = (0, out_len - budget)
+            return jnp.pad(merged, pad)
 
-    def compact(x):
-        sel = gather_sel(x)
-        obs = gather_obs(x)
-        merged = jnp.concatenate([sel, obs], axis=2)  # [L,B,budget,Hkv,*]
-        pad = [(0, 0)] * x.ndim
-        pad[2] = (0, out_len - budget)
-        return jnp.pad(merged, pad)
+        return (
+            compact(k_l),
+            compact(v_l),
+            compact(ks_l) if ks_l is not None else None,
+            compact(vs_l) if vs_l is not None else None,
+        )
 
-    new_k = compact(cache.k)
-    new_v = compact(cache.v)
-    new_ks = compact(cache.k_scale) if cache.quantized else None
-    new_vs = compact(cache.v_scale) if cache.quantized else None
+    if cache.quantized:
+        new_k, new_v, new_ks, new_vs = jax.lax.map(
+            one_layer, (cache.k, cache.v, cache.k_scale, cache.v_scale, q_obs)
+        )
+    else:
+        new_k, new_v = jax.lax.map(
+            lambda t: one_layer((t[0], t[1], None, None, t[2]))[:2],
+            (cache.k, cache.v, q_obs),
+        )
+        new_ks = new_vs = None
 
     avail = jnp.maximum(obs_start - start, 0)  # prefix tokens per row
     kept = jnp.minimum(avail, keep_k)

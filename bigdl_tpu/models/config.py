@@ -128,6 +128,19 @@ class ModelConfig:
         }
         kwargs = {k: hf[k] for k in known if k in hf and hf[k] is not None}
         kwargs["model_type"] = model_type
+        rs = kwargs.get("rope_scaling")
+        if isinstance(rs, dict):
+            # longrope/su/dynamic/yarn need the context lengths, which HF
+            # stores at the TOP level of config.json (phi3: rope_scaling
+            # only carries the factor lists) — inject them.
+            rs = dict(rs)
+            for src, dst in (
+                ("original_max_position_embeddings", "original_max_position_embeddings"),
+                ("max_position_embeddings", "max_position_embeddings"),
+            ):
+                if dst not in rs and hf.get(src) is not None:
+                    rs[dst] = hf[src]
+            kwargs["rope_scaling"] = rs
         builder = _HF_BUILDERS.get(model_type)
         if builder is not None:
             builder(hf, kwargs)

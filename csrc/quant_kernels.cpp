@@ -1,0 +1,225 @@
+// Host-side block quantization kernels.
+//
+// TPU-native counterpart of the reference's native quantizers
+// (`ggml_quantize_tensor` / `ggml_quantize_tensor_rtn` and friends —
+// ctypes surface in /root/reference python/llm/src/ipex_llm/ggml/model/
+// llama/llama_cpp.py:955-1065, driven from low_bit_linear.py:104-258):
+// the checkpoint-ingest hot loop. Re-designed for our QTensor layout
+// (bigdl_tpu/quant/numerics.py): 4-bit codes packed two-per-byte along
+// the contraction axis (element 2i low nibble), float16 block scales.
+//
+// Numerics are bit-identical to the jnp reference implementation
+// (round-half-to-even code rounding, round-to-nearest-even f16 scales,
+// first-occurrence signed absmax) so the native path is a pure speedup.
+//
+// Build: g++ -O3 -march=native -fopenmp -shared -fPIC (bigdl_tpu/native.py).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---- float32 -> float16 (round-to-nearest-even), no F16C dependency ----
+static inline uint16_t f32_to_f16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  const uint32_t sign = (x >> 16) & 0x8000u;
+  x &= 0x7fffffffu;
+  if (x >= 0x47800000u) {                 // overflow or inf/nan
+    if (x > 0x7f800000u) return sign | 0x7e00u;  // nan
+    return sign | 0x7c00u;                       // inf
+  }
+  if (x < 0x38800000u) {                  // subnormal or zero
+    if (x < 0x33000000u) return sign;     // underflow to 0
+    // value = mant * 2^(e-150); f16 subnormal unit is 2^-24 → shift 126-e
+    const int shift = 126 - (int)(x >> 23);
+    uint32_t mant = (x & 0x7fffffu) | 0x800000u;
+    uint32_t half = mant >> shift;
+    const uint32_t rem = mant & ((1u << shift) - 1);
+    const uint32_t mid = 1u << (shift - 1);
+    if (rem > mid || (rem == mid && (half & 1u))) half++;
+    return sign | (uint16_t)half;
+  }
+  const uint32_t e = x + 0xc8000000u;     // rebias exponent
+  uint32_t half = e >> 13;
+  const uint32_t rem = x & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1u))) half++;
+  return sign | (uint16_t)half;
+}
+
+static inline float f16_to_f32(uint16_t h) {
+  const uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+  uint32_t em = h & 0x7fffu;
+  uint32_t x;
+  if (em >= 0x7c00u) {                    // inf/nan
+    x = sign | 0x7f800000u | ((em & 0x3ffu) << 13);
+  } else if (em >= 0x0400u) {             // normal
+    x = sign | ((em + 0x1c000u) << 13);
+  } else if (em == 0) {
+    x = sign;
+  } else {                                // subnormal
+    int e = -1;
+    uint32_t m = em;
+    while (!(m & 0x400u)) { m <<= 1; e++; }
+    m &= 0x3ffu;
+    x = sign | ((uint32_t)(112 - e) << 23) | (m << 13);
+  }
+  float f;
+  std::memcpy(&f, &x, 4);
+  return f;
+}
+
+// round-half-to-even, matching jnp.round
+static inline float rte(float x) { return std::nearbyintf(x); }
+
+// ---- sym_int4: block 32, d = signed-absmax / -8, codes in [0,15] ----
+void quantize_sym_int4(const float* x, int64_t rows, int64_t k,
+                       uint8_t* data, uint16_t* scales) {
+  const int64_t nb = k / 32;
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xr = x + r * k;
+    uint8_t* dr = data + r * (k / 2);
+    uint16_t* sr = scales + r * nb;
+    for (int64_t b = 0; b < nb; ++b) {
+      const float* xb = xr + b * 32;
+      float smax = xb[0], amax = std::fabs(xb[0]);
+      for (int j = 1; j < 32; ++j) {
+        const float a = std::fabs(xb[j]);
+        if (a > amax) { amax = a; smax = xb[j]; }
+      }
+      float d = smax / -8.0f;
+      const uint16_t dh = f32_to_f16(d);
+      sr[b] = dh;
+      const float inv = d != 0.0f ? 1.0f / d : 0.0f;
+      uint8_t* db = dr + b * 16;
+      for (int j = 0; j < 16; ++j) {
+        float q0 = rte(xb[2 * j] * inv) + 8.0f;
+        float q1 = rte(xb[2 * j + 1] * inv) + 8.0f;
+        q0 = q0 < 0 ? 0 : (q0 > 15 ? 15 : q0);
+        q1 = q1 < 0 ? 0 : (q1 > 15 ? 15 : q1);
+        db[j] = (uint8_t)q0 | ((uint8_t)q1 << 4);
+      }
+    }
+  }
+}
+
+// ---- asym_int4: block 32, d = (max-min)/15, m = min ----
+void quantize_asym_int4(const float* x, int64_t rows, int64_t k,
+                        uint8_t* data, uint16_t* scales, uint16_t* mins) {
+  const int64_t nb = k / 32;
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xr = x + r * k;
+    uint8_t* dr = data + r * (k / 2);
+    for (int64_t b = 0; b < nb; ++b) {
+      const float* xb = xr + b * 32;
+      float mn = xb[0], mx = xb[0];
+      for (int j = 1; j < 32; ++j) {
+        if (xb[j] < mn) mn = xb[j];
+        if (xb[j] > mx) mx = xb[j];
+      }
+      const float d = (mx - mn) / 15.0f;
+      scales[r * nb + b] = f32_to_f16(d);
+      mins[r * nb + b] = f32_to_f16(mn);
+      const float inv = d != 0.0f ? 1.0f / d : 0.0f;
+      uint8_t* db = dr + b * 16;
+      for (int j = 0; j < 16; ++j) {
+        float q0 = rte((xb[2 * j] - mn) * inv);
+        float q1 = rte((xb[2 * j + 1] - mn) * inv);
+        q0 = q0 < 0 ? 0 : (q0 > 15 ? 15 : q0);
+        q1 = q1 < 0 ? 0 : (q1 > 15 ? 15 : q1);
+        db[j] = (uint8_t)q0 | ((uint8_t)q1 << 4);
+      }
+    }
+  }
+}
+
+// ---- sym_int8: block 32, d = absmax / 127 ----
+void quantize_sym_int8(const float* x, int64_t rows, int64_t k,
+                       int8_t* data, uint16_t* scales) {
+  const int64_t nb = k / 32;
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xr = x + r * k;
+    int8_t* dr = data + r * k;
+    for (int64_t b = 0; b < nb; ++b) {
+      const float* xb = xr + b * 32;
+      float amax = 0.0f;
+      for (int j = 0; j < 32; ++j) {
+        const float a = std::fabs(xb[j]);
+        if (a > amax) amax = a;
+      }
+      const float d = amax / 127.0f;
+      scales[r * nb + b] = f32_to_f16(d);
+      const float inv = d != 0.0f ? 1.0f / d : 0.0f;
+      for (int j = 0; j < 32; ++j) {
+        float q = rte(xb[j] * inv);
+        q = q < -127 ? -127 : (q > 127 ? 127 : q);
+        dr[b * 32 + j] = (int8_t)q;
+      }
+    }
+  }
+}
+
+// ---- codebook (nf4/fp4): block `bs`, absmax scale, nearest entry ----
+// `boundaries` are midpoints of the sorted codebook (15 entries for 4-bit),
+// `order[i]` is the original code of sorted slot i — exactly the
+// searchsorted construction in quant/numerics.py (_codebook_tables).
+void quantize_codebook4(const float* x, int64_t rows, int64_t k, int64_t bs,
+                        const float* boundaries, const int32_t* order,
+                        float cb_absmax, uint8_t* data, uint16_t* scales) {
+  const int64_t nb = k / bs;
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xr = x + r * k;
+    uint8_t* dr = data + r * (k / 2);
+    for (int64_t b = 0; b < nb; ++b) {
+      const float* xb = xr + b * bs;
+      float amax = 0.0f;
+      for (int64_t j = 0; j < bs; ++j) {
+        const float a = std::fabs(xb[j]);
+        if (a > amax) amax = a;
+      }
+      const float scale = amax / cb_absmax;
+      scales[r * nb + b] = f32_to_f16(scale);
+      const float inv = scale != 0.0f ? 1.0f / scale : 0.0f;
+      for (int64_t j = 0; j < bs; j += 2) {
+        uint8_t codes[2];
+        for (int t = 0; t < 2; ++t) {
+          const float xn = xb[j + t] * inv;
+          // lower_bound over 15 boundaries == jnp.searchsorted side='left'
+          int lo = 0, hi = 15;
+          while (lo < hi) {
+            const int mid = (lo + hi) / 2;
+            if (boundaries[mid] < xn) lo = mid + 1; else hi = mid;
+          }
+          codes[t] = (uint8_t)order[lo];
+        }
+        dr[(b * bs + j) / 2] = codes[0] | (codes[1] << 4);
+      }
+    }
+  }
+}
+
+// ---- dequant (for tests / CPU fallbacks) ----
+void dequantize_sym_int4(const uint8_t* data, const uint16_t* scales,
+                         int64_t rows, int64_t k, float* out) {
+  const int64_t nb = k / 32;
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < rows; ++r) {
+    const uint8_t* dr = data + r * (k / 2);
+    float* yr = out + r * k;
+    for (int64_t b = 0; b < nb; ++b) {
+      const float d = f16_to_f32(scales[r * nb + b]);
+      for (int j = 0; j < 16; ++j) {
+        const uint8_t byte = dr[b * 16 + j];
+        yr[b * 32 + 2 * j] = ((int)(byte & 0xF) - 8) * d;
+        yr[b * 32 + 2 * j + 1] = ((int)(byte >> 4) - 8) * d;
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,153 @@
+"""AWQ/GPTQ import tests: pack synthetic checkpoints with the real bit
+layouts, then verify exact (lossless) mapping into asym_int4 QTensors
+(reference `transformers/convert.py:379-455` convert_gptq and
+`transformers/awq/` in /root/reference)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.convert.autoq import (
+    QuantCheckpointAdapter,
+    codes_to_qtensor,
+    dequantize_to_fp32,
+    unpack_awq,
+    unpack_gptq,
+)
+
+OUT, IN, GROUP = 8, 128, 32
+
+
+def _pack_int32(codes: np.ndarray, axis: int, order) -> np.ndarray:
+    """uint8 4-bit codes → int32, 8 per word along `axis` (inverse of the
+    importer's unpack, using the same nibble order)."""
+    codes = np.moveaxis(codes, axis, -1)
+    grouped = codes.reshape(*codes.shape[:-1], codes.shape[-1] // 8, 8)
+    word = np.zeros(grouped.shape[:-1], np.uint32)
+    for pos, src in enumerate(order):
+        word |= grouped[..., src].astype(np.uint32) << np.uint32(4 * pos)
+    return np.moveaxis(word.view(np.int32), -1, axis)
+
+
+_GPTQ_ORDER = list(range(8))
+# AutoAWQ pack order: nibble position i holds element order_map[i]
+# (the importer unpacks with the inverse map [0,4,1,5,2,6,3,7])
+_AWQ_ORDER = [0, 2, 4, 6, 1, 3, 5, 7]
+
+
+def make_gptq(rng, v2=False):
+    codes = rng.integers(0, 16, (IN, OUT), dtype=np.uint8)  # [in, out]
+    zeros = rng.integers(1, 15, (IN // GROUP, OUT), dtype=np.uint8)
+    scales = (rng.random((IN // GROUP, OUT)) * 0.1 + 0.01).astype(np.float32)
+    qweight = _pack_int32(codes, 0, _GPTQ_ORDER)
+    stored_zeros = zeros if v2 else zeros - 1  # v1 stores zero-1
+    qzeros = _pack_int32(stored_zeros, 1, _GPTQ_ORDER)
+    return codes, zeros, scales, qweight, qzeros
+
+
+def test_gptq_unpack_exact(rng):
+    codes, zeros, scales, qweight, qzeros = make_gptq(rng)
+    c, s, z = unpack_gptq(qweight, qzeros, scales.astype(np.float16))
+    np.testing.assert_array_equal(c, codes.T)
+    np.testing.assert_array_equal(z, zeros.T.astype(np.float32))
+    np.testing.assert_allclose(s, scales.T, rtol=1e-3)
+
+
+def test_gptq_v2_no_offset(rng):
+    codes, zeros, scales, qweight, _ = make_gptq(rng, v2=True)
+    qzeros = _pack_int32(zeros, 1, _GPTQ_ORDER)
+    c, s, z = unpack_gptq(qweight, qzeros, scales, v1_zero_offset=False)
+    np.testing.assert_array_equal(z, zeros.T.astype(np.float32))
+
+
+def test_awq_unpack_exact(rng):
+    codes = rng.integers(0, 16, (IN, OUT), dtype=np.uint8)
+    zeros = rng.integers(0, 16, (IN // GROUP, OUT), dtype=np.uint8)
+    scales = (rng.random((IN // GROUP, OUT)) * 0.1 + 0.01).astype(np.float32)
+    qweight = _pack_int32(codes, 1, _AWQ_ORDER)
+    qzeros = _pack_int32(zeros, 1, _AWQ_ORDER)
+    c, s, z = unpack_awq(qweight, qzeros, scales)
+    np.testing.assert_array_equal(c, codes.T)
+    np.testing.assert_array_equal(z, zeros.T.astype(np.float32))
+
+
+def test_exact_qtensor_mapping(rng):
+    """asym_int4 QTensor dequantizes to (code - zero) * scale up to the
+    f16 rounding of d/m — codes carried bit-for-bit."""
+    codes, zeros, scales, qweight, qzeros = make_gptq(rng)
+    c, s, z = unpack_gptq(qweight, qzeros, scales)
+    qt = codes_to_qtensor(c, s, z, GROUP)
+    assert qt.qtype == "asym_int4" and qt.shape == (OUT, IN)
+    want = dequantize_to_fp32(c, s, z, GROUP)
+    got = np.asarray(qt.dequantize(jnp.float32))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+    # code-level exactness: unpacked nibbles equal the gptq codes
+    from bigdl_tpu.quant.numerics import unpack_nibbles
+
+    np.testing.assert_array_equal(np.asarray(unpack_nibbles(qt.data)), c)
+
+
+def test_adapter_end_to_end(rng):
+    """A fake GPTQ llama checkpoint through params_from_state_dict: packed
+    linears arrive as asym_int4 QTensors, norms/embeds stay dense."""
+    from bigdl_tpu.convert.hf import _wrap_quantized, params_from_state_dict
+    from bigdl_tpu.models.config import ModelConfig
+    from bigdl_tpu.quant import QTensor
+
+    H = 32
+    config = ModelConfig(
+        vocab_size=64, hidden_size=H, intermediate_size=IN,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        head_dim=16,
+    )
+    sd = {}
+
+    def add_packed(base, out_f, in_f):
+        codes = rng.integers(0, 16, (in_f, out_f), dtype=np.uint8)
+        zeros = rng.integers(1, 15, (in_f // GROUP, out_f), dtype=np.uint8)
+        scales = (rng.random((in_f // GROUP, out_f)) * 0.1).astype(np.float32)
+        sd[base + ".qweight"] = _pack_int32(codes, 0, _GPTQ_ORDER)
+        sd[base + ".qzeros"] = _pack_int32(zeros - 1, 1, _GPTQ_ORDER)
+        sd[base + ".scales"] = scales
+
+    p = "model.layers.0."
+    for base, (o, i) in {
+        p + "self_attn.q_proj": (H, H), p + "self_attn.k_proj": (H, H),
+        p + "self_attn.v_proj": (H, H), p + "self_attn.o_proj": (H, H),
+        p + "mlp.gate_proj": (IN, H), p + "mlp.up_proj": (IN, H),
+        p + "mlp.down_proj": (H, IN),
+    }.items():
+        add_packed(base, o, i)
+    sd[p + "input_layernorm.weight"] = np.ones(H, np.float32)
+    sd[p + "post_attention_layernorm.weight"] = np.ones(H, np.float32)
+    sd["model.embed_tokens.weight"] = rng.standard_normal((64, H)).astype(np.float32)
+    sd["model.norm.weight"] = np.ones(H, np.float32)
+    sd["lm_head.weight"] = rng.standard_normal((64, H)).astype(np.float32)
+
+    def raw_get(name):
+        if name not in sd:
+            raise KeyError(name)
+        return sd[name]
+
+    getter, qtype = _wrap_quantized(
+        raw_get, {"quant_method": "gptq", "bits": 4, "group_size": GROUP},
+        "llama", "sym_int4",
+    )
+    assert qtype == "asym_int4"
+    params = params_from_state_dict(config, getter, qtype=qtype)
+    wq = params["layers"]["wq"]
+    assert isinstance(wq, QTensor) and wq.qtype == "asym_int4"
+    assert wq.shape == (1, H, H)
+    # lm head was dense in the checkpoint → requantized to the same qtype
+    assert params["lm_head"].qtype == "asym_int4"
+
+    # forward smoke
+    import jax
+
+    from bigdl_tpu import kvcache
+    from bigdl_tpu.models import llama
+
+    cache = kvcache.init_cache(1, 1, 16, 2, 16)
+    logits, _ = llama.forward(
+        config, params, jnp.asarray([[1, 2, 3]], jnp.int32), cache, mode="prefill"
+    )
+    assert np.all(np.isfinite(np.asarray(logits)))

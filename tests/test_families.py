@@ -186,6 +186,32 @@ def test_qwen2_moe_equivalence():
     assert config.shared_expert_intermediate_size == 64
 
 
+def test_phi3_longrope_top_level_injection():
+    """HF phi3 keeps original/max position embeddings at config top level;
+    from_hf_config must fold them into rope_scaling so the longrope
+    attention factor is applied (regression: factor was silently 1.0)."""
+    from bigdl_tpu.ops.rope import make_inv_freq_scaled
+
+    hf = {
+        "model_type": "phi3", "vocab_size": 64, "hidden_size": 64,
+        "num_hidden_layers": 1, "num_attention_heads": 4,
+        "num_key_value_heads": 4, "max_position_embeddings": 131072,
+        "original_max_position_embeddings": 4096,
+        "rope_scaling": {
+            "type": "longrope",
+            "short_factor": [1.0] * 8, "long_factor": [4.0] * 8,
+        },
+    }
+    config = ModelConfig.from_hf_config(hf)
+    rs = config.rope_scaling_dict
+    assert rs["original_max_position_embeddings"] == 4096
+    assert rs["max_position_embeddings"] == 131072
+    _, att = make_inv_freq_scaled(16, 10000.0, rs, seq_len=8192)
+    import math
+
+    assert att == pytest.approx(math.sqrt(1 + math.log(32) / math.log(4096)))
+
+
 def test_baichuan_w_pack_split_and_alibi():
     """No HF-builtin baichuan (trust_remote_code); test the W_pack ingest
     split + NormHead + the 13B-style ALiBi path shape/mask behavior."""
