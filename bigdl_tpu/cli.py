@@ -1,0 +1,140 @@
+"""bigdl-tpu command line.
+
+Role-equivalent of the reference's `llm-cli` / `llm-chat` shell dispatch
+(cli/llm-cli:25-57 in /root/reference — there it picks a per-ISA C++
+binary; here every path is the same XLA program) plus `llm_convert`
+(convert_model.py:31).
+
+    python -m bigdl_tpu.cli convert  <hf_dir> -o <out_dir> -q sym_int4
+    python -m bigdl_tpu.cli generate <model_dir> -p "..." -n 64
+    python -m bigdl_tpu.cli serve    <model_dir> --port 8000
+    python -m bigdl_tpu.cli bench    <model_dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _load(path: str, qtype: str):
+    from bigdl_tpu.api import AutoModelForCausalLM
+
+    if path.endswith(".gguf"):
+        return AutoModelForCausalLM.from_gguf(path)
+    import os
+
+    if os.path.exists(os.path.join(path, "bigdl_tpu_config.json")):
+        return AutoModelForCausalLM.load_low_bit(path)
+    return AutoModelForCausalLM.from_pretrained(path, load_in_low_bit=qtype)
+
+
+def _tokenizer(path: str):
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(path)
+    except Exception:
+        return None
+
+
+def cmd_convert(args):
+    model = _load(args.model, args.qtype)
+    model.save_low_bit(args.output)
+    print(f"saved {args.qtype} model to {args.output}")
+
+
+def cmd_generate(args):
+    model = _load(args.model, args.qtype)
+    tok = _tokenizer(args.model)
+    if tok is None:
+        ids = [int(t) for t in args.prompt.split()]
+    else:
+        ids = list(tok(args.prompt)["input_ids"])
+    t0 = time.time()
+    out = model.generate(
+        [ids], max_new_tokens=args.max_new_tokens,
+        do_sample=args.temperature > 0, temperature=max(args.temperature, 1e-5),
+        eos_token_id=(tok.eos_token_id if tok else None),
+    )
+    dt = time.time() - t0
+    toks = out[0].tolist()
+    text = tok.decode(toks, skip_special_tokens=True) if tok else str(toks)
+    print(text)
+    print(
+        f"[{len(toks)} tokens in {dt:.2f}s — {1000 * dt / max(len(toks), 1):.1f} ms/token]",
+        file=sys.stderr,
+    )
+
+
+def cmd_serve(args):
+    from bigdl_tpu.serving.api_server import ApiServer
+
+    from bigdl_tpu.generate import GenerationConfig
+
+    model = _load(args.model, args.qtype)
+    tok = _tokenizer(args.model)
+    gen = GenerationConfig(
+        eos_token_id=(tok.eos_token_id if tok is not None else None)
+    )
+    server = ApiServer(
+        model, tokenizer=tok, host=args.host,
+        port=args.port, n_slots=args.slots, max_len=args.max_len, gen=gen,
+    )
+    server.start()
+    print(f"bigdl-tpu serving {args.model} on {args.host}:{server.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+def cmd_bench(args):
+    model = _load(args.model, args.qtype)
+    ids = list(range(1, 33))
+    model.generate([ids], max_new_tokens=4)  # warm
+    t0 = time.time()
+    model.generate([ids], max_new_tokens=32)
+    dt = (time.time() - t0) / 32 * 1000
+    print(json.dumps({"metric": "decode_latency", "value": round(dt, 2),
+                      "unit": "ms/token"}))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="bigdl-tpu")
+    p.add_argument("-q", "--qtype", default="sym_int4")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("convert", help="quantize + save_low_bit")
+    c.add_argument("model")
+    c.add_argument("-o", "--output", required=True)
+    c.set_defaults(fn=cmd_convert)
+
+    g = sub.add_parser("generate", help="one-shot generation")
+    g.add_argument("model")
+    g.add_argument("-p", "--prompt", required=True)
+    g.add_argument("-n", "--max-new-tokens", type=int, default=64)
+    g.add_argument("-t", "--temperature", type=float, default=0.0)
+    g.set_defaults(fn=cmd_generate)
+
+    s = sub.add_parser("serve", help="OpenAI-compatible server")
+    s.add_argument("model")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--slots", type=int, default=8)
+    s.add_argument("--max-len", type=int, default=2048)
+    s.set_defaults(fn=cmd_serve)
+
+    b = sub.add_parser("bench", help="quick decode-latency check")
+    b.add_argument("model")
+    b.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

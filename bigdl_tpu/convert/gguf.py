@@ -382,6 +382,10 @@ def config_from_gguf(reader: GGUFReader) -> ModelConfig:
         max_position_embeddings=int(g("context_length", 4096)),
         tie_word_embeddings="output.weight" not in reader.tensors,
     )
+    # explicit head_dim (mistral-nemo style: head_dim != hidden/heads)
+    key_len = g("attention.key_length")
+    if key_len:
+        kwargs["head_dim"] = int(key_len)
     # rope scaling metadata ({arch}.rope.scaling.*): linear / yarn
     sc_type = g("rope.scaling.type")
     sc_factor = g("rope.scaling.factor")

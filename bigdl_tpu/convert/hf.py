@@ -422,7 +422,12 @@ def load_hf_checkpoint(
                 os.path.join(model_path, shard), framework="pt"
             )
         t = handles[shard].get_tensor(name)
-        return t.to(dtype=torch.float32).numpy()
+        if t.is_floating_point():
+            return t.to(dtype=torch.float32).numpy()
+        # integer tensors (GPTQ/AWQ packed qweight/qzeros, g_idx) must keep
+        # their dtype: float32 has 24 mantissa bits and silently corrupts
+        # packed int32 words
+        return t.numpy()
 
     quant_config = hf_config.get("quantization_config")
     if quant_config:

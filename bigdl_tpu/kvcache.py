@@ -45,7 +45,10 @@ class KVCache:
     v: jax.Array
     k_scale: Optional[jax.Array]  # [L, B, S, Hkv] f16 when quantized, else None
     v_scale: Optional[jax.Array]
-    pos: jax.Array  # scalar int32: next write slot (shared across batch)
+    # next write slot: scalar int32 (rows aligned — generate path) or [B]
+    # int32 (per-row — the serving engine's continuous batching, where each
+    # slot's sequence has its own length; decode writes become scatters)
+    pos: jax.Array
     start: jax.Array  # [B] int32: first valid slot per row (left padding)
     # [B] int32 rope position of the token written at slot `pos`, when it
     # differs from (pos - start) — i.e. after SnapKV compression. None =
@@ -70,7 +73,8 @@ class KVCache:
         step = jnp.arange(t, dtype=jnp.int32)[None, :]
         if self.rope_base is not None:
             return self.rope_base[:, None] + step
-        return jnp.maximum(self.pos + step - self.start[:, None], 0)
+        pos = self.pos[:, None] if self.pos.ndim == 1 else self.pos
+        return jnp.maximum(pos + step - self.start[:, None], 0)
 
 
 def init_cache(
@@ -108,29 +112,58 @@ def _quantize_heads(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return codes, scale.astype(jnp.float16)
 
 
+def _scatter_rows(buf: jax.Array, layer: jax.Array, pos: jax.Array,
+                  val: jax.Array) -> jax.Array:
+    """buf [L,B,S,...] ← val [B,T,...] at row-dependent slots pos[b]+t.
+    Per-row scatter (serving engine decode, T normally 1); XLA performs it
+    in place when the buffer is donated."""
+    B, T = val.shape[:2]
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    cols = pos[:, None] + jnp.arange(T)[None, :]
+    layer_b = jnp.broadcast_to(layer, (B, T))
+    return buf.at[layer_b, rows, cols].set(val.astype(buf.dtype), mode="drop")
+
+
 def update_layer(
     cache: KVCache, layer: jax.Array, k_new: jax.Array, v_new: jax.Array
 ) -> KVCache:
     """Write k_new/v_new [B,T,Hkv,D] into layer `layer` at cache.pos.
 
     Does NOT advance pos (the model advances it once per forward, after the
-    layer scan). jit-safe with traced `layer` and `cache.pos`.
+    layer scan). jit-safe with traced `layer` and `cache.pos`. Scalar pos
+    writes one contiguous slice; per-row pos scatters row by row.
     """
-    idx = (layer, 0, cache.pos, 0, 0)
+    per_row = cache.pos.ndim == 1
     if cache.quantized:
         kq, ks = _quantize_heads(k_new)
         vq, vs = _quantize_heads(v_new)
-        k = jax.lax.dynamic_update_slice(cache.k, kq[None], idx)
-        v = jax.lax.dynamic_update_slice(cache.v, vq[None], idx)
-        k_scale = jax.lax.dynamic_update_slice(
-            cache.k_scale, ks[None], (layer, 0, cache.pos, 0)
-        )
-        v_scale = jax.lax.dynamic_update_slice(
-            cache.v_scale, vs[None], (layer, 0, cache.pos, 0)
-        )
+        if per_row:
+            k = _scatter_rows(cache.k, layer, cache.pos, kq)
+            v = _scatter_rows(cache.v, layer, cache.pos, vq)
+            k_scale = _scatter_rows(cache.k_scale, layer, cache.pos, ks)
+            v_scale = _scatter_rows(cache.v_scale, layer, cache.pos, vs)
+        else:
+            idx = (layer, 0, cache.pos, 0, 0)
+            k = jax.lax.dynamic_update_slice(cache.k, kq[None], idx)
+            v = jax.lax.dynamic_update_slice(cache.v, vq[None], idx)
+            k_scale = jax.lax.dynamic_update_slice(
+                cache.k_scale, ks[None], (layer, 0, cache.pos, 0)
+            )
+            v_scale = jax.lax.dynamic_update_slice(
+                cache.v_scale, vs[None], (layer, 0, cache.pos, 0)
+            )
         return dataclasses.replace(cache, k=k, v=v, k_scale=k_scale, v_scale=v_scale)
-    k = jax.lax.dynamic_update_slice(cache.k, k_new[None].astype(cache.k.dtype), idx)
-    v = jax.lax.dynamic_update_slice(cache.v, v_new[None].astype(cache.v.dtype), idx)
+    if per_row:
+        k = _scatter_rows(cache.k, layer, cache.pos, k_new)
+        v = _scatter_rows(cache.v, layer, cache.pos, v_new)
+    else:
+        idx = (layer, 0, cache.pos, 0, 0)
+        k = jax.lax.dynamic_update_slice(
+            cache.k, k_new[None].astype(cache.k.dtype), idx
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache.v, v_new[None].astype(cache.v.dtype), idx
+        )
     return dataclasses.replace(cache, k=k, v=v)
 
 
@@ -201,6 +234,7 @@ def compress(
     G = Hq // Hkv
     keep_k = budget - W
     assert keep_k > 0, "budget must exceed the observation window"
+    assert cache.pos.ndim == 0, "compress expects an aligned (scalar-pos) cache"
 
     P = cache.pos  # prompt end (next slot)
     start = cache.start

@@ -176,6 +176,35 @@ def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
     return jnp.tanh(x / cap) * cap
 
 
+def embed_tokens(config: ModelConfig, params: Params, tokens: jax.Array,
+                 compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Token embedding incl. the gemma/minicpm scaling knobs — shared by
+    forward() and the pipeline stage program (parallel/pipeline.py)."""
+    h = params["embed"].astype(compute_dtype)[tokens]
+    if config.scale_embeddings:
+        h = h * jnp.asarray(config.hidden_size**0.5, compute_dtype)
+    if config.embedding_scale:
+        h = h * jnp.asarray(config.embedding_scale, compute_dtype)
+    return h
+
+
+def lm_head_logits(config: ModelConfig, params: Params, h: jax.Array,
+                   compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Final norm + lm head + logit scaling/softcap — shared by forward()
+    and the pipeline stage program."""
+    if config.norm_type == "layernorm":
+        h = layer_norm(h, params["final_norm"], params.get("final_norm_b"),
+                       config.rms_norm_eps)
+    else:
+        h = rms_norm(h, params["final_norm"], config.rms_norm_eps,
+                     offset=config.rms_norm_offset)
+    lm_head = params.get("lm_head", params["embed"])
+    logits = linear(h, lm_head, None, compute_dtype).astype(jnp.float32)
+    if config.logit_scale:
+        logits = logits * config.logit_scale
+    return _softcap(logits, config.final_logit_softcap)
+
+
 def _lora_delta(x, pair, scale, compute_dtype):
     """x [.., in] through a LoRA pair {'a': [r, in], 'b': [out, r]}."""
     a, b = pair["a"], pair["b"]
@@ -248,6 +277,11 @@ def forward(
     lora: Optional[Params] = None,  # LoRA adapter tree (see bigdl_tpu.train)
     start: Optional[jax.Array] = None,  # [B] pad offsets when cache is None
     collect_obs: int = 0,  # static: stash the last-N rotated queries per layer
+    attention_override=None,  # static: fn(q, k, v, start) for the cache-free
+    # path — e.g. sequence-parallel ring attention (parallel/ring.py)
+    input_is_hidden: bool = False,  # static: tokens is [B,T,H] hidden states
+    return_hidden: bool = False,  # static: skip final norm/head, return h
+    layer_offset=0,  # global index of params['layers'][0] (pipeline stages)
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache with pos advanced).
 
@@ -258,9 +292,13 @@ def forward(
     collect_obs=W > 0 (prefill only) additionally returns the observation
     window queries [L, B, W, Hq, D] for SnapKV compression
     (kvcache.compress) as a third element.
+
+    input_is_hidden/return_hidden let a pipeline stage run only its slice
+    of the layer stack (parallel/pipeline.py): embedding happens before
+    the first stage, final norm + lm head after the last.
     """
     assert mode in ("prefill", "decode")
-    B, T = tokens.shape
+    B, T = tokens.shape[:2]
     Hq, Hkv, D = config.num_attention_heads, config.num_key_value_heads, config.head_dim_
     eps = config.rms_norm_eps
 
@@ -276,16 +314,16 @@ def forward(
         pos0 = cache.pos
         row_start = cache.start
 
-    h = params["embed"].astype(compute_dtype)[tokens]
-    if config.scale_embeddings:
-        h = h * jnp.asarray(config.hidden_size**0.5, compute_dtype)
-    if config.embedding_scale:
-        h = h * jnp.asarray(config.embedding_scale, compute_dtype)
+    if input_is_hidden:
+        h = tokens.astype(compute_dtype)
+    else:
+        h = embed_tokens(config, params, tokens, compute_dtype)
 
     # Rotary tables: positions are relative to each row's start (left pad);
     # after SnapKV compression slots ≠ positions and the cache carries the
-    # true next position in rope_base.
-    slots = pos0 + jnp.arange(T)[None, :]  # [1, T] global cache slots
+    # true next position in rope_base. pos may be per-row (serving engine).
+    pos_col = pos0[:, None] if pos0.ndim == 1 else pos0
+    slots = pos_col + jnp.arange(T)[None, :]  # [B|1, T] global cache slots
     if cache is not None:
         positions = cache.next_positions(T)  # [B, T]
     else:
@@ -312,6 +350,7 @@ def forward(
     use_flash = (
         cache is not None and mode == "prefill" and T > 1 and use_pallas()
         and uniform_window and not config.alibi
+        and cache.pos.ndim == 0  # kernel takes a scalar q_offset
     )
 
     # Attention masks (shared by all layers, computed once outside the scan).
@@ -392,7 +431,9 @@ def forward(
             k_att = k.astype(compute_dtype)
             v_att = v.astype(compute_dtype)
 
-        if use_flash:
+        if attention_override is not None and c is None:
+            attn = attention_override(q, k_att, v_att, row_start)
+        elif use_flash:
             from bigdl_tpu.ops.pallas import flash_attention
 
             attn = flash_attention(
@@ -401,7 +442,7 @@ def forward(
                 scale=config.attn_scale,
             )
         else:
-            is_sliding = sliding_flags[idx]
+            is_sliding = sliding_flags[layer_offset + idx]
             mask = jnp.where(is_sliding, mask_sliding, mask_global)
             if alibi_bias is not None:
                 mask = jnp.where(mask, alibi_bias, _NEG_INF)
@@ -437,12 +478,10 @@ def forward(
         body, (h, cache, jnp.zeros((), jnp.int32)), xs
     )
 
-    h = norm(h, params["final_norm"], params.get("final_norm_b"))
-    lm_head = params.get("lm_head", params["embed"])
-    logits = linear(h, lm_head, None, compute_dtype).astype(jnp.float32)
-    if config.logit_scale:
-        logits = logits * config.logit_scale
-    logits = _softcap(logits, config.final_logit_softcap)
+    if return_hidden:
+        logits = h
+    else:
+        logits = lm_head_logits(config, params, h, compute_dtype)
     if cache is not None:
         cache = kvcache.advance(cache, T)
     if collect_obs:

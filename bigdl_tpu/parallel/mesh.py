@@ -41,13 +41,15 @@ def mesh_shape_for(
 
 
 def make_mesh(
-    shape: Optional[tuple[int, int, int]] = None,
+    shape: Optional[tuple[int, ...]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    axes: Sequence[str] = AXES,
 ) -> Mesh:
-    """Create a (dp, sp, tp) mesh. tp is the fastest-varying axis so that
+    """Create a mesh (default axes (dp, sp, tp); pass axes=("pp", ...) etc.
+    for pipeline topologies). The last axis is fastest-varying so that
     tensor-parallel collectives ride neighboring ICI links."""
     devices = list(devices if devices is not None else jax.devices())
     if shape is None:
         shape = mesh_shape_for(len(devices))
     arr = np.asarray(devices).reshape(shape)
-    return Mesh(arr, AXES)
+    return Mesh(arr, tuple(axes))

@@ -1,0 +1,142 @@
+"""Pipeline parallelism over a `pp` mesh axis.
+
+TPU-native re-design of the reference's pipeline-parallel inference
+(`transformers/pipeline_parallel.py:166-234` stage slicing with
+Dummy layers, `:300-446` p2p send/recv token loop over oneCCL in
+/root/reference): stages are shards of the **stacked layer axis** (the
+same leading-L layout `lax.scan` iterates), microbatches flow stage to
+stage via `ppermute` inside one jitted SPMD program — no process groups,
+no explicit send/recv, and the whole GPipe schedule (fill, steady state,
+drain: n_micro + n_stages - 1 ticks) compiles into a single XLA loop
+with compute/ICI overlap.
+
+This covers the scoring/training forward (cache-free path). For decode,
+tensor parallelism over ICI dominates PP on TPU slices — PP's niche is
+multi-slice/DCN topologies, where the same ppermute schedule applies to
+the decode step with per-stage KV caches (planned).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.quant import QTensor
+
+
+def pipeline_param_specs(params: dict, axis: str = "pp") -> dict:
+    """PartitionSpec tree: layer-stack leaves sharded on their leading L
+    axis over `axis`; embed/head/final norm replicated (they run on the
+    edge stages). QTensor nodes expand field-wise."""
+    is_node = lambda x: isinstance(x, (QTensor, jax.Array))
+
+    def expand(spec, param):
+        if isinstance(param, QTensor):
+            return QTensor(
+                data=spec, scales=spec,
+                mins=None if param.mins is None else spec, qtype=param.qtype,
+            )
+        return spec
+
+    specs = {
+        k: jax.tree.map(
+            lambda _: P(axis) if k == "layers" else P(), v, is_leaf=is_node
+        )
+        for k, v in params.items()
+    }
+    return jax.tree.map(expand, specs, params, is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_for_pipeline(params: dict, mesh: Mesh, axis: str = "pp") -> dict:
+    """Place a param tree with the layer stack split across pp stages."""
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        pipeline_param_specs(params, axis),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(params, shardings)
+
+
+def make_pipeline_forward(
+    config: ModelConfig,
+    forward_fn: Callable,  # family forward (models.llama.forward)
+    mesh: Mesh,
+    n_micro: int,
+    axis: str = "pp",
+    compute_dtype=jnp.bfloat16,
+):
+    """Returns fn(params, tokens [B,T], start [B]|None) -> logits
+    [B,T,V] float32, with params layer-sharded over `axis`
+    (shard_for_pipeline) and B divisible by n_micro.
+    """
+    n_stages = mesh.shape[axis]
+    L = config.num_hidden_layers
+    assert L % n_stages == 0, f"layers {L} not divisible by {n_stages} stages"
+    L_local = L // n_stages
+    perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
+
+    from bigdl_tpu.models.llama import embed_tokens, lm_head_logits
+
+    def stage_fn(params, tokens, start):
+        s = jax.lax.axis_index(axis)
+        B, T = tokens.shape
+        Bm = B // n_micro
+        toks_mb = tokens.reshape(n_micro, Bm, T)
+        start_mb = start.reshape(n_micro, Bm)
+        H = config.hidden_size
+
+        n_ticks = n_micro + n_stages - 1
+        outs0 = jnp.zeros((n_micro, Bm, T, H), compute_dtype)
+        recv0 = jnp.zeros((Bm, T, H), compute_dtype)
+
+        def tick(carry, t):
+            recv, outs = carry
+            m = t - s  # microbatch index at this stage this tick
+            active = (m >= 0) & (m < n_micro)
+            mi = jnp.clip(m, 0, n_micro - 1)
+            toks_m = toks_mb[mi]
+            start_m = start_mb[mi]
+            # stage 0 embeds; later stages consume the ppermuted hidden
+            h_in = jnp.where(
+                s == 0, embed_tokens(config, params, toks_m, compute_dtype), recv
+            )
+            h_out, _ = forward_fn(
+                config, params, h_in, None, compute_dtype=compute_dtype,
+                start=start_m, input_is_hidden=True, return_hidden=True,
+                layer_offset=s * L_local,
+            )
+            outs = jnp.where(
+                active & (s == n_stages - 1),
+                outs.at[mi].set(h_out),
+                outs,
+            )
+            send = jax.lax.ppermute(h_out, axis, perm_fwd)
+            return (send, outs), None
+
+        (recv, outs), _ = jax.lax.scan(tick, (recv0, outs0), jnp.arange(n_ticks))
+        # only the last stage holds real hiddens (zeros elsewhere): psum the
+        # [B,T,H] hidden — V/H times less ICI traffic than psumming logits —
+        # then run the replicated head locally on the identical summed value.
+        h_final = jax.lax.psum(
+            jnp.where(s == n_stages - 1, outs.reshape(B, T, H), 0.0), axis
+        )
+        return lm_head_logits(config, params, h_final, compute_dtype)
+
+    def fn(params, tokens, start=None):
+        if start is None:
+            start = jnp.zeros((tokens.shape[0],), jnp.int32)
+        sharded = jax.shard_map(
+            stage_fn,
+            mesh=mesh,
+            in_specs=(pipeline_param_specs(params, axis), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return sharded(params, tokens, start)
+
+    return fn

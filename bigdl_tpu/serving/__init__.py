@@ -1,0 +1,7 @@
+"""Serving stack (reference: `serving/fastapi/` lightweight OpenAI server
++ the PPModelWorker continuous-batching scheduler,
+pipeline_parallel.py:482-929 in /root/reference)."""
+
+from bigdl_tpu.serving.engine import InferenceEngine, Request
+
+__all__ = ["InferenceEngine", "Request"]

@@ -1,0 +1,266 @@
+"""OpenAI-compatible HTTP server over the continuous-batching engine.
+
+Role-equivalent of the reference's lightweight FastAPI server
+(`serving/fastapi/api_server.py:245-434` in /root/reference: /generate,
+/generate_stream, /v1/chat/completions, /v1/completions, plus the
+`ModelWorker.process_step` batching loop in model_worker.py:28-200), built
+on the standard library's threading HTTP server — the runtime has zero
+third-party serving dependencies; the engine thread IS the worker loop.
+
+Endpoints:
+    GET  /health                     {"status": "ok"}
+    POST /generate                   {"prompt": str|[int], "max_new_tokens"}
+    POST /generate_stream            same, server-sent events
+    POST /v1/completions             OpenAI completion schema (subset)
+    POST /v1/chat/completions        OpenAI chat schema (subset), streaming
+
+Text prompts need a tokenizer (pass tokenizer= or a HF model_path);
+token-id list prompts work without one.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from bigdl_tpu.serving.engine import InferenceEngine
+
+
+class _EngineThread(threading.Thread):
+    def __init__(self, engine: InferenceEngine):
+        super().__init__(daemon=True)
+        self.engine = engine
+        self.stop_flag = threading.Event()
+
+    def run(self):
+        while not self.stop_flag.is_set():
+            try:
+                busy = self.engine.step()
+            except Exception as e:  # noqa: BLE001
+                # fail everything in flight so clients unblock, then keep
+                # serving (a poisoned request must not kill the server)
+                self.engine.fail_all(f"engine error: {e}")
+                busy = False
+            if not busy:
+                time.sleep(0.002)
+
+
+class ApiServer:
+    def __init__(
+        self,
+        model,
+        tokenizer=None,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        n_slots: int = 8,
+        max_len: int = 1024,
+        gen=None,
+    ):
+        self.engine = InferenceEngine(model, n_slots=n_slots, max_len=max_len, gen=gen)
+        self.tokenizer = tokenizer
+        self.worker = _EngineThread(self.engine)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, obj: Any):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    return self._json(200, {"status": "ok"})
+                return self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except Exception as e:
+                    return self._json(400, {"error": f"bad json: {e}"})
+                try:
+                    if self.path == "/generate":
+                        return self._generate(payload, stream=False)
+                    if self.path == "/generate_stream":
+                        return self._generate(payload, stream=True)
+                    if self.path == "/v1/completions":
+                        return self._completions(payload)
+                    if self.path == "/v1/chat/completions":
+                        return self._chat(payload)
+                except Exception as e:  # noqa: BLE001
+                    return self._json(500, {"error": str(e)})
+                return self._json(404, {"error": "not found"})
+
+            # ---- endpoint bodies ----
+            def _generate(self, payload, stream: bool):
+                ids = outer._encode(payload.get("prompt", payload.get("inputs", "")))
+                maxnt = int(payload.get("max_new_tokens", payload.get("max_tokens", 64)))
+                if stream:
+                    q: queue.SimpleQueue = queue.SimpleQueue()
+                    req = outer.engine.submit(ids, maxnt, stream=q)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.end_headers()
+                    for tok in outer._stream_iter(q):
+                        text = outer._decode_tok([tok])
+                        evt = json.dumps({"token": tok, "text": text})
+                        self.wfile.write(f"data: {evt}\n\n".encode())
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    return None
+                req = outer.engine.submit(ids, maxnt)
+                outer._wait(req)
+                if req.error:
+                    return self._json(500, {"error": req.error})
+                if not req.done:
+                    return self._json(504, {"error": "generation timed out"})
+                return self._json(200, {
+                    "tokens": req.out_tokens,
+                    "text": outer._decode_tok(req.out_tokens),
+                })
+
+            def _completions(self, payload):
+                ids = outer._encode(payload.get("prompt", ""))
+                maxnt = int(payload.get("max_tokens", 64))
+                req = outer.engine.submit(ids, maxnt)
+                outer._wait(req)
+                if req.error:
+                    return self._json(500, {"error": req.error})
+                if not req.done:
+                    return self._json(504, {"error": "generation timed out"})
+                return self._json(200, {
+                    "id": f"cmpl-{uuid.uuid4().hex[:12]}",
+                    "object": "text_completion",
+                    "created": int(time.time()),
+                    "model": payload.get("model", "bigdl-tpu"),
+                    "choices": [{
+                        "index": 0,
+                        "text": outer._decode_tok(req.out_tokens),
+                        "finish_reason": req.finish_reason or "length",
+                    }],
+                    "usage": {
+                        "prompt_tokens": len(ids),
+                        "completion_tokens": len(req.out_tokens),
+                        "total_tokens": len(ids) + len(req.out_tokens),
+                    },
+                })
+
+            def _chat(self, payload):
+                messages = payload.get("messages", [])
+                ids = outer._encode_chat(messages)
+                maxnt = int(payload.get("max_tokens", 64))
+                if payload.get("stream"):
+                    q: queue.SimpleQueue = queue.SimpleQueue()
+                    outer.engine.submit(ids, maxnt, stream=q)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.end_headers()
+                    cid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+                    for tok in outer._stream_iter(q):
+                        chunk = {
+                            "id": cid, "object": "chat.completion.chunk",
+                            "choices": [{
+                                "index": 0,
+                                "delta": {"content": outer._decode_tok([tok])},
+                            }],
+                        }
+                        self.wfile.write(
+                            f"data: {json.dumps(chunk)}\n\n".encode()
+                        )
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    return None
+                req = outer.engine.submit(ids, maxnt)
+                outer._wait(req)
+                if req.error:
+                    return self._json(500, {"error": req.error})
+                if not req.done:
+                    return self._json(504, {"error": "generation timed out"})
+                return self._json(200, {
+                    "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+                    "object": "chat.completion",
+                    "created": int(time.time()),
+                    "model": payload.get("model", "bigdl-tpu"),
+                    "choices": [{
+                        "index": 0,
+                        "message": {
+                            "role": "assistant",
+                            "content": outer._decode_tok(req.out_tokens),
+                        },
+                        "finish_reason": req.finish_reason or "length",
+                    }],
+                })
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    # ---- helpers ----------------------------------------------------------
+
+    def _encode(self, prompt) -> list[int]:
+        if isinstance(prompt, list):
+            return [int(t) for t in prompt]
+        if self.tokenizer is None:
+            raise ValueError("text prompt but no tokenizer configured")
+        return list(self.tokenizer(prompt)["input_ids"])
+
+    def _encode_chat(self, messages) -> list[int]:
+        if self.tokenizer is not None and hasattr(
+            self.tokenizer, "apply_chat_template"
+        ):
+            return list(self.tokenizer.apply_chat_template(
+                messages, add_generation_prompt=True
+            ))
+        # tokenizer-less fallback: messages may carry raw token ids
+        ids: list[int] = []
+        for m in messages:
+            c = m.get("content")
+            if isinstance(c, list):
+                ids.extend(int(t) for t in c)
+            else:
+                ids.extend(self._encode(c))
+        return ids
+
+    def _decode_tok(self, tokens: list[int]) -> str:
+        if self.tokenizer is None:
+            return ""
+        return self.tokenizer.decode(tokens, skip_special_tokens=True)
+
+    def _stream_iter(self, q, timeout: float = 300.0):
+        """Yield tokens until the None sentinel; a timeout (e.g. dead
+        engine before fail_all delivered sentinels) ends the stream rather
+        than blocking the handler thread forever."""
+        while True:
+            try:
+                tok = q.get(timeout=timeout)
+            except queue.Empty:
+                return
+            if tok is None:
+                return
+            yield tok
+
+    def _wait(self, req, timeout: float = 300.0):
+        t0 = time.time()
+        while not req.done and time.time() - t0 < timeout:
+            time.sleep(0.005)
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self.worker.start()
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        return self
+
+    def shutdown(self):
+        self.worker.stop_flag.set()
+        self.httpd.shutdown()

@@ -1,0 +1,280 @@
+"""Slot-based continuous-batching inference engine.
+
+TPU-native re-design of the reference's serving scheduler
+(`PPModelWorker.process_step`, pipeline_parallel.py:482-929 in
+/root/reference: dynamic batching with `max_num_seqs`, split prefill,
+per-rank p2p hops; and `serving/fastapi/model_worker.py:28-200`'s async
+queue loop). Here the whole batch lives in ONE static-shape XLA program:
+
+- a fixed pool of `n_slots` decode slots shares one KV cache with
+  **per-row write positions** (kvcache.KVCache with pos: [B]);
+- prefill runs per request on bucketed lengths (its own small cache),
+  then a jitted `insert` copies the prompt KV into the slot's rows —
+  so a new request joins mid-flight without recompiling or disturbing
+  running rows (the reference's "dynamic batching" without its Python
+  per-step re-batching);
+- one jitted `decode_step` advances every active slot one token and
+  samples on device; idle slots compute masked garbage (the static-shape
+  price, paid instead of recompilation).
+
+The host-side loop (`step()`) only moves tokens in/out and does
+bookkeeping — the reference's asyncio request queue maps onto it
+directly (serving/api_server.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import queue
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.generate import GenerationConfig, sample_token
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.utils import round_up
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int = 64
+    # filled by the engine
+    out_tokens: list[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    finish_reason: str = ""  # "stop" (EOS) | "length" (budget) | "error"
+    error: Optional[str] = None
+    stream: Optional[queue.SimpleQueue] = None  # receives (token|None=EOS)
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Optional[Request] = None
+    remaining: int = 0
+
+
+class InferenceEngine:
+    """model: a TpuModel (api.py). Greedy/sampled decoding per request is
+    limited to one shared GenerationConfig per engine for now (sampling
+    params are static to the jitted step)."""
+
+    def __init__(
+        self,
+        model,
+        n_slots: int = 8,
+        max_len: int = 1024,
+        gen: Optional[GenerationConfig] = None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.config: ModelConfig = model.config
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.gen = gen or GenerationConfig()
+        self._rng = jax.random.PRNGKey(seed)
+        self._queue: "queue.SimpleQueue[Request]" = queue.SimpleQueue()
+        self._slots = [_Slot() for _ in range(n_slots)]
+        self._rid = itertools.count()
+
+        cfg = self.config
+        self.cache = kvcache.init_cache(
+            cfg.num_hidden_layers, n_slots, max_len,
+            cfg.num_key_value_heads, cfg.head_dim_,
+        )
+        # per-row positions from the start (idle rows park at 0)
+        self.cache = dataclasses.replace(
+            self.cache, pos=jnp.zeros((n_slots,), jnp.int32)
+        )
+        self.cur = jnp.zeros((n_slots,), jnp.int32)  # last token per slot
+        self.active = np.zeros((n_slots,), bool)  # host-side mask
+
+        self._decode = jax.jit(
+            functools.partial(self._decode_impl, self.model.family.forward),
+            static_argnames=("gen",),
+            donate_argnames=("cache",),
+        )
+        self._prefill = jax.jit(
+            functools.partial(self._prefill_impl, self.model.family.forward),
+            static_argnames=("bucket",),
+        )
+        self._insert = jax.jit(self._insert_impl, donate_argnames=("cache",))
+
+    # ---- jitted pieces ----------------------------------------------------
+
+    def _prefill_impl(self, forward, params, tokens, start, bucket):
+        """Single-request prefill on its own scalar-pos cache."""
+        cfg = self.config
+        cache = kvcache.init_cache(
+            cfg.num_hidden_layers, 1, bucket, cfg.num_key_value_heads,
+            cfg.head_dim_,
+        )
+        cache = dataclasses.replace(cache, start=start)
+        logits, cache = forward(cfg, params, tokens, cache, mode="prefill")
+        return logits[:, -1], cache
+
+    def _insert_impl(self, cache, pcache, slot, pad):
+        """Copy a prefilled request's KV (length `bucket`) into slot row at
+        slots [0, bucket); per-row pos/start updated."""
+        bucket = pcache.k.shape[2]
+        k = jax.lax.dynamic_update_slice(
+            cache.k, pcache.k, (0, slot, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache.v, pcache.v, (0, slot, 0, 0, 0)
+        )
+        pos = cache.pos.at[slot].set(bucket)
+        start = cache.start.at[slot].set(pad)
+        return dataclasses.replace(cache, k=k, v=v, pos=pos, start=start)
+
+    def _decode_impl(self, forward, params, cur, cache, key, gen):
+        logits, cache = forward(
+            self.config, params, cur[:, None], cache, mode="decode"
+        )
+        nxt = sample_token(logits[:, -1], key, gen)
+        return nxt, cache
+
+    # ---- host API ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: list[int],
+        max_new_tokens: int = 64,
+        stream: Optional[queue.SimpleQueue] = None,
+    ) -> Request:
+        # the decode window must fit the cache alongside a minimal prompt
+        # bucket; clamp instead of letting _admit derive a zero/negative
+        # bucket (which would crash the engine thread)
+        max_new_tokens = max(1, min(max_new_tokens, self.max_len - 16))
+        req = Request(
+            rid=next(self._rid), prompt=list(prompt),
+            max_new_tokens=max_new_tokens, stream=stream,
+        )
+        self._queue.put(req)
+        return req
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s.req is None:
+                return i
+        return None
+
+    def _admit(self) -> None:
+        while True:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            # decode writes land at [bucket, bucket + max_new_tokens): keep
+            # that window inside the cache, tail-truncating over-long prompts
+            limit = self.max_len - req.max_new_tokens
+            bucket = min(round_up(max(len(req.prompt), 16), 64), limit)
+            if len(req.prompt) > bucket:
+                req.prompt = req.prompt[-bucket:]
+            tokens = np.full((1, bucket), self.gen.pad_token_id, np.int32)
+            tokens[0, bucket - len(req.prompt):] = req.prompt
+            pad = bucket - len(req.prompt)
+            logits_last, pcache = self._prefill(
+                self.model.params, jnp.asarray(tokens),
+                jnp.asarray([pad], jnp.int32), bucket=bucket,
+            )
+            self._rng, k = jax.random.split(self._rng)
+            first = int(sample_token(logits_last, k, self.gen)[0])
+            self.cache = self._insert(
+                self.cache, pcache, jnp.asarray(slot), jnp.asarray(pad)
+            )
+            self.cur = self.cur.at[slot].set(first)
+            self._slots[slot] = _Slot(req=req, remaining=req.max_new_tokens - 1)
+            self.active[slot] = True
+            self._emit(slot, first)
+
+    def _emit(self, slot: int, token: int) -> None:
+        s = self._slots[slot]
+        s.req.out_tokens.append(token)
+        if s.req.stream is not None:
+            s.req.stream.put(token)
+        eos = self.gen.eos_token_id
+        if eos is not None and token == eos:
+            self._finish(slot, "stop")
+        elif s.remaining <= 0:
+            self._finish(slot, "length")
+
+    def _finish(self, slot: int, reason: str = "stop") -> None:
+        s = self._slots[slot]
+        s.req.finish_reason = reason
+        s.req.done = True
+        if s.req.stream is not None:
+            s.req.stream.put(None)
+        self._slots[slot] = _Slot()
+        self.active[slot] = False
+
+    def _reset_state(self) -> None:
+        """Rebuild the (possibly donated-away) cache after a failed decode
+        so the engine can keep serving new requests."""
+        cfg = self.config
+        self.cache = kvcache.init_cache(
+            cfg.num_hidden_layers, self.n_slots, self.max_len,
+            cfg.num_key_value_heads, cfg.head_dim_,
+        )
+        self.cache = dataclasses.replace(
+            self.cache, pos=jnp.zeros((self.n_slots,), jnp.int32)
+        )
+        self.cur = jnp.zeros((self.n_slots,), jnp.int32)
+        self.active[:] = False
+
+    def step(self) -> bool:
+        """Admit queued requests, advance every active slot one token.
+        Returns True if any work remains."""
+        self._admit()
+        if not self.active.any():
+            return not self._queue.empty()
+        self._rng, k = jax.random.split(self._rng)
+        try:
+            nxt, self.cache = self._decode(
+                self.model.params, self.cur, self.cache, k, self.gen
+            )
+        except Exception:
+            # the donated cache buffer is gone — rebuild before re-raising
+            # (the server's guard fails the in-flight requests)
+            self.fail_all("decode step failed")
+            self._reset_state()
+            raise
+        self.cur = nxt
+        toks = np.asarray(nxt)
+        for i in np.nonzero(self.active)[0]:
+            s = self._slots[int(i)]
+            s.remaining -= 1
+            self._emit(int(i), int(toks[i]))
+        return True
+
+    def fail_all(self, msg: str) -> None:
+        """Mark every in-flight and queued request failed (engine-thread
+        crash path — streams get their sentinel so clients unblock)."""
+        for i, s in enumerate(self._slots):
+            if s.req is not None:
+                s.req.error = msg
+                self._finish(i, "error")
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = msg
+            req.finish_reason = "error"
+            req.done = True
+            if req.stream is not None:
+                req.stream.put(None)
+        self.active[:] = False
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        for _ in range(max_steps):
+            if not self.step():
+                return

@@ -1,5 +1,5 @@
 """Finetuning on quantized bases (reference L6: qlora.py, relora.py,
-lisa.py — SURVEY.md §2.2)."""
+lisa.py, DPO example recipe — SURVEY.md §2.2)."""
 
 from bigdl_tpu.train.qlora import (
     init_lora,
@@ -7,5 +7,28 @@ from bigdl_tpu.train.qlora import (
     merge_lora,
     next_token_loss,
 )
+from bigdl_tpu.train.recipes import (
+    ReLoRASchedule,
+    ReLoRAState,
+    apply_layer_mask,
+    make_full_train_step,
+    relora_reset,
+    sample_lisa_mask,
+)
+from bigdl_tpu.train.dpo import dpo_loss, make_dpo_step, sequence_logprob
 
-__all__ = ["init_lora", "make_train_step", "merge_lora", "next_token_loss"]
+__all__ = [
+    "init_lora",
+    "make_train_step",
+    "merge_lora",
+    "next_token_loss",
+    "ReLoRASchedule",
+    "ReLoRAState",
+    "apply_layer_mask",
+    "make_full_train_step",
+    "relora_reset",
+    "sample_lisa_mask",
+    "dpo_loss",
+    "make_dpo_step",
+    "sequence_logprob",
+]

@@ -1,0 +1,103 @@
+"""Direct Preference Optimization.
+
+The reference ships DPO as an example recipe over TRL
+(`python/llm/example/GPU/LLM-Finetuning/DPO` in /root/reference — QLoRA
+base + TRL's DPOTrainer); here the loss itself is implemented natively so
+the same jitted-step machinery covers preference tuning: the policy is
+(frozen low-bit base + LoRA), the reference model is the SAME base with
+adapters disabled — no second model copy in HBM (TRL's
+`ref_model=None` peft trick, done structurally).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bigdl_tpu.models.config import ModelConfig
+
+
+def sequence_logprob(
+    config: ModelConfig,
+    forward_fn: Callable,
+    params: dict,
+    lora: Optional[dict],
+    tokens: jax.Array,  # [B, T]
+    loss_mask: jax.Array,  # [B, T] 1.0 on completion tokens (targets)
+) -> jax.Array:
+    """[B] sum of per-token log p(target) over masked positions."""
+    logits, _ = forward_fn(config, params, tokens[:, :-1], None, lora=lora)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    tok_lp = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return jnp.sum(tok_lp * loss_mask[:, 1:].astype(jnp.float32), axis=-1)
+
+
+def dpo_loss(
+    config: ModelConfig,
+    forward_fn: Callable,
+    params: dict,
+    lora: dict,
+    chosen: jax.Array,  # [B, T]
+    chosen_mask: jax.Array,
+    rejected: jax.Array,  # [B, T]
+    rejected_mask: jax.Array,
+    beta: float = 0.1,
+    label_smoothing: float = 0.0,
+) -> tuple[jax.Array, dict]:
+    """Standard DPO: -log σ(β[(π_c - π_r) - (ref_c - ref_r)]).
+
+    The reference policy is the base model with lora=None — gradients flow
+    only through the adapter branch, exactly TRL's peft shortcut.
+    """
+    pol_c = sequence_logprob(config, forward_fn, params, lora, chosen, chosen_mask)
+    pol_r = sequence_logprob(config, forward_fn, params, lora, rejected, rejected_mask)
+    ref_c = jax.lax.stop_gradient(
+        sequence_logprob(config, forward_fn, params, None, chosen, chosen_mask)
+    )
+    ref_r = jax.lax.stop_gradient(
+        sequence_logprob(config, forward_fn, params, None, rejected, rejected_mask)
+    )
+    logits = beta * ((pol_c - pol_r) - (ref_c - ref_r))
+    loss = (
+        -jax.nn.log_sigmoid(logits) * (1 - label_smoothing)
+        - jax.nn.log_sigmoid(-logits) * label_smoothing
+    )
+    aux = {
+        "reward_margin": jnp.mean(logits) / beta,
+        "accuracy": jnp.mean((logits > 0).astype(jnp.float32)),
+        "policy_chosen_logp": jnp.mean(pol_c),
+        "policy_rejected_logp": jnp.mean(pol_r),
+    }
+    return jnp.mean(loss), aux
+
+
+def make_dpo_step(
+    config: ModelConfig,
+    forward_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    beta: float = 0.1,
+):
+    """step(params, lora, opt_state, chosen, chosen_mask, rejected,
+    rejected_mask) -> (lora, opt_state, loss, aux)."""
+
+    def step(params, lora, opt_state, chosen, chosen_mask, rejected, rejected_mask):
+        scale = lora["scale"]
+
+        def loss_fn(layers):
+            return dpo_loss(
+                config, forward_fn, params, {"layers": layers, "scale": scale},
+                chosen, chosen_mask, rejected, rejected_mask, beta=beta,
+            )
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            lora["layers"]
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, lora["layers"])
+        layers = optax.apply_updates(lora["layers"], updates)
+        return {"layers": layers, "scale": scale}, opt_state, loss, aux
+
+    return step

@@ -110,6 +110,9 @@ def make_train_step(
     forward_fn: Callable,
     optimizer: optax.GradientTransformation,
     seq_spec=None,
+    ring_mesh=None,
+    ring_axis: str = "sp",
+    batch_axis: str = "dp",
 ):
     """Returns jittable step(params, lora, opt_state, tokens, loss_mask) ->
     (lora, opt_state, loss). Only lora['layers'] is trained (the alpha/rank
@@ -118,14 +121,61 @@ def make_train_step(
 
     seq_spec: optional PartitionSpec (e.g. P('dp', 'sp')) constraining the
     input token grid — sequence-parallel training: embedding/norm/MLP run
-    on sequence shards, XLA all-gathers around attention. Requires an
-    enclosing `jax.set_mesh`.
+    on sequence shards; without ring_mesh XLA all-gathers KV around
+    attention.
+
+    ring_mesh: pass the Mesh to replace those all-gathers with ring
+    attention (parallel/ring.py) — each device keeps 1/sp of the KV and
+    shards rotate over ICI, making attention memory O(T/sp) for
+    long-context training. Requires an enclosing `jax.set_mesh` and
+    sliding_window/softcap-free attention (llama-family default).
     """
+    attention_override = None
+    if ring_mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from bigdl_tpu.parallel.ring import ring_attention
+
+        # features the ring path does not implement — fail loudly instead
+        # of silently optimizing a different loss than the dense path
+        assert config.attn_logit_softcap is None, "ring: no logit softcap"
+        assert config.sliding_window is None, "ring: no sliding window"
+        assert not config.alibi, "ring: no alibi"
+
+        n = ring_mesh.shape[ring_axis]
+        # shard heads over tp too (when present and divisible): each tp
+        # device keeps its own head shard instead of all-gathering q/k/v
+        head_axis = None
+        if "tp" in ring_mesh.shape and ring_mesh.shape["tp"] > 1:
+            tp = ring_mesh.shape["tp"]
+            if (config.num_attention_heads % tp == 0
+                    and config.num_key_value_heads % tp == 0):
+                head_axis = "tp"
+        qspec = P(batch_axis, ring_axis, head_axis, None)
+
+        def _local(q, k, v, start):
+            return ring_attention(
+                q, k, v, axis_name=ring_axis, axis_size=n, causal=True,
+                scale=config.attn_scale, start=start,
+            )
+
+        attention_override = jax.shard_map(
+            _local,
+            mesh=ring_mesh,
+            in_specs=(qspec, qspec, qspec, P(batch_axis)),
+            out_specs=qspec,
+            check_vma=False,
+        )
+
     inner_forward = forward_fn
-    if seq_spec is not None:
+    if seq_spec is not None or attention_override is not None:
         def inner_forward(cfg, params, toks, cache, lora=None):
-            toks = jax.lax.with_sharding_constraint(toks, seq_spec)
-            return forward_fn(cfg, params, toks, cache, lora=lora)
+            if seq_spec is not None:
+                toks = jax.lax.with_sharding_constraint(toks, seq_spec)
+            return forward_fn(
+                cfg, params, toks, cache, lora=lora,
+                attention_override=attention_override,
+            )
 
     def step(params, lora, opt_state, tokens, loss_mask):
         scale = lora["scale"]

@@ -1,0 +1,138 @@
+"""Finetuning recipes beyond plain QLoRA.
+
+TPU-native re-designs of the reference's training extras:
+- ReLoRA (`transformers/relora.py:64-150` periodic merge-and-reset +
+  optimizer-state pruning `:128`): high-rank updates from a sequence of
+  low-rank phases.
+- LISA (`transformers/lisa.py:23-81` DynamicLayerActivationCallback):
+  full-weight finetuning with a random subset of layers unfrozen per
+  interval. With layers stacked on a leading axis, (un)freezing is a
+  per-layer gradient mask — no module surgery.
+- Full finetune step for dense models (the reference delegates this to
+  HF Trainer + deepspeed; here it is the same jitted step pattern as
+  QLoRA, over the whole param tree).
+
+The reference hooks these into HF Trainer callbacks; here each recipe is
+a pure function over (params, opt_state) plus a small schedule object the
+host loop consults — no trainer framework required.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.train.qlora import init_lora, merge_lora, next_token_loss
+
+
+# ---------------------------------------------------------------------------
+# ReLoRA
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ReLoRAState:
+    params: dict  # base (merged so far)
+    lora: dict
+    opt_state: optax.OptState
+    resets: int = 0
+
+
+def relora_reset(
+    config: ModelConfig,
+    state: ReLoRAState,
+    optimizer: optax.GradientTransformation,
+    key: jax.Array,
+    rank: int = 8,
+    alpha: float = 16.0,
+    requantize: Optional[str] = None,
+) -> ReLoRAState:
+    """Merge the current adapters into the base, re-init them, and prune
+    the optimizer state (reference relora.py:64-150; the pruning at :128
+    zeroes optimizer moments so each phase starts cold)."""
+    targets = tuple(state.lora["layers"].keys())
+    merged = merge_lora(state.params, state.lora, requantize=requantize)
+    fresh = init_lora(config, key, rank=rank, alpha=alpha, targets=targets)
+    opt_state = optimizer.init(fresh["layers"])
+    return ReLoRAState(
+        params=merged, lora=fresh, opt_state=opt_state, resets=state.resets + 1
+    )
+
+
+class ReLoRASchedule:
+    """Host-side: call should_reset(step) each step; reset_every in steps
+    (the reference's relora_steps)."""
+
+    def __init__(self, reset_every: int, warmup: int = 0):
+        self.reset_every = reset_every
+        self.warmup = warmup
+
+    def should_reset(self, step: int) -> bool:
+        return (
+            step > self.warmup
+            and self.reset_every > 0
+            and step % self.reset_every == 0
+        )
+
+
+# ---------------------------------------------------------------------------
+# LISA
+# ---------------------------------------------------------------------------
+
+def sample_lisa_mask(
+    key: jax.Array, n_layers: int, n_active: int
+) -> jax.Array:
+    """[L] float mask with exactly n_active ones (the layers that train
+    this interval) — reference lisa.py:23-81 `switch_active_layers`."""
+    perm = jax.random.permutation(key, n_layers)
+    return (perm < n_active).astype(jnp.float32)
+
+
+def apply_layer_mask(grads: dict, mask: jax.Array) -> dict:
+    """Zero the gradient of frozen layers. Works on any tree whose layer
+    leaves are stacked [L, ...]; non-stacked leaves (embed/head/norms)
+    pass through untouched."""
+    L = mask.shape[0]
+
+    def f(g):
+        if g.ndim >= 1 and g.shape[0] == L:
+            return g * mask.reshape((L,) + (1,) * (g.ndim - 1)).astype(g.dtype)
+        return g
+
+    return jax.tree.map(f, grads)
+
+
+# ---------------------------------------------------------------------------
+# Full finetune (dense weights)
+# ---------------------------------------------------------------------------
+
+def make_full_train_step(
+    config: ModelConfig,
+    forward_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    train_embed: bool = True,
+):
+    """step(params, opt_state, tokens, loss_mask, layer_mask|None) ->
+    (params, opt_state, loss). layer_mask is the LISA per-layer mask;
+    None trains everything. Quantized (QTensor) leaves are not supported —
+    full finetune needs dense weights (use QLoRA for low-bit bases)."""
+
+    def step(params, opt_state, tokens, loss_mask, layer_mask=None):
+        def loss_fn(p):
+            return next_token_loss(config, forward_fn, p, None, tokens, loss_mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if layer_mask is not None:
+            grads["layers"] = apply_layer_mask(grads["layers"], layer_mask)
+        if not train_embed:
+            grads = dict(grads)
+            grads["embed"] = jnp.zeros_like(grads["embed"])
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
