@@ -75,8 +75,27 @@ class TpuModel:
         quantize_kv is the reference's IPEX_LLM_QUANTIZE_KV_CACHE (FP8 KV);
         compress_kv the reference's IPEX_LLM_COMPRESS_KV_CACHE (SnapKV) —
         applied only when the prompt is longer than the budget."""
+        from bigdl_tpu.utils import flags
+
         if isinstance(prompts, np.ndarray):
             prompts = [list(row) for row in prompts]
+        # env-flag defaults (reference IPEX_LLM_QUANTIZE_KV_CACHE /
+        # IPEX_LLM_COMPRESS_KV_CACHE / IPEX_LLM_PERFORMANCE_MODE)
+        if not quantize_kv:
+            quantize_kv = flags.quantize_kv_default()
+        if compress_kv is None:
+            compress_kv = flags.compress_kv_budget()
+        if (
+            flags.performance_mode()
+            and not do_sample
+            and compress_kv is None  # lookup path has no SnapKV support
+            and max(len(p) for p in prompts) >= 256
+        ):
+            return self.generate_lookup(
+                prompts, max_new_tokens=max_new_tokens,
+                eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+                seed=seed, quantize_kv=quantize_kv,
+            )
         tokens, start = pad_prompts(prompts, pad_token_id)
         gen = GenerationConfig(
             max_new_tokens=max_new_tokens,
@@ -105,6 +124,7 @@ class TpuModel:
             quantize_kv=quantize_kv,
             compress_budget=budget,
             compress_window=min(compress_window, max(budget - 1, 1)),
+            last_logits=flags.last_lm_head_default(),
         )
         return np.asarray(out)
 

@@ -19,16 +19,19 @@ import sys
 import time
 
 
-def _load(path: str, qtype: str):
+def _load(path: str, qtype):
+    """qtype=None means: native formats for .gguf, sym_int4 for HF dirs."""
     from bigdl_tpu.api import AutoModelForCausalLM
 
     if path.endswith(".gguf"):
-        return AutoModelForCausalLM.from_gguf(path)
+        return AutoModelForCausalLM.from_gguf(path, qtype=qtype)
     import os
 
     if os.path.exists(os.path.join(path, "bigdl_tpu_config.json")):
         return AutoModelForCausalLM.load_low_bit(path)
-    return AutoModelForCausalLM.from_pretrained(path, load_in_low_bit=qtype)
+    return AutoModelForCausalLM.from_pretrained(
+        path, load_in_low_bit=qtype or "sym_int4"
+    )
 
 
 def _tokenizer(path: str):
@@ -105,7 +108,8 @@ def cmd_bench(args):
 
 def main(argv=None):
     p = argparse.ArgumentParser(prog="bigdl-tpu")
-    p.add_argument("-q", "--qtype", default="sym_int4")
+    p.add_argument("-q", "--qtype", default=None,
+               help="sym_int4 (HF default) / q4_k_m / ... ; gguf keeps native formats unless set")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     c = sub.add_parser("convert", help="quantize + save_low_bit")

@@ -350,10 +350,20 @@ def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
             axis=-1,
         ).astype(np.int8)
         return codes.reshape(*codes.shape[:-2], -1), d, m, "asym_int5"
+    if ggml_type in (GGML_Q4_K, GGML_Q6_K):
+        # our q4_k/q6_k QTensor storage IS the ggml super-block byte
+        # layout — carry the blocks verbatim (quant/kquants.py decodes
+        # them in-graph)
+        off = 0 if ggml_type == GGML_Q4_K else 208
+        d = _f16(blocks, off).astype(np.float16)
+        name = "q4_k" if ggml_type == GGML_Q4_K else "q6_k"
+        return blocks, d, None, name
     raise KeyError(ggml_type)
 
 
-_REPACKABLE = {GGML_Q4_0, GGML_Q4_1, GGML_Q8_0, GGML_Q5_0, GGML_Q5_1}
+_REPACKABLE = {
+    GGML_Q4_0, GGML_Q4_1, GGML_Q8_0, GGML_Q5_0, GGML_Q5_1, GGML_Q4_K, GGML_Q6_K,
+}
 
 
 # ---------------------------------------------------------------------------
@@ -433,6 +443,11 @@ def load_gguf(
 
     if dtype is None:
         dtype = jnp.bfloat16
+    head_qtype = None
+    if qtype is not None:
+        from bigdl_tpu.quant.qtypes import split_mixed_qtype
+
+        qtype, head_qtype = split_mixed_qtype(qtype)
     reader = GGUFReader(path)
     arch = reader.architecture
     if arch not in ("llama", "mistral", "qwen2"):
@@ -449,7 +464,7 @@ def load_gguf(
     else:
         perm_fn = perm_fn_kv = None
 
-    def load_weight(name: str, permute=None):
+    def load_weight(name: str, permute=None, target_qtype=None):
         info = reader.tensors[name]
         if info.ggml_type in _REPACKABLE and qtype is None:
             blocks = reader.raw_blocks(name)
@@ -465,7 +480,7 @@ def load_gguf(
         w = reader.dequantize(name)
         if permute is not None:
             w = w[permute(w.shape[0])]
-        target = qtype or "sym_int4"
+        target = target_qtype or qtype or "sym_int4"
         return quantize(jnp.asarray(w, jnp.float32), target)
 
     def load_dense(name: str):
@@ -528,5 +543,5 @@ def load_gguf(
         "final_norm": load_dense("output_norm.weight"),
     }
     if not config.tie_word_embeddings:
-        params["lm_head"] = load_weight("output.weight")
+        params["lm_head"] = load_weight("output.weight", target_qtype=head_qtype)
     return config, params

@@ -335,28 +335,39 @@ def params_from_state_dict(
     get_tensor: Get,
     qtype: str = "sym_int4",
     dtype=jnp.bfloat16,
+    lm_head_qtype: Optional[str] = None,
 ) -> dict:
     """Build the model param pytree from a tensor-name accessor.
 
     `get_tensor` returns a numpy array for an HF tensor name (backed by a
     dict for tests, or by lazy safetensors shards for real checkpoints).
     Weights are quantized layer by layer as they stream in, then stacked
-    along the leading (scan) axis.
+    along the leading (scan) axis. lm_head_qtype overrides the head's
+    format (mixed-precision head, reference IPEX_LLM_LAST_LM_HEAD /
+    gguf_mixed_qtype behavior).
     """
+    from bigdl_tpu.quant.qtypes import split_mixed_qtype
+
+    qtype, head_default = split_mixed_qtype(qtype)
+    lm_head_qtype = lm_head_qtype or head_default
     spec = resolve_qtype(qtype)
+    head_spec = resolve_qtype(lm_head_qtype) if lm_head_qtype else spec
 
     def maybe_quant(name: str, arr):
         if isinstance(arr, QTensor):  # exact GPTQ/AWQ repack (autoq.py)
             return arr
-        if (not spec.is_dense) and (name in _QUANT_TARGETS or name == "lm_head"):
+        use_spec = head_spec if name == "lm_head" else spec
+        if (not use_spec.is_dense) and (name in _QUANT_TARGETS or name == "lm_head"):
             from bigdl_tpu import native
 
             # native C++ packer (csrc/) for the ingest hot loop; bit-equal
             # jnp fallback otherwise
-            qt = native.quantize_to_qtensor(np.asarray(arr, np.float32), spec.name)
+            qt = native.quantize_to_qtensor(
+                np.asarray(arr, np.float32), use_spec.name
+            )
             if qt is not None:
                 return qt
-            return quantize(jnp.asarray(arr, jnp.float32), spec.name)
+            return quantize(jnp.asarray(arr, jnp.float32), use_spec.name)
         return jnp.asarray(arr).astype(dtype)
 
     # per-layer dicts -> stacked leaves

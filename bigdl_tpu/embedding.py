@@ -1,0 +1,87 @@
+"""Embedding variants for memory-constrained serving.
+
+TPU-native re-design of the reference's `transformers/embedding.py`:
+- `LowBitEmbedding` (:179) — quantized table, per-row dequant at lookup
+  (`xe_linear.dequantize_rows`): here the table is a QTensor and only the
+  gathered rows are dequantized, in-graph.
+- `CPUEmbedding` (:58) — table pinned in host RAM, device receives only
+  the looked-up rows: `jax.pure_callback` performs the host gather, so
+  HBM never holds the [V, H] matrix.
+- `DiskEmbedding` (:96) — same, but the table is an np.memmap over a
+  .npy file: rows stream from disk page cache per lookup.
+
+`embed_lookup` dispatches on the leaf type; models/llama.embed_tokens
+calls it, so any family supports all variants transparently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.quant import QTensor
+from bigdl_tpu.quant.numerics import dequantize_blockwise
+
+
+class HostEmbedding:
+    """Host-resident embedding table (CPU RAM or disk-backed memmap).
+
+    Registered as a childless pytree node: it crosses jit boundaries as a
+    static aux value (identity-hashed), and the lookup runs as a host
+    callback — the device only ever sees [B, T, H] gathered rows.
+    """
+
+    def __init__(self, table: np.ndarray, dtype=jnp.bfloat16):
+        self.table = table
+        self.dtype = dtype
+        self.vocab_size, self.hidden_size = table.shape
+
+    @classmethod
+    def from_file(cls, path: str, dtype=jnp.bfloat16) -> "HostEmbedding":
+        """Disk-backed (reference DiskEmbedding): np.memmap keeps rows on
+        disk until the page cache pulls them in."""
+        return cls(np.load(path, mmap_mode="r"), dtype=dtype)
+
+    def lookup(self, tokens: jax.Array) -> jax.Array:
+        shape = jax.ShapeDtypeStruct(
+            tokens.shape + (self.hidden_size,), np.float32
+        )
+
+        def host_gather(t):
+            return np.asarray(self.table[np.asarray(t)], np.float32)
+
+        out = jax.pure_callback(host_gather, shape, tokens, vmap_method="sequential")
+        return out.astype(self.dtype)
+
+
+jax.tree_util.register_pytree_node(
+    HostEmbedding,
+    lambda e: ((), e),
+    lambda aux, _: aux,
+)
+
+
+def quantize_embedding(embed: jax.Array, qtype: str = "sym_int4") -> QTensor:
+    """Reference LowBitEmbedding: quantize the table row-blockwise (each
+    row's H dim carries the blocks, so a row dequantizes independently)."""
+    from bigdl_tpu.quant import quantize
+
+    return quantize(jnp.asarray(embed, jnp.float32), qtype)
+
+
+def embed_lookup(embed: Any, tokens: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Gather token embeddings from a dense array, QTensor (low-bit), or
+    HostEmbedding (CPU/disk) table."""
+    if isinstance(embed, HostEmbedding):
+        return embed.lookup(tokens).astype(compute_dtype)
+    if isinstance(embed, QTensor):
+        # gather packed rows + their scales, then dequantize just those rows
+        data = embed.data[tokens]
+        scales = embed.scales[tokens]
+        mins = embed.mins[tokens] if embed.mins is not None else None
+        return dequantize_blockwise(data, scales, mins, embed.spec, compute_dtype)
+    return embed.astype(compute_dtype)[tokens]

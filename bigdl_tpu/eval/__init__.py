@@ -1,0 +1,6 @@
+"""Evaluation harness (reference: dev/benchmark/{perplexity,harness} in
+/root/reference)."""
+
+from bigdl_tpu.eval.perplexity import perplexity
+
+__all__ = ["perplexity"]

@@ -1,0 +1,82 @@
+"""Perplexity evaluation.
+
+Equivalent of the reference's wikitext runner
+(`dev/benchmark/perplexity/run_wikitext.py` in /root/reference, which
+backs the README quality table §6 of SURVEY.md): strided sliding-window
+NLL over a token stream, jitted per window shape. The quality gate for
+every quantization format — sym_int4 must land within the README table's
+delta of fp16.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models.config import ModelConfig
+
+
+@functools.partial(jax.jit, static_argnames=("config", "forward"))
+def _window_nll(config: ModelConfig, forward, params, tokens, valid):
+    """tokens [1, T]; valid [T-1] marks target positions scored in this
+    window (stride overlap is context only). Returns (sum_nll, n)."""
+    logits, _ = forward(config, params, tokens[:, :-1], None)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32), -1)[0, :, 0]
+    v = valid.astype(jnp.float32)
+    return jnp.sum(nll * v), jnp.sum(v)
+
+
+def perplexity(
+    model,
+    token_stream: Iterable[int],
+    window: int = 512,
+    stride: Optional[int] = None,
+    max_tokens: Optional[int] = None,
+    return_count: bool = False,
+):
+    """model: TpuModel. token_stream: the corpus as one token sequence
+    (e.g. tokenizer("\\n\\n".join(wikitext))['input_ids']).
+
+    stride defaults to window (disjoint windows); stride < window scores
+    only the last `stride` targets per window with the rest as context —
+    the HF/reference strided protocol.
+    """
+    ids = np.asarray(list(token_stream), np.int32)
+    if max_tokens:
+        ids = ids[:max_tokens]
+    stride = stride or window
+    fwd = model.family.forward
+
+    total, count = 0.0, 0.0
+    prev_end = 0
+    for begin in range(0, max(len(ids) - 1, 1), stride):
+        end = min(begin + window, len(ids))
+        chunk = ids[end - window:end] if end >= window else ids[:end]
+        if len(chunk) < window:  # left-pad the first/short window
+            chunk = np.concatenate([np.zeros(window - len(chunk), np.int32), chunk])
+        # score only tokens not already scored (HF strided protocol:
+        # windows overlap by window - stride as pure context)
+        new_targets = min(end - prev_end, window - 1, end - 1)
+        if new_targets <= 0:
+            break
+        valid = np.zeros(window - 1, np.float32)
+        valid[window - 1 - new_targets:] = 1.0
+        s, n = _window_nll(
+            model.config, fwd, model.params, jnp.asarray(chunk[None]),
+            jnp.asarray(valid),
+        )
+        total += float(s)
+        count += float(n)
+        prev_end = end
+        if end == len(ids):
+            break
+    ppl = float(np.exp(total / max(count, 1.0)))
+    if return_count:
+        return ppl, int(count)
+    return ppl

@@ -87,6 +87,7 @@ def pad_prompts(
     static_argnames=(
         "config", "gen", "model_forward", "cache_len", "quantize_kv",
         "compress_budget", "compress_window", "compress_kernel",
+        "last_logits",
     ),
     donate_argnames=(),
 )
@@ -103,6 +104,9 @@ def generate_tokens(
     compress_budget: int = 0,  # SnapKV: compress prompt KV to this many slots
     compress_window: int = 32,
     compress_kernel: int = 7,
+    # lm head on the last prefill position only (BIGDL_TPU_LAST_LM_HEAD;
+    # reference IPEX_LLM_LAST_LM_HEAD) — saves the [B,T,V] prefill logits
+    last_logits: bool = True,
 ) -> jax.Array:
     """One compiled program: prefill + full decode loop.
 
@@ -127,7 +131,7 @@ def generate_tokens(
         assert compress_budget > compress_window
         logits, cache, obs = model_forward(
             config, params, tokens, cache, mode="prefill",
-            collect_obs=compress_window,
+            collect_obs=compress_window, last_logits_only=last_logits,
         )
         out_len = cache_len_for(compress_budget, gen.max_new_tokens)
         cache = kvcache.compress(
@@ -135,7 +139,10 @@ def generate_tokens(
             window=compress_window, kernel=compress_kernel,
         )
     else:
-        logits, cache = model_forward(config, params, tokens, cache, mode="prefill")
+        logits, cache = model_forward(
+            config, params, tokens, cache, mode="prefill",
+            last_logits_only=last_logits,
+        )
     key, k0 = jax.random.split(key)
     first = sample_token(logits[:, -1], k0, gen)
 

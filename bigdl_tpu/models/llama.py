@@ -137,8 +137,13 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
     Equivalent of `ggml_convert_low_bit` walking modules (convert.py:1077):
     norms/biases/router stay dense; the lm head may use a different (higher)
     qtype, mirroring the reference's mixed-precision lm-head handling
-    (convert.py:469-750, IPEX_LLM_LAST_LM_HEAD).
+    (convert.py:469-750, IPEX_LLM_LAST_LM_HEAD). Mixed aliases (q4_k_m)
+    resolve to (body, head) formats here.
     """
+    from bigdl_tpu.quant.qtypes import split_mixed_qtype
+
+    qtype, head_default = split_mixed_qtype(qtype)
+    lm_head_qtype = lm_head_qtype or head_default
     spec = resolve_qtype(qtype)
     if spec.is_dense:
         return params
@@ -179,8 +184,12 @@ def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
 def embed_tokens(config: ModelConfig, params: Params, tokens: jax.Array,
                  compute_dtype=jnp.bfloat16) -> jax.Array:
     """Token embedding incl. the gemma/minicpm scaling knobs — shared by
-    forward() and the pipeline stage program (parallel/pipeline.py)."""
-    h = params["embed"].astype(compute_dtype)[tokens]
+    forward() and the pipeline stage program (parallel/pipeline.py).
+    The table may be dense, a QTensor (LowBitEmbedding), or a
+    HostEmbedding (CPU/disk offload) — see bigdl_tpu/embedding.py."""
+    from bigdl_tpu.embedding import embed_lookup
+
+    h = embed_lookup(params["embed"], tokens, compute_dtype)
     if config.scale_embeddings:
         h = h * jnp.asarray(config.hidden_size**0.5, compute_dtype)
     if config.embedding_scale:
@@ -282,6 +291,10 @@ def forward(
     input_is_hidden: bool = False,  # static: tokens is [B,T,H] hidden states
     return_hidden: bool = False,  # static: skip final norm/head, return h
     layer_offset=0,  # global index of params['layers'][0] (pipeline stages)
+    last_logits_only: bool = False,  # static: lm head on the last position
+    # only — prefill skips the [B,T,V] logits (reference
+    # reshape_lm_head_input / IPEX_LLM_LAST_LM_HEAD,
+    # low_bit_linear.py:262-270)
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache with pos advanced).
 
@@ -481,6 +494,8 @@ def forward(
     if return_hidden:
         logits = h
     else:
+        if last_logits_only:
+            h = h[:, -1:]
         logits = lm_head_logits(config, params, h, compute_dtype)
     if cache is not None:
         cache = kvcache.advance(cache, T)

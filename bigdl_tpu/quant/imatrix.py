@@ -1,0 +1,108 @@
+"""Importance-matrix (weighted) quantization.
+
+Equivalent of the reference's `ggml_quantize_tensor_with_weights` /
+`ggml_quantize_tensor_rtn_with_weights` entry points
+(ggml/model/llama/llama_cpp.py:955-1047 in /root/reference, driven from
+low_bit_linear.py's imatrix path): per-channel importance weights
+(activation second moments collected on a calibration set) steer the
+block scale search, so frequently-activated channels round more
+accurately.
+
+Default (un-weighted) quantization in this framework is plain RTN — the
+reference's `*_rtn` variants; `quantize_with_weights` is the upgrade:
+for each block it searches candidate scales minimizing the weighted MSE
+    sum_i w_i * (x_i - d * q_i(d))^2
+over a grid around the RTN scale (the same shape of search as ggml's
+make_qx_quants).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.quant.qtypes import resolve_qtype
+
+
+def _search_scales(
+    xb: np.ndarray,  # [n_blocks, bs]
+    wb: np.ndarray,  # [n_blocks, bs] importance weights
+    qmin: int,
+    qmax: int,
+    anchor: np.ndarray,  # [n_blocks] RTN scale (signed)
+    n_steps: int = 16,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Returns (d [n_blocks], q [n_blocks, bs] int codes)."""
+    best_d = anchor.copy()
+    inv = np.where(anchor == 0, 0.0, 1.0 / np.where(anchor == 0, 1, anchor))
+    q = np.clip(np.round(xb * inv[:, None]), qmin, qmax)
+    best_err = np.sum(wb * (xb - best_d[:, None] * q) ** 2, axis=-1)
+
+    # candidates: scale the anchor by factors around 1 (ggml tries
+    # nmax-1+is*0.1 style perturbations of the divisor)
+    for f in np.linspace(0.75, 1.25, n_steps):
+        d = anchor * f
+        inv = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
+        q = np.clip(np.round(xb * inv[:, None]), qmin, qmax)
+        # given the rounding, the OPTIMAL scale for these codes is the
+        # weighted least-squares fit  d* = sum(w x q) / sum(w q^2)
+        num = np.sum(wb * xb * q, axis=-1)
+        den = np.sum(wb * q * q, axis=-1)
+        d_opt = np.where(den > 0, num / np.maximum(den, 1e-30), d)
+        err = np.sum(wb * (xb - d_opt[:, None] * q) ** 2, axis=-1)
+        better = err < best_err
+        best_d = np.where(better, d_opt, best_d)
+        best_err = np.where(better, err, best_err)
+
+    inv = np.where(best_d == 0, 0.0, 1.0 / np.where(best_d == 0, 1, best_d))
+    q = np.clip(np.round(xb * inv[:, None]), qmin, qmax)
+    return best_d, q
+
+
+def quantize_with_weights(
+    x: np.ndarray,  # [..., K]
+    qtype: str,
+    weights: Optional[np.ndarray] = None,  # [K] or broadcastable to x
+):
+    """Weighted-search quantization for sym_int4/sym_int8. Returns a
+    QTensor. weights=None degrades to (searched, unweighted) quantization
+    — still better than plain RTN."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.quant import QTensor
+    from bigdl_tpu.quant.numerics import pack_nibbles
+
+    spec = resolve_qtype(qtype)
+    if spec.name not in ("sym_int4", "sym_int8"):
+        raise NotImplementedError(f"imatrix search for {qtype}")
+    x = np.asarray(x, np.float32)
+    k = x.shape[-1]
+    bs = spec.block_size
+    assert k % bs == 0
+    w = np.ones_like(x) if weights is None else np.broadcast_to(
+        np.asarray(weights, np.float32), x.shape
+    )
+    lead = x.shape[:-1]
+    xb = x.reshape(-1, bs)
+    wb = w.reshape(-1, bs)
+
+    if spec.name == "sym_int4":
+        qmin, qmax, offset = -8, 7, 8
+        idx = np.argmax(np.abs(xb), axis=-1)
+        anchor = xb[np.arange(len(xb)), idx] / -8.0
+    else:
+        qmin, qmax, offset = -127, 127, 0
+        anchor = np.abs(xb).max(axis=-1) / 127.0
+
+    d, q = _search_scales(xb, wb, qmin, qmax, anchor)
+    scales = d.astype(np.float16).reshape(*lead, k // bs)
+    codes = (q + offset).reshape(*lead, k)
+    if spec.name == "sym_int4":
+        data = np.asarray(pack_nibbles(jnp.asarray(codes.astype(np.uint8))))
+    else:
+        data = codes.astype(np.int8)
+    return QTensor(
+        data=jnp.asarray(data), scales=jnp.asarray(scales), mins=None,
+        qtype=spec.name,
+    )

@@ -1,0 +1,188 @@
+"""K-quant (super-block) codecs: q4_K and q6_K.
+
+The reference reaches these formats through its native quantizers
+(`ggml_quantize_tensor` with q4_k/q6_k qtypes, ggml/quantize.py:28-57 +
+gguf_mixed_qtype :60-61 in /root/reference). Here:
+
+- storage is the llama.cpp super-block byte layout (256 elements; q4_K:
+  fp16 d/dmin + 12B packed 6-bit sub-scales/mins + 128B nibbles = 144B;
+  q6_K: 128B low nibbles + 64B high bits + 16 int8 sub-scales + fp16 d =
+  210B) so GGUF k-quant tensors repack into QTensor **without**
+  dequantization (convert/gguf.py);
+- `dequant_q4_k` / `dequant_q6_k` are jnp (jit-safe) — they run in-graph
+  on TPU, fused by XLA into the consuming matmul like the other formats;
+- the encoders are host-side numpy (RTN two-level scales — the
+  non-imatrix ggml path) used at checkpoint ingest.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+QK_K = 256
+
+
+# ---------------------------------------------------------------------------
+# jnp decoders (device-side, jit-safe)
+# ---------------------------------------------------------------------------
+
+def _read_f16(blocks: jnp.ndarray, off: int) -> jnp.ndarray:
+    """fp16 scalar stored little-endian at byte offset `off`."""
+    lo = blocks[..., off].astype(jnp.uint16)
+    hi = blocks[..., off + 1].astype(jnp.uint16)
+    bits = lo | (hi << 8)
+    return jax.lax.bitcast_convert_type(bits, jnp.float16).astype(jnp.float32)
+
+
+def dequant_q6_k(blocks: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """blocks [..., n_sb, 210] uint8 -> [..., n_sb*256]."""
+    ql = blocks[..., 0:128]
+    qh = blocks[..., 128:192]
+    scales = blocks[..., 192:208].astype(jnp.int8).astype(jnp.float32)
+    d = _read_f16(blocks, 208)
+
+    outs = []
+    for half in range(2):
+        l1 = ql[..., 64 * half:64 * half + 32]
+        l2 = ql[..., 64 * half + 32:64 * half + 64]
+        h = qh[..., 32 * half:32 * half + 32]
+        q1 = ((l1 & 0xF) | ((h & 3) << 4)).astype(jnp.float32) - 32.0
+        q2 = ((l2 & 0xF) | (((h >> 2) & 3) << 4)).astype(jnp.float32) - 32.0
+        q3 = ((l1 >> 4) | (((h >> 4) & 3) << 4)).astype(jnp.float32) - 32.0
+        q4 = ((l2 >> 4) | (((h >> 6) & 3) << 4)).astype(jnp.float32) - 32.0
+        outs.extend([q1, q2, q3, q4])
+    q = jnp.concatenate(outs, axis=-1)  # [..., 256] element order
+    sub_scale = jnp.repeat(scales, 16, axis=-1)
+    vals = q * sub_scale * d[..., None]
+    return vals.reshape(*blocks.shape[:-2], -1).astype(dtype)
+
+
+def _unpack_q4k_scales(sc_raw: jnp.ndarray):
+    """12 packed bytes -> (sc [., 8], mn [., 8]) floats (get_scale_min_k4)."""
+    sc = []
+    mn = []
+    for j in range(8):
+        if j < 4:
+            sc.append((sc_raw[..., j] & 63).astype(jnp.float32))
+            mn.append((sc_raw[..., j + 4] & 63).astype(jnp.float32))
+        else:
+            sc.append(
+                ((sc_raw[..., j + 4] & 0xF) | ((sc_raw[..., j - 4] >> 6) << 4)
+                 ).astype(jnp.float32)
+            )
+            mn.append(
+                ((sc_raw[..., j + 4] >> 4) | ((sc_raw[..., j] >> 6) << 4)
+                 ).astype(jnp.float32)
+            )
+    return jnp.stack(sc, axis=-1), jnp.stack(mn, axis=-1)
+
+
+def dequant_q4_k(blocks: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """blocks [..., n_sb, 144] uint8 -> [..., n_sb*256]."""
+    d = _read_f16(blocks, 0)
+    dmin = _read_f16(blocks, 2)
+    sc, mn = _unpack_q4k_scales(blocks[..., 4:16])
+    qs = blocks[..., 16:144]
+
+    outs = []
+    for pair in range(4):
+        grp = qs[..., 32 * pair:32 * (pair + 1)]
+        lo = (grp & 0xF).astype(jnp.float32)
+        hi = (grp >> 4).astype(jnp.float32)
+        j0, j1 = 2 * pair, 2 * pair + 1
+        outs.append(
+            d[..., None] * sc[..., j0:j0 + 1] * lo
+            - dmin[..., None] * mn[..., j0:j0 + 1]
+        )
+        outs.append(
+            d[..., None] * sc[..., j1:j1 + 1] * hi
+            - dmin[..., None] * mn[..., j1:j1 + 1]
+        )
+    vals = jnp.concatenate(outs, axis=-1)
+    return vals.reshape(*blocks.shape[:-2], -1).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# numpy encoders (host-side ingest; RTN two-level scales)
+# ---------------------------------------------------------------------------
+
+def quantize_q6_k(x: np.ndarray) -> np.ndarray:
+    """x [..., K] (K % 256 == 0) -> blocks [..., K/256, 210] uint8."""
+    x = np.asarray(x, np.float32)
+    lead = x.shape[:-1]
+    xb = x.reshape(-1, QK_K // 16, 16)  # [n_sb_total, 16 subblocks, 16]
+    n = xb.shape[0]
+
+    # per-sub-block signed-absmax scale, super scale d = max|s|/127
+    idx = np.argmax(np.abs(xb), axis=-1)
+    smax = np.take_along_axis(xb, idx[..., None], axis=-1)[..., 0]  # [n, 16]
+    s = smax / -32.0
+    d = np.max(np.abs(s), axis=-1) / 127.0  # [n]
+    inv_d = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
+    sc = np.clip(np.round(s * inv_d[:, None]), -128, 127).astype(np.int8)
+
+    eff = d[:, None] * sc.astype(np.float32)  # effective sub scales
+    inv_eff = np.where(eff == 0, 0.0, 1.0 / np.where(eff == 0, 1, eff))
+    q = np.clip(np.round(xb * inv_eff[..., None]), -32, 31).astype(np.int32) + 32
+    q = q.reshape(n, QK_K)  # element order
+
+    blocks = np.zeros((n, 210), np.uint8)
+    for half in range(2):
+        base = 128 * half
+        q1 = q[:, base:base + 32]
+        q2 = q[:, base + 32:base + 64]
+        q3 = q[:, base + 64:base + 96]
+        q4 = q[:, base + 96:base + 128]
+        blocks[:, 64 * half:64 * half + 32] = (q1 & 0xF) | ((q3 & 0xF) << 4)
+        blocks[:, 64 * half + 32:64 * half + 64] = (q2 & 0xF) | ((q4 & 0xF) << 4)
+        blocks[:, 128 + 32 * half:128 + 32 * half + 32] = (
+            (q1 >> 4) | ((q2 >> 4) << 2) | ((q3 >> 4) << 4) | ((q4 >> 4) << 6)
+        )
+    blocks[:, 192:208] = sc.view(np.uint8)
+    blocks[:, 208:210] = (
+        d.astype(np.float16).view(np.uint8).reshape(n, 2)
+    )
+    return blocks.reshape(*lead, x.shape[-1] // QK_K, 210)
+
+
+def quantize_q4_k(x: np.ndarray) -> np.ndarray:
+    """x [..., K] (K % 256 == 0) -> blocks [..., K/256, 144] uint8."""
+    x = np.asarray(x, np.float32)
+    lead = x.shape[:-1]
+    xb = x.reshape(-1, 8, 32)  # 8 sub-blocks of 32
+    n = xb.shape[0]
+
+    mins = np.minimum(xb.min(axis=-1), 0.0)  # [n, 8] (m >= 0 convention)
+    maxs = xb.max(axis=-1)
+    scales = (maxs - mins) / 15.0
+    d = scales.max(axis=-1) / 63.0
+    dmin = (-mins).max(axis=-1) / 63.0
+    inv_d = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
+    inv_dm = np.where(dmin == 0, 0.0, 1.0 / np.where(dmin == 0, 1, dmin))
+    sc = np.clip(np.round(scales * inv_d[:, None]), 0, 63).astype(np.uint8)
+    mn = np.clip(np.round(-mins * inv_dm[:, None]), 0, 63).astype(np.uint8)
+
+    eff_s = d[:, None] * sc.astype(np.float32)
+    eff_m = dmin[:, None] * mn.astype(np.float32)
+    inv_eff = np.where(eff_s == 0, 0.0, 1.0 / np.where(eff_s == 0, 1, eff_s))
+    q = np.clip(
+        np.round((xb + eff_m[..., None]) * inv_eff[..., None]), 0, 15
+    ).astype(np.uint8)
+
+    blocks = np.zeros((n, 144), np.uint8)
+    blocks[:, 0:2] = d.astype(np.float16).view(np.uint8).reshape(n, 2)
+    blocks[:, 2:4] = dmin.astype(np.float16).view(np.uint8).reshape(n, 2)
+    # pack 6-bit scales/mins (inverse of get_scale_min_k4)
+    packed = np.zeros((n, 12), np.uint8)
+    for j in range(4):
+        packed[:, j] = sc[:, j] | ((sc[:, j + 4] >> 4) << 6)
+        packed[:, j + 4] = mn[:, j] | ((mn[:, j + 4] >> 4) << 6)
+        packed[:, j + 8] = (sc[:, j + 4] & 0xF) | ((mn[:, j + 4] & 0xF) << 4)
+    blocks[:, 4:16] = packed
+    for pair in range(4):
+        lo = q[:, 2 * pair]
+        hi = q[:, 2 * pair + 1]
+        blocks[:, 16 + 32 * pair:16 + 32 * (pair + 1)] = lo | (hi << 4)
+    return blocks.reshape(*lead, x.shape[-1] // QK_K, 144)

@@ -77,10 +77,27 @@ def quantize_blockwise(x: jax.Array, spec: QTypeSpec):
     """Quantize x along its last axis. Returns (data, scales, mins|None).
 
     scales/mins are float16 with shape [..., K // block_size], matching the
-    reference's half-precision block headers.
+    reference's half-precision block headers. K-quants (ggml_block storage)
+    encode on host (numpy) into the llama.cpp super-block byte layout; the
+    returned scales are the extracted per-super-block d (informational —
+    dequant reads everything from the block bytes).
     """
     x = x.astype(jnp.float32)
     name = spec.name
+
+    if spec.storage == "ggml_block":
+        from bigdl_tpu.quant import kquants
+
+        xh = np.asarray(x)  # host-side encode (ingest path)
+        if name == "q6_k":
+            blocks = kquants.quantize_q6_k(xh)
+            d = blocks[..., 208:210].copy().view(np.float16)[..., 0]
+        elif name == "q4_k":
+            blocks = kquants.quantize_q4_k(xh)
+            d = blocks[..., 0:2].copy().view(np.float16)[..., 0]
+        else:
+            raise NotImplementedError(name)
+        return jnp.asarray(blocks), jnp.asarray(d), None
 
     if spec.storage.startswith("fp8"):
         xb = _blocked(x, spec.block_size)
@@ -149,6 +166,15 @@ def dequantize_blockwise(
 ) -> jax.Array:
     """Inverse of quantize_blockwise; returns [..., K] in `dtype`."""
     name = spec.name
+
+    if spec.storage == "ggml_block":
+        from bigdl_tpu.quant import kquants
+
+        if name == "q6_k":
+            return kquants.dequant_q6_k(data, dtype)
+        if name == "q4_k":
+            return kquants.dequant_q4_k(data, dtype)
+        raise NotImplementedError(name)
 
     if spec.storage.startswith("fp8"):
         xb = _blocked(data.astype(jnp.float32), spec.block_size)

@@ -43,6 +43,9 @@ class QTensor:
         spec = self.spec
         if spec.storage == "packed_u8":
             return (*self.data.shape[:-1], self.data.shape[-1] * 2)
+        if spec.storage == "ggml_block":
+            # data [..., n_superblocks, block_bytes]
+            return (*self.data.shape[:-2], self.data.shape[-2] * spec.block_size)
         return tuple(self.data.shape)
 
     @property

@@ -85,8 +85,11 @@ class QTypeSpec:
     block_size: int  # elements sharing one scale along the contraction axis
     asymmetric: bool = False  # stores per-block mins in addition to scales
     codebook: np.ndarray | None = None  # LUT types (nf4/nf3/fp4/fp6)
-    storage: str = "packed_u8"  # packed_u8 | int8 | fp8_e4m3 | fp8_e5m2 | dense
+    storage: str = "packed_u8"  # packed_u8 | int8 | fp8_e4m3 | fp8_e5m2 |
+    # ggml_block | dense. ggml_block = k-quant super-blocks kept in the
+    # llama.cpp byte layout (data [.., n_sb, block_bytes] uint8).
     # dense == not quantized (fp16/bf16 passthrough kept as plain arrays)
+    block_bytes: int = 0  # ggml_block: bytes per super-block
 
     @property
     def is_dense(self) -> bool:
@@ -118,6 +121,16 @@ FP4 = _register(QTypeSpec("fp4", bits=4, block_size=64, codebook=FP4_CODEBOOK))
 FP6 = _register(QTypeSpec("fp6", bits=6, block_size=64, codebook=FP6_CODEBOOK, storage="int8"))
 FP8_E4M3 = _register(QTypeSpec("fp8_e4m3", bits=8, block_size=128, storage="fp8_e4m3"))
 FP8_E5M2 = _register(QTypeSpec("fp8_e5m2", bits=8, block_size=128, storage="fp8_e5m2"))
+# k-quants: 256-element super-blocks in the llama.cpp byte layout
+# (two-level scales; ggml q4_K = 4.5 bit/weight, q6_K = 6.5625), kept
+# byte-compatible so GGUF k-quant tensors repack without dequantization.
+Q4_K = _register(QTypeSpec(
+    "q4_k", bits=4, block_size=256, storage="ggml_block", block_bytes=144,
+    asymmetric=True,
+))
+Q6_K = _register(QTypeSpec(
+    "q6_k", bits=6, block_size=256, storage="ggml_block", block_bytes=210,
+))
 FP16 = _register(QTypeSpec("fp16", bits=16, block_size=1, storage="dense"))
 BF16 = _register(QTypeSpec("bf16", bits=16, block_size=1, storage="dense"))
 
@@ -133,6 +146,25 @@ _ALIASES = {
     "q8_0": "sym_int8",
     "fp8": "fp8_e5m2",  # reference maps plain "fp8" to e5m2 on most devices
 }
+
+
+# mixed qtypes: body format + higher-precision lm head (reference
+# gguf_mixed_qtype, ggml/quantize.py:60-61: *_s/*_m variants keep the
+# output layer at q6_k)
+MIXED_QTYPES = {
+    "q4_k_s": ("q4_k", "q6_k"),
+    "q4_k_m": ("q4_k", "q6_k"),
+}
+
+
+def split_mixed_qtype(name: str) -> tuple[str, "str | None"]:
+    """(body_qtype, lm_head_qtype|None) — resolves the mixed aliases so
+    every quantization entry point (optimize_model, quantize_params,
+    from_gguf, from_pretrained) accepts them uniformly."""
+    key = name.lower()
+    if key in MIXED_QTYPES:
+        return MIXED_QTYPES[key]
+    return name, None
 
 
 def qtype_registry() -> dict[str, QTypeSpec]:

@@ -117,6 +117,9 @@ class ApiServer:
                         evt = json.dumps({"token": tok, "text": text})
                         self.wfile.write(f"data: {evt}\n\n".encode())
                         self.wfile.flush()
+                    if req.error:
+                        err = json.dumps({"error": req.error})
+                        self.wfile.write(f"data: {err}\n\n".encode())
                     self.wfile.write(b"data: [DONE]\n\n")
                     return None
                 req = outer.engine.submit(ids, maxnt)
@@ -162,7 +165,7 @@ class ApiServer:
                 maxnt = int(payload.get("max_tokens", 64))
                 if payload.get("stream"):
                     q: queue.SimpleQueue = queue.SimpleQueue()
-                    outer.engine.submit(ids, maxnt, stream=q)
+                    req = outer.engine.submit(ids, maxnt, stream=q)
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.end_headers()
@@ -179,6 +182,9 @@ class ApiServer:
                             f"data: {json.dumps(chunk)}\n\n".encode()
                         )
                         self.wfile.flush()
+                    if req.error:
+                        err = json.dumps({"error": req.error})
+                        self.wfile.write(f"data: {err}\n\n".encode())
                     self.wfile.write(b"data: [DONE]\n\n")
                     return None
                 req = outer.engine.submit(ids, maxnt)

@@ -115,7 +115,9 @@ class InferenceEngine:
             cfg.head_dim_,
         )
         cache = dataclasses.replace(cache, start=start)
-        logits, cache = forward(cfg, params, tokens, cache, mode="prefill")
+        logits, cache = forward(
+            cfg, params, tokens, cache, mode="prefill", last_logits_only=True
+        )
         return logits[:, -1], cache
 
     def _insert_impl(self, cache, pcache, slot, pad):

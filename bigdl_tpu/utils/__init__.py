@@ -10,11 +10,14 @@ def round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-# KV caches are sized to 64-slot multiples so only a few distinct XLA
+# KV caches are sized to quantum multiples so only a few distinct XLA
 # programs are ever compiled per model — the TPU-shaped replacement for the
 # reference's KV_CACHE_ALLOC_BLOCK_LENGTH growth policy (models/utils.py:39).
+# Overridable via BIGDL_TPU_KV_CACHE_QUANTUM (utils/flags.py).
 CACHE_SLOT_QUANTUM = 64
 
 
 def cache_len_for(prompt_len: int, max_new_tokens: int) -> int:
-    return round_up(prompt_len + max_new_tokens, CACHE_SLOT_QUANTUM)
+    from bigdl_tpu.utils.flags import cache_slot_quantum
+
+    return round_up(prompt_len + max_new_tokens, cache_slot_quantum())

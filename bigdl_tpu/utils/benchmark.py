@@ -1,0 +1,127 @@
+"""Latency instrumentation.
+
+Role-equivalent of the reference's `BenchmarkWrapper` — six pinned forks
+of HF `generate` instrumented to record `first_cost` / `rest_cost_mean` /
+peak memory (utils/benchmark_util_4_29.py:489-519,2467-2476 + version
+dispatch utils/__init__.py:23-36 in /root/reference). Here no fork is
+needed: prefill and decode are separate jitted programs, so the wrapper
+times them directly and the numbers mean exactly what they claim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.generate import GenerationConfig, pad_prompts, sample_token
+from bigdl_tpu.utils import cache_len_for
+
+
+@dataclasses.dataclass
+class BenchResult:
+    first_cost_ms: float  # prefill (1st token) latency
+    rest_cost_mean_ms: float  # mean 2+ token latency
+    rest_cost_p90_ms: float
+    tokens_per_s: float
+    peak_memory_bytes: Optional[int]  # device peak (None off-TPU)
+    prompt_len: int
+    new_tokens: int
+
+    def row(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class BenchmarkedModel:
+    """Wraps a TpuModel: same generate() surface, but timed step by step
+    (the reference's `model = BenchmarkWrapper(model)` pattern)."""
+
+    def __init__(self, model):
+        self.model = model
+        self.results: list[BenchResult] = []
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int = 32,
+        **gen_kw,
+    ) -> np.ndarray:
+        model = self.model
+        config, params = model.config, model.params
+        gen = GenerationConfig(max_new_tokens=max_new_tokens, **gen_kw)
+        tokens_np, start = pad_prompts(list(prompts), gen.pad_token_id)
+        B, T = tokens_np.shape
+        cache_len = cache_len_for(T, max_new_tokens)
+
+        fwd = model.family.forward
+
+        def prefill(params, tokens, cache):
+            return fwd(config, params, tokens, cache, mode="prefill")
+
+        def decode(params, cur, cache):
+            return fwd(config, params, cur, cache, mode="decode")
+
+        prefill_j = jax.jit(prefill, donate_argnames=("cache",))
+        decode_j = jax.jit(decode, donate_argnames=("cache",))
+
+        def fresh_cache():
+            c = kvcache.init_cache(
+                config.num_hidden_layers, B, cache_len,
+                config.num_key_value_heads, config.head_dim_,
+            )
+            return dataclasses.replace(c, start=jnp.asarray(start))
+
+        # compile outside the timed region (the reference's wrapper also
+        # reports post-warmup numbers)
+        logits, cache = prefill_j(params, jnp.asarray(tokens_np), fresh_cache())
+        logits.block_until_ready()
+
+        key = jax.random.PRNGKey(0)
+        t0 = time.perf_counter()
+        logits, cache = prefill_j(params, jnp.asarray(tokens_np), fresh_cache())
+        cur = sample_token(logits[:, -1], key, gen)
+        cur.block_until_ready()
+        first_ms = (time.perf_counter() - t0) * 1000
+
+        out = [np.asarray(cur)]
+        rest: list[float] = []
+        for _ in range(max_new_tokens - 1):
+            key, k = jax.random.split(key)
+            t0 = time.perf_counter()
+            logits, cache = decode_j(params, cur[:, None], cache)
+            cur = sample_token(logits[:, -1], k, gen)
+            cur.block_until_ready()
+            rest.append((time.perf_counter() - t0) * 1000)
+            out.append(np.asarray(cur))
+
+        mem = None
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                mem = stats.get("peak_bytes_in_use")
+        except Exception:
+            pass
+
+        rest_arr = np.asarray(rest) if rest else np.asarray([first_ms])
+        total_s = (first_ms + rest_arr.sum()) / 1000
+        self.results.append(
+            BenchResult(
+                first_cost_ms=round(first_ms, 3),
+                rest_cost_mean_ms=round(float(rest_arr.mean()), 3),
+                rest_cost_p90_ms=round(float(np.percentile(rest_arr, 90)), 3),
+                tokens_per_s=round(B * max_new_tokens / total_s, 2),
+                peak_memory_bytes=mem,
+                prompt_len=T,
+                new_tokens=max_new_tokens,
+            )
+        )
+        return np.stack(out, axis=1)
+
+    @property
+    def last(self) -> BenchResult:
+        return self.results[-1]

@@ -1,0 +1,105 @@
+"""Embedding variants (low-bit / CPU / disk) + last-logits-only +
+env-flag defaults."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.embedding import HostEmbedding, embed_lookup, quantize_embedding
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS
+
+CFG = PRESETS["tiny-llama"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _forward(params, tokens, **kw):
+    cache = kvcache.init_cache(
+        CFG.num_hidden_layers, 1, 32, CFG.num_key_value_heads, CFG.head_dim_
+    )
+    return llama.forward(CFG, params, tokens, cache, mode="prefill", **kw)
+
+
+TOKENS = jnp.asarray([[3, 1, 4, 1, 5, 9]], jnp.int32)
+
+
+def test_low_bit_embedding_close(params):
+    ref, _ = _forward(params, TOKENS)
+    p2 = dict(params)
+    p2["embed"] = quantize_embedding(params["embed"], "sym_int8")
+    # tie: lm_head exists separately in init_params, so only input embedding
+    # is quantized here
+    out, _ = _forward(p2, TOKENS)
+    err = np.abs(np.asarray(out) - np.asarray(ref)).mean()
+    scale = np.abs(np.asarray(ref)).mean() + 1e-6
+    assert err / scale < 0.1, err / scale
+
+
+def test_host_embedding_exact(params):
+    ref, _ = _forward(params, TOKENS)
+    table = np.asarray(params["embed"], np.float32)
+    p2 = dict(params)
+    p2["embed"] = HostEmbedding(table, dtype=jnp.bfloat16)
+    out, _ = _forward(p2, TOKENS)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-2, atol=1e-2
+    )
+
+
+def test_disk_embedding(tmp_path, params):
+    path = str(tmp_path / "embed.npy")
+    np.save(path, np.asarray(params["embed"], np.float32))
+    he = HostEmbedding.from_file(path)
+    got = embed_lookup(he, TOKENS)
+    want = embed_lookup(params["embed"], TOKENS)
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), np.asarray(want, dtype=np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
+
+
+def test_host_embedding_under_jit(params):
+    he = HostEmbedding(np.asarray(params["embed"], np.float32))
+
+    @jax.jit
+    def f(toks):
+        return embed_lookup(he, toks)
+
+    out = f(TOKENS)
+    assert out.shape == (1, 6, CFG.hidden_size)
+
+
+def test_last_logits_only_matches(params):
+    full, _ = _forward(params, TOKENS)
+    last, _ = _forward(params, TOKENS, last_logits_only=True)
+    assert last.shape == (1, 1, CFG.vocab_size)
+    np.testing.assert_allclose(
+        np.asarray(last[:, 0]), np.asarray(full[:, -1]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_env_flag_defaults(monkeypatch):
+    from bigdl_tpu.utils import flags
+
+    monkeypatch.setenv("BIGDL_TPU_QUANTIZE_KV_CACHE", "1")
+    assert flags.quantize_kv_default()
+    monkeypatch.setenv("BIGDL_TPU_QUANTIZE_KV_CACHE", "0")
+    assert not flags.quantize_kv_default()
+    monkeypatch.setenv("BIGDL_TPU_COMPRESS_KV_CACHE", "1")
+    monkeypatch.setenv("BIGDL_TPU_COMPRESS_KV_BUDGET", "512")
+    assert flags.compress_kv_budget() == 512
+    monkeypatch.delenv("BIGDL_TPU_COMPRESS_KV_CACHE")
+    assert flags.compress_kv_budget() is None
+    monkeypatch.setenv("BIGDL_TPU_KV_CACHE_QUANTUM", "128")
+    from bigdl_tpu.utils import cache_len_for
+
+    assert cache_len_for(100, 50) == 256

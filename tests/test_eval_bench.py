@@ -1,0 +1,86 @@
+"""Perplexity evaluator + benchmark instrumentation tests."""
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu import optimize_model
+from bigdl_tpu.api import TpuModel
+from bigdl_tpu.eval import perplexity
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS
+from bigdl_tpu.utils.benchmark import BenchmarkedModel
+
+CFG = PRESETS["tiny-llama"]
+
+
+@pytest.fixture(scope="module")
+def model():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    return TpuModel(CFG, params, "bf16")
+
+
+@pytest.fixture(scope="module")
+def qmodel():
+    params = optimize_model(
+        llama.init_params(CFG, jax.random.PRNGKey(0)), CFG, "sym_int4"
+    )
+    return TpuModel(CFG, params, "sym_int4")
+
+
+def test_perplexity_random_model_near_uniform(model, rng):
+    """A tiny random-init model is near-uniform: ppl ≈ vocab size."""
+    ids = rng.integers(0, CFG.vocab_size, 300)
+    ppl = perplexity(model, ids, window=64)
+    assert 0.3 * CFG.vocab_size < ppl < 3 * CFG.vocab_size, ppl
+
+
+def test_perplexity_quantization_close(model, qmodel, rng):
+    """sym_int4 ppl within a few percent of dense — the reference README
+    quality-table contract (SURVEY.md §6)."""
+    ids = rng.integers(0, CFG.vocab_size, 400)
+    p_dense = perplexity(model, ids, window=64)
+    p_q = perplexity(qmodel, ids, window=64)
+    assert abs(np.log(p_q) - np.log(p_dense)) < 0.08, (p_dense, p_q)
+
+
+def test_perplexity_stride_overlap(model, rng):
+    """Every corpus token must be scored exactly once, for ANY stride
+    (regression: strided windows silently dropped window-stride tokens)."""
+    ids = rng.integers(0, CFG.vocab_size, 300)
+    for stride in (64, 32, 48):
+        p, n = perplexity(
+            model, ids, window=64, stride=stride, return_count=True
+        )
+        assert np.isfinite(p)
+        # first window scores 63 targets (token 0 has no context);
+        # disjoint-window boundaries (stride == window) each lose one
+        boundary_losses = (
+            (len(ids) - 1) // stride if stride == 64 else 0
+        )
+        assert n >= len(ids) - 1 - boundary_losses - 1, (stride, n)
+        assert n <= len(ids) - 1
+
+
+def test_benchmarked_model_records(qmodel):
+    bm = BenchmarkedModel(qmodel)
+    out = bm.generate([[3, 1, 4, 1, 5]], max_new_tokens=6)
+    assert out.shape == (1, 6)
+    r = bm.last
+    assert r.first_cost_ms > 0 and r.rest_cost_mean_ms > 0
+    assert r.new_tokens == 6 and r.tokens_per_s > 0
+    # instrumented output matches the fused generate path
+    want = qmodel.generate([[3, 1, 4, 1, 5]], max_new_tokens=6)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_runner_case(qmodel):
+    import sys
+
+    sys.path.insert(0, "benchmark")
+    from benchmark.run import run_case
+
+    r = run_case(qmodel, "transformer_int4", in_len=8, out_len=4, batch=1)
+    assert r["rest_cost_mean_ms"] > 0
+    r = run_case(qmodel, "serving_engine", in_len=8, out_len=4, batch=2)
+    assert r["tokens_per_s"] > 0

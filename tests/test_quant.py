@@ -35,6 +35,8 @@ _TOL = {
     "fp6": 0.08,
     "fp8_e4m3": 0.04,
     "fp8_e5m2": 0.12,
+    "q4_k": 0.13,  # two-level RTN scales (quant/kquants.py)
+    "q6_k": 0.025,
 }
 
 
