@@ -234,6 +234,127 @@ def _glm_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     return out
 
 
+def _gpt2_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """GPT-2 stores linears as Conv1D ([in, out] — transposed) with a fused
+    c_attn [in, 3H]."""
+    p = f"transformer.h.{i}."
+    H = config.hidden_size
+    c_attn = get(p + "attn.c_attn.weight").T  # [3H, H]
+    b_attn = get(p + "attn.c_attn.bias")
+    return {
+        "attn_norm": get(p + "ln_1.weight"),
+        "attn_norm_b": get(p + "ln_1.bias"),
+        "mlp_norm": get(p + "ln_2.weight"),
+        "mlp_norm_b": get(p + "ln_2.bias"),
+        "wq": c_attn[:H], "wk": c_attn[H:2 * H], "wv": c_attn[2 * H:],
+        "bq": b_attn[:H], "bk": b_attn[H:2 * H], "bv": b_attn[2 * H:],
+        "wo": get(p + "attn.c_proj.weight").T,
+        "bo": get(p + "attn.c_proj.bias"),
+        "w_up": get(p + "mlp.c_fc.weight").T,
+        "b_up": get(p + "mlp.c_fc.bias"),
+        "w_down": get(p + "mlp.c_proj.weight").T,
+        "b_down": get(p + "mlp.c_proj.bias"),
+    }
+
+
+def _gpt2_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    return {
+        "embed": get("transformer.wte.weight"),
+        "wpe": get("transformer.wpe.weight"),
+        "final_norm": get("transformer.ln_f.weight"),
+        "final_norm_b": get("transformer.ln_f.bias"),
+    }
+
+
+def _split_headwise_qkv(fused: np.ndarray, n_heads: int, head_dim: int):
+    """[heads*3*D, H] fused per head (bloom/gptneox query_key_value) →
+    (q, k, v) each [heads*D, H]."""
+    H_in = fused.shape[-1]
+    g = fused.reshape(n_heads, 3, head_dim, H_in)
+    return (
+        g[:, 0].reshape(-1, H_in),
+        g[:, 1].reshape(-1, H_in),
+        g[:, 2].reshape(-1, H_in),
+    )
+
+
+def _bloom_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    p = f"transformer.h.{i}."
+    D = config.head_dim_
+    nh = config.num_attention_heads
+    wq, wk, wv = _split_headwise_qkv(
+        get(p + "self_attention.query_key_value.weight"), nh, D
+    )
+    bq, bk, bv = (
+        b.reshape(-1)
+        for b in _split_headwise_qkv(
+            get(p + "self_attention.query_key_value.bias").reshape(-1, 1), nh, D
+        )
+    )
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "attn_norm_b": get(p + "input_layernorm.bias"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "mlp_norm_b": get(p + "post_attention_layernorm.bias"),
+        "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+        "wo": get(p + "self_attention.dense.weight"),
+        "bo": get(p + "self_attention.dense.bias"),
+        "w_up": get(p + "mlp.dense_h_to_4h.weight"),
+        "b_up": get(p + "mlp.dense_h_to_4h.bias"),
+        "w_down": get(p + "mlp.dense_4h_to_h.weight"),
+        "b_down": get(p + "mlp.dense_4h_to_h.bias"),
+    }
+
+
+def _bloom_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    return {
+        "embed": get("transformer.word_embeddings.weight"),
+        "embed_norm": get("transformer.word_embeddings_layernorm.weight"),
+        "embed_norm_b": get("transformer.word_embeddings_layernorm.bias"),
+        "final_norm": get("transformer.ln_f.weight"),
+        "final_norm_b": get("transformer.ln_f.bias"),
+    }
+
+
+def _gptneox_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    p = f"gpt_neox.layers.{i}."
+    D = config.head_dim_
+    nh = config.num_attention_heads
+    wq, wk, wv = _split_headwise_qkv(
+        get(p + "attention.query_key_value.weight"), nh, D
+    )
+    bq, bk, bv = (
+        b.reshape(-1)
+        for b in _split_headwise_qkv(
+            get(p + "attention.query_key_value.bias").reshape(-1, 1), nh, D
+        )
+    )
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "attn_norm_b": get(p + "input_layernorm.bias"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "mlp_norm_b": get(p + "post_attention_layernorm.bias"),
+        "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+        "wo": get(p + "attention.dense.weight"),
+        "bo": get(p + "attention.dense.bias"),
+        "w_up": get(p + "mlp.dense_h_to_4h.weight"),
+        "b_up": get(p + "mlp.dense_h_to_4h.bias"),
+        "w_down": get(p + "mlp.dense_4h_to_h.weight"),
+        "b_down": get(p + "mlp.dense_4h_to_h.bias"),
+    }
+
+
+def _gptneox_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    out = {
+        "embed": get("gpt_neox.embed_in.weight"),
+        "final_norm": get("gpt_neox.final_layer_norm.weight"),
+        "final_norm_b": get("gpt_neox.final_layer_norm.bias"),
+    }
+    if not config.tie_word_embeddings:
+        out["lm_head"] = get("embed_out.weight")
+    return out
+
+
 def _mixtral_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     p = f"model.layers.{i}."
     E = config.num_experts
@@ -295,6 +416,9 @@ _FAMILY_LAYER = {
     "internlm2": _internlm2_layer,
     "starcoder2": _starcoder2_layer,
     "glm": _glm_layer,
+    "gpt2": _gpt2_layer,
+    "bloom": _bloom_layer,
+    "gpt_neox": _gptneox_layer,
     "mixtral": _mixtral_layer,
     "qwen2_moe": _qwen2_moe_layer,
 }
@@ -302,6 +426,9 @@ _FAMILY_LAYER = {
 _FAMILY_TOP = {
     "baichuan": _baichuan_top,
     "internlm2": _internlm2_top,
+    "gpt2": _gpt2_top,
+    "bloom": _bloom_top,
+    "gpt_neox": _gptneox_top,
 }
 
 

@@ -1,0 +1,17 @@
+"""Ecosystem integrations (reference: `langchain/` LLM+embeddings classes,
+`llamaindex/` IpexLLM class — SURVEY.md §2.2). Imports are gated: each
+adapter activates only when its framework is installed."""
+
+__all__ = ["BigdlTpuLLM", "BigdlTpuLlamaIndexLLM"]
+
+
+def __getattr__(name):
+    if name == "BigdlTpuLLM":
+        from bigdl_tpu.integrations.langchain import BigdlTpuLLM
+
+        return BigdlTpuLLM
+    if name == "BigdlTpuLlamaIndexLLM":
+        from bigdl_tpu.integrations.llamaindex import BigdlTpuLlamaIndexLLM
+
+        return BigdlTpuLlamaIndexLLM
+    raise AttributeError(name)

@@ -1,0 +1,100 @@
+"""LangChain adapter.
+
+Equivalent of the reference's `langchain/llms/transformersllm.py`
+(`TransformersLLM`, :61) and embeddings classes: wraps a TpuModel +
+tokenizer behind LangChain's `LLM` interface. When langchain isn't
+installed the same class still works as a plain callable text generator
+(duck-typed `_call`/`invoke`), so the adapter is testable without the
+framework.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+try:  # langchain >= 0.1 layout
+    from langchain_core.language_models.llms import LLM as _BaseLLM
+
+    _HAVE_LANGCHAIN = True
+except ImportError:  # standalone fallback with the same surface
+    _HAVE_LANGCHAIN = False
+
+    class _BaseLLM:  # type: ignore[no-redef]
+        def invoke(self, prompt: str, **kw) -> str:
+            return self._call(prompt, **kw)
+
+
+class BigdlTpuLLM(_BaseLLM):
+    """LangChain LLM over a bigdl_tpu model.
+
+        llm = BigdlTpuLLM.from_model_id("/path/to/ckpt", load_in_low_bit="sym_int4")
+        llm.invoke("Q: What is a TPU?\nA:")
+    """
+
+    model: Any = None
+    tokenizer: Any = None
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+
+    def __init__(self, model=None, tokenizer=None, max_new_tokens: int = 128,
+                 temperature: float = 0.0, **kw):
+        if _HAVE_LANGCHAIN:
+            super().__init__(
+                model=model, tokenizer=tokenizer,
+                max_new_tokens=max_new_tokens, temperature=temperature, **kw
+            )
+        else:
+            self.model = model
+            self.tokenizer = tokenizer
+            self.max_new_tokens = max_new_tokens
+            self.temperature = temperature
+
+    class Config:
+        arbitrary_types_allowed = True
+
+    @classmethod
+    def from_model_id(
+        cls, model_id: str, load_in_low_bit: str = "sym_int4", **kw
+    ) -> "BigdlTpuLLM":
+        from bigdl_tpu.api import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            model_id, load_in_low_bit=load_in_low_bit
+        )
+        tokenizer = None
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(model_id)
+        except Exception:
+            pass
+        return cls(model=model, tokenizer=tokenizer, **kw)
+
+    @property
+    def _llm_type(self) -> str:
+        return "bigdl-tpu"
+
+    def _call(
+        self,
+        prompt: str,
+        stop: Optional[List[str]] = None,
+        run_manager: Any = None,
+        **kwargs: Any,
+    ) -> str:
+        if self.tokenizer is None:
+            raise ValueError("BigdlTpuLLM needs a tokenizer for text prompts")
+        ids = list(self.tokenizer(prompt)["input_ids"])
+        out = self.model.generate(
+            [ids],
+            max_new_tokens=kwargs.get("max_new_tokens", self.max_new_tokens),
+            do_sample=self.temperature > 0,
+            temperature=max(self.temperature, 1e-5),
+            eos_token_id=self.tokenizer.eos_token_id,
+        )
+        text = self.tokenizer.decode(out[0].tolist(), skip_special_tokens=True)
+        if stop:
+            for s in stop:
+                idx = text.find(s)
+                if idx >= 0:
+                    text = text[:idx]
+        return text

@@ -1,0 +1,101 @@
+"""LlamaIndex adapter.
+
+Equivalent of the reference's `llamaindex/llms/bigdlllm.py` (`IpexLLM`
+class): exposes a TpuModel through LlamaIndex's CustomLLM interface when
+llama_index is installed; otherwise a standalone class with `complete()`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+try:
+    from llama_index.core.llms import (
+        CompletionResponse,
+        CustomLLM,
+        LLMMetadata,
+    )
+    from llama_index.core.llms.callbacks import llm_completion_callback
+
+    _HAVE_LLAMAINDEX = True
+except ImportError:
+    _HAVE_LLAMAINDEX = False
+
+    class CustomLLM:  # type: ignore[no-redef]
+        pass
+
+    class CompletionResponse:  # type: ignore[no-redef]
+        def __init__(self, text: str):
+            self.text = text
+
+    def llm_completion_callback():  # type: ignore[no-redef]
+        def deco(fn):
+            return fn
+
+        return deco
+
+
+class BigdlTpuLlamaIndexLLM(CustomLLM):
+    model: Any = None
+    tokenizer: Any = None
+    max_new_tokens: int = 128
+    context_window: int = 4096
+
+    def __init__(self, model=None, tokenizer=None, max_new_tokens: int = 128,
+                 context_window: int = 4096, **kw):
+        if _HAVE_LLAMAINDEX:
+            super().__init__(
+                model=model, tokenizer=tokenizer,
+                max_new_tokens=max_new_tokens,
+                context_window=context_window, **kw
+            )
+        else:
+            self.model = model
+            self.tokenizer = tokenizer
+            self.max_new_tokens = max_new_tokens
+            self.context_window = context_window
+
+    class Config:
+        arbitrary_types_allowed = True
+
+    @classmethod
+    def from_model_id(cls, model_id: str, load_in_low_bit: str = "sym_int4", **kw):
+        from bigdl_tpu.api import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            model_id, load_in_low_bit=load_in_low_bit
+        )
+        tokenizer = None
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(model_id)
+        except Exception:
+            pass
+        return cls(model=model, tokenizer=tokenizer, **kw)
+
+    @property
+    def metadata(self):
+        if _HAVE_LLAMAINDEX:
+            return LLMMetadata(
+                context_window=self.context_window,
+                num_output=self.max_new_tokens,
+                model_name="bigdl-tpu",
+            )
+        return {"model_name": "bigdl-tpu"}
+
+    @llm_completion_callback()
+    def complete(self, prompt: str, **kwargs: Any) -> "CompletionResponse":
+        ids = list(self.tokenizer(prompt)["input_ids"])
+        out = self.model.generate(
+            [ids],
+            max_new_tokens=kwargs.get("max_new_tokens", self.max_new_tokens),
+            eos_token_id=self.tokenizer.eos_token_id,
+        )
+        text = self.tokenizer.decode(out[0].tolist(), skip_special_tokens=True)
+        return CompletionResponse(text=text)
+
+    @llm_completion_callback()
+    def stream_complete(self, prompt: str, **kwargs: Any):
+        # single-shot fallback streaming (chunk = full completion)
+        yield self.complete(prompt, **kwargs)

@@ -30,6 +30,9 @@ _FAMILIES = {
     "glm": llama,
     # chatglm (THUDM trust_remote_code schema) needs its own config/weights
     # translator before it can be registered — not silently aliased to glm.
+    "gpt2": llama,
+    "bloom": llama,
+    "gpt_neox": llama,
     "mixtral": llama,
     "qwen2_moe": llama,
     "yi": llama,

@@ -61,6 +61,9 @@ class ModelConfig:
     partial_rotary_factor: float = 1.0  # stablelm 0.25, glm 0.5
     rope_interleaved: bool = False  # GPT-NeoX/GLM pair-interleaved rope
     alibi: bool = False  # baichuan-13b/bloom attention-bias positions
+    learned_positions: bool = False  # gpt2 wpe table (rope disabled)
+    parallel_residual: bool = False  # gptneox: h += attn(x) + mlp(x)
+    embed_layernorm: bool = False  # bloom word_embeddings_layernorm
     # MoE (mixtral / qwen2_moe); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -240,6 +243,57 @@ def _hf_glm(hf, kw):
     kw.setdefault("head_dim", hf.get("head_dim"))
 
 
+def _hf_gpt2(hf, kw):
+    kw["hidden_size"] = hf.get("n_embd", 768)
+    kw["num_hidden_layers"] = hf.get("n_layer", 12)
+    kw["num_attention_heads"] = hf.get("n_head", 12)
+    kw["num_key_value_heads"] = kw["num_attention_heads"]
+    kw["intermediate_size"] = hf.get("n_inner") or 4 * kw["hidden_size"]
+    kw["max_position_embeddings"] = hf.get("n_positions", 1024)
+    kw["rms_norm_eps"] = hf.get("layer_norm_epsilon", 1e-5)
+    kw["norm_type"] = "layernorm"
+    kw["norm_bias"] = True
+    kw["gated_mlp"] = False
+    kw["mlp_bias"] = True
+    kw["attention_bias"] = True
+    kw["attention_out_bias"] = True
+    kw["learned_positions"] = True
+    kw["hidden_act"] = hf.get("activation_function", "gelu_new")
+    kw.setdefault("tie_word_embeddings", True)
+
+
+def _hf_bloom(hf, kw):
+    kw["num_hidden_layers"] = hf.get("n_layer", 24)
+    kw["num_attention_heads"] = hf.get("n_head", 16)
+    kw["num_key_value_heads"] = kw["num_attention_heads"]
+    kw["intermediate_size"] = 4 * kw.get("hidden_size", hf.get("hidden_size", 64))
+    kw["rms_norm_eps"] = hf.get("layer_norm_epsilon", 1e-5)
+    kw["norm_type"] = "layernorm"
+    kw["norm_bias"] = True
+    kw["gated_mlp"] = False
+    kw["mlp_bias"] = True
+    kw["attention_bias"] = True
+    kw["attention_out_bias"] = True
+    kw["alibi"] = True
+    kw["embed_layernorm"] = True
+    kw["hidden_act"] = "gelu_pytorch_tanh"
+    kw.setdefault("tie_word_embeddings", True)
+
+
+def _hf_gptneox(hf, kw):
+    kw["norm_type"] = "layernorm"
+    kw["norm_bias"] = True
+    kw["gated_mlp"] = False
+    kw["mlp_bias"] = True
+    kw["attention_bias"] = True
+    kw["attention_out_bias"] = True
+    kw["parallel_residual"] = hf.get("use_parallel_residual", True)
+    kw.setdefault("partial_rotary_factor", hf.get("rotary_pct", 0.25))
+    kw["rope_theta"] = hf.get("rotary_emb_base", 10000.0)
+    kw["rms_norm_eps"] = hf.get("layer_norm_eps", 1e-5)
+    kw["hidden_act"] = hf.get("hidden_act", "gelu")
+
+
 def _hf_mixtral(hf, kw):
     kw["num_experts"] = hf.get("num_local_experts", 8)
     kw["num_experts_per_tok"] = hf.get("num_experts_per_tok", 2)
@@ -268,6 +322,9 @@ _HF_BUILDERS = {
     "internlm2": _hf_internlm2,
     "minicpm": _hf_minicpm,
     "glm": _hf_glm,
+    "gpt2": _hf_gpt2,
+    "bloom": _hf_bloom,
+    "gpt_neox": _hf_gptneox,
     "mixtral": _hf_mixtral,
     "qwen2_moe": _hf_qwen2_moe,
 }

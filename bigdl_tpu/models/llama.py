@@ -120,6 +120,11 @@ def init_params(
     }
     if config.norm_bias:
         params["final_norm_b"] = jnp.zeros((H,), dtype)
+    if config.learned_positions:
+        params["wpe"] = w((config.max_position_embeddings, H))
+    if config.embed_layernorm:
+        params["embed_norm"] = jnp.ones((H,), dtype)
+        params["embed_norm_b"] = jnp.zeros((H,), dtype)
     if not config.tie_word_embeddings:
         params["lm_head"] = w((V, H))
     return params
@@ -327,23 +332,30 @@ def forward(
         pos0 = cache.pos
         row_start = cache.start
 
-    if input_is_hidden:
-        h = tokens.astype(compute_dtype)
-    else:
-        h = embed_tokens(config, params, tokens, compute_dtype)
-
-    # Rotary tables: positions are relative to each row's start (left pad);
-    # after SnapKV compression slots ≠ positions and the cache carries the
-    # true next position in rope_base. pos may be per-row (serving engine).
+    # Positions are relative to each row's start (left pad); after SnapKV
+    # compression slots ≠ positions and the cache carries the true next
+    # position in rope_base. pos may be per-row (serving engine).
     pos_col = pos0[:, None] if pos0.ndim == 1 else pos0
     slots = pos_col + jnp.arange(T)[None, :]  # [B|1, T] global cache slots
     if cache is not None:
         positions = cache.next_positions(T)  # [B, T]
     else:
         positions = jnp.maximum(slots - row_start[:, None], 0)  # [B, T]
-    if config.alibi:
-        cos = sin = None
+
+    if input_is_hidden:
+        h = tokens.astype(compute_dtype)
     else:
+        h = embed_tokens(config, params, tokens, compute_dtype)
+        if config.learned_positions:  # gpt2 wpe table
+            h = h + params["wpe"].astype(compute_dtype)[positions]
+        if config.embed_layernorm:  # bloom word_embeddings_layernorm
+            h = layer_norm(
+                h, params["embed_norm"], params.get("embed_norm_b"),
+                config.rms_norm_eps,
+            )
+
+    use_rope = not (config.alibi or config.learned_positions)
+    if use_rope:
         inv_freq, att_scale = make_inv_freq_scaled(
             config.rotary_dim, config.rope_theta, config.rope_scaling_dict,
             seq_len=(cache.max_len if cache is not None else T),
@@ -352,6 +364,8 @@ def forward(
             positions, inv_freq, interleaved=config.rope_interleaved,
             scale=att_scale,
         )
+    else:
+        cos = sin = None
 
     # Prefill goes through the Pallas flash-attention kernel (no [T,S]
     # score matrix in HBM); decode and the differentiable cache-free
@@ -434,7 +448,7 @@ def forward(
         if config.qk_norm:
             q = rms_norm(q, p["q_norm"], eps, offset=config.rms_norm_offset)
             k = rms_norm(k, p["k_norm"], eps, offset=config.rms_norm_offset)
-        if not config.alibi:
+        if use_rope:
             q, k = apply_rotary_emb(q, k, cos, sin, config.rope_interleaved)
 
         if c is not None:
@@ -467,9 +481,15 @@ def forward(
         if config.post_attn_norm:
             out = norm(out, p["post_attn_norm"])
         rs = config.residual_scale
-        hidden = hidden + (out * rs if rs else out)
+        if config.parallel_residual:
+            # gptneox: attention and MLP both read the SAME layer input;
+            # residual adds both at once
+            mlp_in = norm(hidden, p["mlp_norm"], p.get("mlp_norm_b"))
+        else:
+            hidden = hidden + (out * rs if rs else out)
+            mlp_in = norm(hidden, p["mlp_norm"], p.get("mlp_norm_b"))
 
-        x = norm(hidden, p["mlp_norm"], p.get("mlp_norm_b"))
+        x = mlp_in
         if config.is_moe:
             down = _moe_mlp(config, x, p, compute_dtype)
         elif config.gated_mlp:
@@ -481,7 +501,10 @@ def forward(
             down = proj(_act(config.hidden_act, up), p, lp, "w_down", "b_down")
         if config.post_attn_norm:
             down = norm(down, p["post_mlp_norm"])
-        hidden = hidden + (down * rs if rs else down)
+        if config.parallel_residual:
+            hidden = hidden + out + down
+        else:
+            hidden = hidden + (down * rs if rs else down)
 
         ys = q[:, T - collect_obs:] if collect_obs else None
         return (hidden, c, idx + 1), ys

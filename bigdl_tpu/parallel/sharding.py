@@ -84,6 +84,10 @@ def param_specs(config: ModelConfig, tie_word_embeddings: bool | None = None) ->
     }
     if config.norm_bias:
         specs["final_norm_b"] = _REP
+    if config.learned_positions:
+        specs["wpe"] = _REP
+    if config.embed_layernorm:
+        specs.update({"embed_norm": _REP, "embed_norm_b": _REP})
     if not tie:
         specs["lm_head"] = P("tp", None)
     return specs

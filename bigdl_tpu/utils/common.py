@@ -1,0 +1,46 @@
+"""Logging + error helpers.
+
+Equivalent of the reference's `utils/common/log4Error.py`
+(`invalidInputError` / `invalidOperationError` / log4Error) — the
+error-reporting idiom used across its codebase — plus a namespaced
+logger factory.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, NoReturn, Optional
+
+
+def get_logger(name: str = "bigdl_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s: %(message)s")
+        )
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+    return logger
+
+
+def invalid_input_error(condition: Any, msg: str, fix: Optional[str] = None) -> None:
+    """Raise ValueError with an actionable message unless `condition`
+    (reference invalidInputError: logs then raises RuntimeError; here the
+    exception type matches the error class)."""
+    if not condition:
+        full = msg if fix is None else f"{msg}. {fix}"
+        get_logger().error(full)
+        raise ValueError(full)
+
+
+def invalid_operation_error(condition: Any, msg: str) -> None:
+    if not condition:
+        get_logger().error(msg)
+        raise RuntimeError(msg)
+
+
+def log_warning_once(msg: str, _seen: set = set()) -> None:  # noqa: B006
+    if msg not in _seen:
+        _seen.add(msg)
+        get_logger().warning(msg)

@@ -186,6 +186,53 @@ def test_qwen2_moe_equivalence():
     assert config.shared_expert_intermediate_size == 64
 
 
+def test_gpt2_equivalence():
+    cfg, model = hf_tiny(
+        "GPT2LMHeadModel", "GPT2Config",
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        n_inner=128, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    config = check(cfg, model)
+    assert config.learned_positions and config.norm_type == "layernorm"
+    assert not config.gated_mlp and config.tie_word_embeddings
+
+
+def test_bloom_equivalence():
+    cfg, model = hf_tiny(
+        "BloomForCausalLM", "BloomConfig",
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    config = check(cfg, model, tol=5e-3)
+    assert config.alibi and config.embed_layernorm
+    assert config.norm_type == "layernorm" and not config.gated_mlp
+
+
+def test_gptneox_equivalence():
+    cfg, model = hf_tiny(
+        "GPTNeoXForCausalLM", "GPTNeoXConfig",
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128, rotary_pct=0.25,
+        use_parallel_residual=True, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    config = check(cfg, model)
+    assert config.parallel_residual and config.rotary_dim == 4
+    assert not config.tie_word_embeddings
+
+
+def test_gptneox_sequential_residual():
+    cfg, model = hf_tiny(
+        "GPTNeoXForCausalLM", "GPTNeoXConfig",
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128, rotary_pct=1.0,
+        use_parallel_residual=False, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    config = check(cfg, model)
+    assert not config.parallel_residual
+
+
 def test_phi3_longrope_top_level_injection():
     """HF phi3 keeps original/max position embeddings at config top level;
     from_hf_config must fold them into rope_scaling so the longrope
