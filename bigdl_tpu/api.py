@@ -16,6 +16,7 @@ and runs the whole decode loop on device.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Optional, Sequence, Union
 
 import jax
@@ -79,12 +80,29 @@ class TpuModel:
 
         if isinstance(prompts, np.ndarray):
             prompts = [list(row) for row in prompts]
+        if not prompts:
+            raise ValueError("prompts is empty — nothing to generate")
         # env-flag defaults (reference IPEX_LLM_QUANTIZE_KV_CACHE /
         # IPEX_LLM_COMPRESS_KV_CACHE / IPEX_LLM_PERFORMANCE_MODE)
         if not quantize_kv:
             quantize_kv = flags.quantize_kv_default()
         if compress_kv is None:
             compress_kv = flags.compress_kv_budget()
+        if (
+            compress_kv is not None
+            and max(len(p) for p in prompts) > compress_kv  # would apply
+            and (self.config.sliding_window or self.config.alibi)
+        ):
+            # After SnapKV compression cache slots no longer correspond to
+            # token positions, so sliding-window masks and ALiBi
+            # slot-distance biases become incoherent (the reference gates
+            # DynamicCompressCache by model type the same way —
+            # models/utils.py:317-331).
+            warnings.warn(
+                "SnapKV compress_kv skipped: incompatible with "
+                "sliding-window/ALiBi attention for this config"
+            )
+            compress_kv = None
         if (
             flags.performance_mode()
             and not do_sample

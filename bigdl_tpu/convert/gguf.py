@@ -12,8 +12,10 @@ into our QTensor formats without a dequant/requant round trip:
 - Q4_1 → asym_int4 (d·q + m, identical numerics, nibble reorder).
 - Q8_0 → sym_int8 (bytes carried over unchanged).
 - Q5_0/Q5_1 → sym_int5/asym_int5 (high bit unpacked from qh).
-- K-quants (Q4_K/Q6_K) and floats are dequantized to fp32 and re-quantized
-  to the requested qtype (no exact container for super-blocks yet).
+- K-quants (Q4_K/Q6_K) are repacked natively, keeping the ggml super-block
+  byte layout as a `ggml_block` QTensor decoded in-graph
+  (quant/kquants.py); remaining float tensors are dequantized to fp32 and
+  re-quantized to the requested qtype.
 
 The llama.cpp converter permutes Wq/Wk rows (interleaved→half rope
 conversion); import un-permutes them (same fix the reference applies in

@@ -21,10 +21,12 @@ from bigdl_tpu.models.config import ModelConfig
 
 
 @functools.partial(jax.jit, static_argnames=("config", "forward"))
-def _window_nll(config: ModelConfig, forward, params, tokens, valid):
+def _window_nll(config: ModelConfig, forward, params, tokens, valid, start):
     """tokens [1, T]; valid [T-1] marks target positions scored in this
-    window (stride overlap is context only). Returns (sum_nll, n)."""
-    logits, _ = forward(config, params, tokens[:, :-1], None)
+    window (stride overlap is context only); start [1] left-pad offset so
+    pad tokens are masked out of attention and consume no rope positions
+    (the HF/reference strided protocol). Returns (sum_nll, n)."""
+    logits, _ = forward(config, params, tokens[:, :-1], None, start=start)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     tgt = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32), -1)[0, :, 0]
@@ -58,8 +60,9 @@ def perplexity(
     for begin in range(0, max(len(ids) - 1, 1), stride):
         end = min(begin + window, len(ids))
         chunk = ids[end - window:end] if end >= window else ids[:end]
-        if len(chunk) < window:  # left-pad the first/short window
-            chunk = np.concatenate([np.zeros(window - len(chunk), np.int32), chunk])
+        pad = window - len(chunk)
+        if pad:  # left-pad the first/short window; start masks the pads
+            chunk = np.concatenate([np.zeros(pad, np.int32), chunk])
         # score only tokens not already scored (HF strided protocol:
         # windows overlap by window - stride as pure context)
         new_targets = min(end - prev_end, window - 1, end - 1)
@@ -69,7 +72,7 @@ def perplexity(
         valid[window - 1 - new_targets:] = 1.0
         s, n = _window_nll(
             model.config, fwd, model.params, jnp.asarray(chunk[None]),
-            jnp.asarray(valid),
+            jnp.asarray(valid), jnp.asarray([pad], jnp.int32),
         )
         total += float(s)
         count += float(n)

@@ -200,13 +200,15 @@ class InferenceEngine:
 
     def _emit(self, slot: int, token: int) -> None:
         s = self._slots[slot]
+        eos = self.gen.eos_token_id
+        if eos is not None and token == eos:
+            # the EOS id terminates the stream but is not generated text
+            self._finish(slot, "stop")
+            return
         s.req.out_tokens.append(token)
         if s.req.stream is not None:
             s.req.stream.put(token)
-        eos = self.gen.eos_token_id
-        if eos is not None and token == eos:
-            self._finish(slot, "stop")
-        elif s.remaining <= 0:
+        if s.remaining <= 0:
             self._finish(slot, "length")
 
     def _finish(self, slot: int, reason: str = "stop") -> None:

@@ -5,7 +5,9 @@ other in-flight requests, staggered admission) must produce exactly the
 greedy tokens the plain `model.generate` path yields for the same
 prompt — continuous batching is a scheduling optimization, never a
 quality change (the reference's PPModelWorker makes the same implicit
-promise, pipeline_parallel.py:482-929).
+promise, pipeline_parallel.py:482-929). One sanctioned divergence: with
+eos_token_id set, the engine finishes the request WITHOUT emitting the
+EOS id itself, while model.generate includes it (then pads).
 """
 
 import json
@@ -81,7 +83,8 @@ def test_engine_eos_frees_slot(model):
     r1 = eng.submit(PROMPTS[0], max_new_tokens=8)
     r2 = eng.submit(PROMPTS[1], max_new_tokens=4)
     eng.run_until_idle(max_steps=100)
-    assert r1.done and r1.out_tokens[-1] == eos and len(r1.out_tokens) == 3
+    # the EOS id itself is not emitted as text (finish_reason records it)
+    assert r1.done and r1.out_tokens == ref[:2] and r1.finish_reason == "stop"
     assert r2.done and len(r2.out_tokens) == 4
 
 
