@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import warnings
-from typing import Optional, Sequence, Union
+from typing import Any, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -45,10 +45,68 @@ class TpuModel:
     config: ModelConfig
     params: dict
     qtype: str
+    # set by to_mesh(): params are sharded over this jax.sharding.Mesh and
+    # every generate/serving entry point runs SPMD under it
+    mesh: Optional[Any] = None
 
     @property
     def family(self):
         return get_family(self.config.model_type)
+
+    def to_mesh(self, mesh=None, tp: Optional[int] = None,
+                dp: Optional[int] = None, sp: int = 1) -> "TpuModel":
+        """Shard the params for multi-chip inference and make generate()
+        / the serving engine run SPMD over the mesh.
+
+        Megatron-style TP: column-parallel qkv/gate/up, row-parallel
+        o/down, vocab-sharded embed+head (parallel/sharding.py). The
+        reference reaches the same point via DeepSpeed-AutoTP module
+        detection + an explicit mp_group.all_reduce
+        (convert.py:152-234, low_bit_linear.py:675-682); here the
+        PartitionSpecs make XLA insert the psums over ICI.
+
+        mesh=None builds a (dp, sp, tp) mesh over all visible devices
+        (tp defaulting to every device).
+        """
+        from bigdl_tpu.parallel import make_mesh, shard_params
+        from bigdl_tpu.parallel.mesh import mesh_shape_for
+        from bigdl_tpu.parallel.sharding import param_specs
+
+        if mesh is None:
+            n = len(jax.devices())
+            if tp is not None and dp is not None:
+                # fully specified: use exactly dp*sp*tp devices (a subset
+                # of the host's devices is fine)
+                if dp * sp * tp > n:
+                    raise ValueError(
+                        f"dp*sp*tp = {dp * sp * tp} exceeds {n} devices"
+                    )
+                mesh = make_mesh(
+                    (dp, sp, tp), devices=jax.devices()[: dp * sp * tp]
+                )
+            else:
+                mesh = make_mesh(mesh_shape_for(n, tp=tp, dp=dp, sp=sp))
+        if "tp" not in mesh.axis_names:
+            raise ValueError(
+                f"mesh axes {mesh.axis_names} lack 'tp' — param_specs "
+                "shard weights over a 'tp' axis (use make_mesh(..., "
+                "axes=('dp','sp','tp')))"
+            )
+        if self.config.num_key_value_heads % (tp_size := mesh.shape["tp"]):
+            raise ValueError(
+                f"num_key_value_heads={self.config.num_key_value_heads} "
+                f"not divisible by tp={tp_size}"
+            )
+        self.mesh = mesh
+        self.params = shard_params(self.params, param_specs(self.config), mesh)
+        return self
+
+    def _mesh_ctx(self):
+        import contextlib
+
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        return jax.set_mesh(self.mesh)
 
     def save_low_bit(self, path: str) -> None:
         from bigdl_tpu.convert import save_low_bit
@@ -130,20 +188,21 @@ class TpuModel:
         budget = 0
         if compress_kv is not None and tokens.shape[1] > compress_kv:
             budget = compress_kv
-        out = generate_tokens(
-            self.config,
-            self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(start),
-            jax.random.PRNGKey(seed),
-            gen,
-            self.family.forward,
-            cache_len=cache_len,
-            quantize_kv=quantize_kv,
-            compress_budget=budget,
-            compress_window=min(compress_window, max(budget - 1, 1)),
-            last_logits=flags.last_lm_head_default(),
-        )
+        with self._mesh_ctx():
+            out = generate_tokens(
+                self.config,
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(start),
+                jax.random.PRNGKey(seed),
+                gen,
+                self.family.forward,
+                cache_len=cache_len,
+                quantize_kv=quantize_kv,
+                compress_budget=budget,
+                compress_window=min(compress_window, max(budget - 1, 1)),
+                last_logits=flags.last_lm_head_default(),
+            )
         return np.asarray(out)
 
 

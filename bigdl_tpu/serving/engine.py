@@ -81,29 +81,61 @@ class InferenceEngine:
         self._queue: "queue.SimpleQueue[Request]" = queue.SimpleQueue()
         self._slots = [_Slot() for _ in range(n_slots)]
         self._rid = itertools.count()
+        # model sharded via TpuModel.to_mesh(): all jitted steps run SPMD
+        # under the mesh, with the KV pool sharded over kv heads ('tp')
+        self._mesh = getattr(model, "mesh", None)
 
-        cfg = self.config
-        self.cache = kvcache.init_cache(
-            cfg.num_hidden_layers, n_slots, max_len,
-            cfg.num_key_value_heads, cfg.head_dim_,
-        )
-        # per-row positions from the start (idle rows park at 0)
-        self.cache = dataclasses.replace(
-            self.cache, pos=jnp.zeros((n_slots,), jnp.int32)
-        )
+        self.cache = self._make_pool()
         self.cur = jnp.zeros((n_slots,), jnp.int32)  # last token per slot
         self.active = np.zeros((n_slots,), bool)  # host-side mask
 
-        self._decode = jax.jit(
+        self._decode = self._with_mesh(jax.jit(
             functools.partial(self._decode_impl, self.model.family.forward),
             static_argnames=("gen",),
             donate_argnames=("cache",),
-        )
-        self._prefill = jax.jit(
+        ))
+        self._prefill = self._with_mesh(jax.jit(
             functools.partial(self._prefill_impl, self.model.family.forward),
             static_argnames=("bucket",),
+        ))
+        self._insert = self._with_mesh(jax.jit(
+            self._insert_impl, donate_argnames=("cache",)
+        ))
+
+    def _with_mesh(self, fn):
+        if self._mesh is None:
+            return fn
+
+        def wrapped(*a, **k):
+            with jax.set_mesh(self._mesh):
+                return fn(*a, **k)
+
+        return wrapped
+
+    def _make_pool(self):
+        """The shared KV pool, per-row positions from the start (idle rows
+        park at 0); sharded over kv heads when the model is on a mesh."""
+        cfg = self.config
+        cache = kvcache.init_cache(
+            cfg.num_hidden_layers, self.n_slots, self.max_len,
+            cfg.num_key_value_heads, cfg.head_dim_,
         )
-        self._insert = jax.jit(self._insert_impl, donate_argnames=("cache",))
+        cache = dataclasses.replace(
+            cache, pos=jnp.zeros((self.n_slots,), jnp.int32)
+        )
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kv_sh = NamedSharding(self._mesh, P(None, None, None, "tp", None))
+            rep = NamedSharding(self._mesh, P())
+            cache = dataclasses.replace(
+                cache,
+                k=jax.device_put(cache.k, kv_sh),
+                v=jax.device_put(cache.v, kv_sh),
+                pos=jax.device_put(cache.pos, rep),
+                start=jax.device_put(cache.start, rep),
+            )
+        return cache
 
     # ---- jitted pieces ----------------------------------------------------
 
@@ -223,14 +255,7 @@ class InferenceEngine:
     def _reset_state(self) -> None:
         """Rebuild the (possibly donated-away) cache after a failed decode
         so the engine can keep serving new requests."""
-        cfg = self.config
-        self.cache = kvcache.init_cache(
-            cfg.num_hidden_layers, self.n_slots, self.max_len,
-            cfg.num_key_value_heads, cfg.head_dim_,
-        )
-        self.cache = dataclasses.replace(
-            self.cache, pos=jnp.zeros((self.n_slots,), jnp.int32)
-        )
+        self.cache = self._make_pool()
         self.cur = jnp.zeros((self.n_slots,), jnp.int32)
         self.active[:] = False
 

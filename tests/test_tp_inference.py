@@ -1,0 +1,114 @@
+"""Tensor-parallel inference through the user API (VERDICT round-1 #3).
+
+Oracle: TP is a layout change, never a math change — a model sharded via
+`TpuModel.to_mesh()` must emit byte-identical greedy tokens to the same
+model single-device, through both `generate()` and the continuous-
+batching engine. Covers the BASELINE Mixtral-TP4 shape class with a
+scaled-down MoE config. Reference mechanism being replaced:
+DeepSpeed-AutoTP sharded-linear detection + mp_group all-reduce
+(convert.py:152-234, low_bit_linear.py:675-682).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigdl_tpu.api import TpuModel
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.parallel import make_mesh
+
+PROMPTS = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8]]
+
+
+def _dense_cfg():
+    return ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+        head_dim=16, max_position_embeddings=256,
+    )
+
+
+def _moe_cfg():
+    # mixtral-shaped: 8 experts, top-2, renormalized router weights
+    return ModelConfig(
+        model_type="mixtral", vocab_size=256, hidden_size=64,
+        intermediate_size=96, num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=4, head_dim=16, num_experts=8,
+        num_experts_per_tok=2, norm_topk_prob=True,
+        max_position_embeddings=256,
+    )
+
+
+def _model(cfg, seed=0):
+    params = llama.quantize_params(
+        llama.init_params(cfg, jax.random.PRNGKey(seed)), "sym_int4"
+    )
+    return TpuModel(config=cfg, params=params, qtype="sym_int4")
+
+
+@pytest.mark.parametrize("make_cfg", [_dense_cfg, _moe_cfg], ids=["dense", "moe"])
+def test_tp_generate_matches_single_device(make_cfg):
+    cfg = make_cfg()
+    ref = _model(cfg).generate(PROMPTS, max_new_tokens=16)
+
+    mesh = make_mesh((1, 1, 4), devices=jax.devices()[:4])
+    tp_model = _model(cfg).to_mesh(mesh)
+    # params really are distributed
+    leaf = tp_model.params["layers"]["wq"].data
+    assert len(leaf.sharding.device_set) == 4
+    out = tp_model.generate(PROMPTS, max_new_tokens=16)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_tp_generate_with_dp_axis():
+    """dp>1: batch rows sharded over the data axis, weights over tp.
+
+    Byte-identity is only promised for pure TP (same per-device batch
+    shape); dp changes the per-shard matmul shapes, so XLA may reorder
+    reductions and near-tie argmaxes can flip. Oracle here: prefill
+    logits within bf16 tolerance, and generation runs clean."""
+    cfg = _dense_cfg()
+    model = _model(cfg)
+    tokens = np.asarray([[3, 1, 4, 1, 5, 9, 2, 6]] * 2, np.int32)
+    ref_logits, _ = model.family.forward(cfg, model.params, tokens, None)
+
+    mesh = make_mesh((2, 1, 2), devices=jax.devices()[:4])
+    tp_model = _model(cfg).to_mesh(mesh)
+    with tp_model._mesh_ctx():
+        got_logits, _ = jax.jit(
+            lambda p, t: tp_model.family.forward(cfg, p, t, None)
+        )(tp_model.params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(got_logits), atol=2e-2, rtol=2e-2
+    )
+    out = tp_model.generate(PROMPTS, max_new_tokens=12)
+    assert np.asarray(out).shape == (2, 12)
+
+
+def test_tp_engine_matches_single_device():
+    from bigdl_tpu.serving.engine import InferenceEngine
+
+    cfg = _dense_cfg()
+    model = _model(cfg)
+    ref = model.generate([PROMPTS[0]], max_new_tokens=8)[0].tolist()
+
+    mesh = make_mesh((1, 1, 4), devices=jax.devices()[:4])
+    eng = InferenceEngine(model.to_mesh(mesh), n_slots=2, max_len=128)
+    r1 = eng.submit(PROMPTS[0], max_new_tokens=8)
+    r2 = eng.submit(PROMPTS[1], max_new_tokens=6)
+    eng.run_until_idle(max_steps=60)
+    assert r1.done and r2.done
+    assert r1.out_tokens == ref
+
+
+def test_tp_rejects_indivisible_heads():
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=64,
+    )
+    mesh = make_mesh((1, 1, 4), devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="not divisible"):
+        _model(cfg).to_mesh(mesh)
