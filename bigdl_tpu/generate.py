@@ -58,6 +58,53 @@ def sample_token(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_token_per_row(
+    logits: jax.Array,  # [B, V] float32
+    key: jax.Array,
+    temperature: jax.Array,  # [B] float32
+    top_k: jax.Array,  # [B] int32, <=0 disables
+    top_p: jax.Array,  # [B] float32, >=1 disables
+    do_sample: jax.Array,  # [B] bool
+) -> jax.Array:
+    """Per-row sampling with TRACED parameters — every row of a batch can
+    carry its own temperature/top-k/top-p (the serving engine's
+    per-request sampling; the reference serves one sampling config per
+    worker, model_worker.py:28-200, so this exceeds it). Rows with
+    do_sample=False take the plain argmax.
+    """
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def run_sampling(_):
+        lt = logits / jnp.maximum(temperature, 1e-5)[:, None]
+        sorted_desc = jnp.sort(lt, axis=-1)[:, ::-1]
+        # top-k first: threshold at the k-th largest value per row
+        kth = jnp.take_along_axis(
+            sorted_desc, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=-1
+        )
+        lt_k = jnp.where((top_k > 0)[:, None] & (lt < kth), -jnp.inf, lt)
+        # top-p (nucleus) over the top-k-FILTERED, renormalized
+        # distribution (HF order; matches sample_token): -inf survivors
+        # sort last and carry zero probability
+        sorted_k = jnp.sort(lt_k, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_k, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True) - 1
+        cutoff = jnp.take_along_axis(
+            sorted_k, jnp.clip(cutoff_idx, 0, V - 1), axis=-1
+        )
+        masked = jnp.where((top_p < 1.0)[:, None] & (lt_k < cutoff),
+                           -jnp.inf, lt_k)
+        return jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+
+    # all-greedy batches (the serving engine's common case) skip the
+    # full-vocab sort/softmax entirely
+    sampled = jax.lax.cond(
+        jnp.any(do_sample), run_sampling, lambda _: greedy, operand=None
+    )
+    return jnp.where(do_sample, sampled, greedy)
+
+
 def pad_prompts(
     prompts: Sequence[Sequence[int]], pad_id: int, bucket: Optional[int] = None
 ) -> tuple[np.ndarray, np.ndarray]:

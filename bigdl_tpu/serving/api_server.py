@@ -31,6 +31,33 @@ from typing import Any, Optional
 from bigdl_tpu.serving.engine import InferenceEngine
 
 
+def _sampling_kwargs(payload: dict) -> dict:
+    """OpenAI-ish request fields → per-request engine sampling kwargs.
+    temperature<=0 means greedy (the OpenAI convention); presence of a
+    positive temperature / top_p<1 / top_k>0 implies sampling unless
+    do_sample is given explicitly."""
+    kw: dict = {}
+    if "temperature" in payload:
+        t = float(payload["temperature"])
+        if t <= 0:
+            kw["do_sample"] = False
+        else:
+            kw.update(do_sample=True, temperature=t)
+    if "top_p" in payload:
+        kw["top_p"] = float(payload["top_p"])  # 1.0 = explicit disable
+        if kw["top_p"] < 1.0:
+            kw.setdefault("do_sample", True)
+    if "top_k" in payload:
+        kw["top_k"] = int(payload["top_k"])  # 0 = explicit disable
+        if kw["top_k"] > 0:
+            kw.setdefault("do_sample", True)
+    if "do_sample" in payload:
+        kw["do_sample"] = bool(payload["do_sample"])
+    if "eos_token_id" in payload:
+        kw["eos_token_id"] = int(payload["eos_token_id"])
+    return kw
+
+
 class _EngineThread(threading.Thread):
     def __init__(self, engine: InferenceEngine):
         super().__init__(daemon=True)
@@ -108,7 +135,8 @@ class ApiServer:
                 maxnt = int(payload.get("max_new_tokens", payload.get("max_tokens", 64)))
                 if stream:
                     q: queue.SimpleQueue = queue.SimpleQueue()
-                    req = outer.engine.submit(ids, maxnt, stream=q)
+                    req = outer.engine.submit(ids, maxnt, stream=q,
+                                              **_sampling_kwargs(payload))
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.end_headers()
@@ -122,7 +150,8 @@ class ApiServer:
                         self.wfile.write(f"data: {err}\n\n".encode())
                     self.wfile.write(b"data: [DONE]\n\n")
                     return None
-                req = outer.engine.submit(ids, maxnt)
+                req = outer.engine.submit(ids, maxnt,
+                                          **_sampling_kwargs(payload))
                 outer._wait(req)
                 if req.error:
                     return self._json(500, {"error": req.error})
@@ -136,7 +165,8 @@ class ApiServer:
             def _completions(self, payload):
                 ids = outer._encode(payload.get("prompt", ""))
                 maxnt = int(payload.get("max_tokens", 64))
-                req = outer.engine.submit(ids, maxnt)
+                req = outer.engine.submit(ids, maxnt,
+                                          **_sampling_kwargs(payload))
                 outer._wait(req)
                 if req.error:
                     return self._json(500, {"error": req.error})
@@ -165,7 +195,8 @@ class ApiServer:
                 maxnt = int(payload.get("max_tokens", 64))
                 if payload.get("stream"):
                     q: queue.SimpleQueue = queue.SimpleQueue()
-                    req = outer.engine.submit(ids, maxnt, stream=q)
+                    req = outer.engine.submit(ids, maxnt, stream=q,
+                                              **_sampling_kwargs(payload))
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.end_headers()
@@ -187,7 +218,8 @@ class ApiServer:
                         self.wfile.write(f"data: {err}\n\n".encode())
                     self.wfile.write(b"data: [DONE]\n\n")
                     return None
-                req = outer.engine.submit(ids, maxnt)
+                req = outer.engine.submit(ids, maxnt,
+                                          **_sampling_kwargs(payload))
                 outer._wait(req)
                 if req.error:
                     return self._json(500, {"error": req.error})

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu import kvcache
-from bigdl_tpu.generate import GenerationConfig, sample_token
+from bigdl_tpu.generate import GenerationConfig, sample_token_per_row
 from bigdl_tpu.models.config import ModelConfig
 from bigdl_tpu.utils import round_up
 
@@ -45,6 +45,14 @@ class Request:
     rid: int
     prompt: list[int]
     max_new_tokens: int = 64
+    # per-request sampling (None = engine default). These become traced
+    # per-slot tensors in the decode step, so two concurrent requests can
+    # sample with different temperatures in the same XLA program.
+    do_sample: Optional[bool] = None
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
     # filled by the engine
     out_tokens: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
@@ -57,12 +65,15 @@ class Request:
 class _Slot:
     req: Optional[Request] = None
     remaining: int = 0
+    eos: Optional[int] = None  # resolved per-request EOS id
 
 
 class InferenceEngine:
-    """model: a TpuModel (api.py). Greedy/sampled decoding per request is
-    limited to one shared GenerationConfig per engine for now (sampling
-    params are static to the jitted step)."""
+    """model: a TpuModel (api.py). Sampling params (do_sample /
+    temperature / top-k / top-p / eos) are PER REQUEST: they ride the
+    decode step as traced per-slot tensors, so concurrent requests with
+    different configs share one compiled program. The engine-level
+    GenerationConfig only provides defaults."""
 
     def __init__(
         self,
@@ -88,10 +99,16 @@ class InferenceEngine:
         self.cache = self._make_pool()
         self.cur = jnp.zeros((n_slots,), jnp.int32)  # last token per slot
         self.active = np.zeros((n_slots,), bool)  # host-side mask
+        # per-slot sampling params (host mirrors, shipped traced each step)
+        g = self.gen
+        self._temp = np.full((n_slots,), g.temperature, np.float32)
+        self._topk = np.full((n_slots,), g.top_k or 0, np.int32)
+        self._topp = np.full((n_slots,), g.top_p if g.top_p is not None else 1.0,
+                             np.float32)
+        self._dosample = np.full((n_slots,), g.do_sample, bool)
 
         self._decode = self._with_mesh(jax.jit(
             functools.partial(self._decode_impl, self.model.family.forward),
-            static_argnames=("gen",),
             donate_argnames=("cache",),
         ))
         self._prefill = self._with_mesh(jax.jit(
@@ -166,11 +183,14 @@ class InferenceEngine:
         start = cache.start.at[slot].set(pad)
         return dataclasses.replace(cache, k=k, v=v, pos=pos, start=start)
 
-    def _decode_impl(self, forward, params, cur, cache, key, gen):
+    def _decode_impl(self, forward, params, cur, cache, key,
+                     temp, topk, topp, dosample):
         logits, cache = forward(
             self.config, params, cur[:, None], cache, mode="decode"
         )
-        nxt = sample_token(logits[:, -1], key, gen)
+        nxt = sample_token_per_row(
+            logits[:, -1], key, temp, topk, topp, dosample
+        )
         return nxt, cache
 
     # ---- host API ---------------------------------------------------------
@@ -180,6 +200,11 @@ class InferenceEngine:
         prompt: list[int],
         max_new_tokens: int = 64,
         stream: Optional[queue.SimpleQueue] = None,
+        do_sample: Optional[bool] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token_id: Optional[int] = None,
     ) -> Request:
         # the decode window must fit the cache alongside a minimal prompt
         # bucket; clamp instead of letting _admit derive a zero/negative
@@ -188,9 +213,22 @@ class InferenceEngine:
         req = Request(
             rid=next(self._rid), prompt=list(prompt),
             max_new_tokens=max_new_tokens, stream=stream,
+            do_sample=do_sample, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
         )
         self._queue.put(req)
         return req
+
+    def _slot_sampling(self, req: Request) -> tuple[float, int, float, bool]:
+        """Resolve a request's sampling params against engine defaults."""
+        g = self.gen
+        temp = req.temperature if req.temperature is not None else g.temperature
+        topk = req.top_k if req.top_k is not None else (g.top_k or 0)
+        topp = req.top_p if req.top_p is not None else (
+            g.top_p if g.top_p is not None else 1.0
+        )
+        dosample = req.do_sample if req.do_sample is not None else g.do_sample
+        return float(temp), int(topk or 0), float(topp), bool(dosample)
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
@@ -220,19 +258,32 @@ class InferenceEngine:
                 self.model.params, jnp.asarray(tokens),
                 jnp.asarray([pad], jnp.int32), bucket=bucket,
             )
+            temp, topk, topp, dosample = self._slot_sampling(req)
             self._rng, k = jax.random.split(self._rng)
-            first = int(sample_token(logits_last, k, self.gen)[0])
+            first = int(sample_token_per_row(
+                logits_last, k,
+                jnp.asarray([temp], jnp.float32),
+                jnp.asarray([topk], jnp.int32),
+                jnp.asarray([topp], jnp.float32),
+                jnp.asarray([dosample], jnp.bool_),
+            )[0])
             self.cache = self._insert(
                 self.cache, pcache, jnp.asarray(slot), jnp.asarray(pad)
             )
             self.cur = self.cur.at[slot].set(first)
-            self._slots[slot] = _Slot(req=req, remaining=req.max_new_tokens - 1)
+            eos = (req.eos_token_id if req.eos_token_id is not None
+                   else self.gen.eos_token_id)
+            self._slots[slot] = _Slot(
+                req=req, remaining=req.max_new_tokens - 1, eos=eos
+            )
+            self._temp[slot], self._topk[slot] = temp, topk
+            self._topp[slot], self._dosample[slot] = topp, dosample
             self.active[slot] = True
             self._emit(slot, first)
 
     def _emit(self, slot: int, token: int) -> None:
         s = self._slots[slot]
-        eos = self.gen.eos_token_id
+        eos = s.eos
         if eos is not None and token == eos:
             # the EOS id terminates the stream but is not generated text
             self._finish(slot, "stop")
@@ -251,6 +302,7 @@ class InferenceEngine:
             s.req.stream.put(None)
         self._slots[slot] = _Slot()
         self.active[slot] = False
+        self._dosample[slot] = False  # idle rows decode deterministic garbage
 
     def _reset_state(self) -> None:
         """Rebuild the (possibly donated-away) cache after a failed decode
@@ -268,7 +320,9 @@ class InferenceEngine:
         self._rng, k = jax.random.split(self._rng)
         try:
             nxt, self.cache = self._decode(
-                self.model.params, self.cur, self.cache, k, self.gen
+                self.model.params, self.cur, self.cache, k,
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), jnp.asarray(self._dosample),
             )
         except Exception:
             # the donated cache buffer is gone — rebuild before re-raising

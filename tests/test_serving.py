@@ -164,3 +164,71 @@ def test_api_server_endpoints(model):
         assert toks == want
     finally:
         server.shutdown()
+
+
+def test_per_request_sampling_independent_streams(model):
+    """VERDICT round-1 #10: two concurrent requests with different
+    sampling params produce correct independent streams in ONE compiled
+    decode program.
+
+    Oracles: (a) a greedy request must still match model.generate while a
+    hot-temperature sampled request shares the batch; (b) a sampled
+    request with top_k=1 IS argmax, so it must also match the greedy
+    reference despite going through the sampling branch."""
+    ref0 = model.generate([PROMPTS[0]], max_new_tokens=10)[0].tolist()
+    ref1 = model.generate([PROMPTS[1]], max_new_tokens=10)[0].tolist()
+
+    eng = InferenceEngine(model, n_slots=3, max_len=128)
+    greedy = eng.submit(PROMPTS[0], max_new_tokens=10)
+    hot = eng.submit(PROMPTS[2], max_new_tokens=10,
+                     do_sample=True, temperature=5.0)
+    topk1 = eng.submit(PROMPTS[1], max_new_tokens=10,
+                       do_sample=True, temperature=3.0, top_k=1)
+    eng.run_until_idle(max_steps=100)
+    assert greedy.done and hot.done and topk1.done
+    assert greedy.out_tokens == ref0
+    assert topk1.out_tokens == ref1
+    assert len(hot.out_tokens) == 10
+
+
+def test_per_request_eos(model):
+    ref = model.generate([PROMPTS[0]], max_new_tokens=8)[0].tolist()
+    eng = InferenceEngine(model, n_slots=2, max_len=128)
+    # same prompt, two different per-request EOS ids
+    r_stop = eng.submit(PROMPTS[0], max_new_tokens=8, eos_token_id=ref[2])
+    r_full = eng.submit(PROMPTS[0], max_new_tokens=8, eos_token_id=-1)
+    eng.run_until_idle(max_steps=100)
+    assert r_stop.out_tokens == ref[:2] and r_stop.finish_reason == "stop"
+    assert r_full.out_tokens == ref and r_full.finish_reason == "length"
+
+
+def test_server_sampling_passthrough(model):
+    from bigdl_tpu.serving.api_server import ApiServer
+
+    ref = model.generate([PROMPTS[0]], max_new_tokens=6)[0].tolist()
+    srv = ApiServer(model, host="127.0.0.1", port=0, n_slots=2, max_len=128)
+    srv.start()
+    try:
+        # temperature=0 → greedy per the OpenAI convention
+        body = json.dumps({"prompt": PROMPTS[0], "max_new_tokens": 6,
+                           "temperature": 0}).encode()
+        r = urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            ), timeout=60,
+        )
+        out = json.loads(r.read())
+        assert out["tokens"] == ref
+        # sampled with top_k=1 ≡ greedy, exercised through the HTTP layer
+        body = json.dumps({"prompt": PROMPTS[0], "max_new_tokens": 6,
+                           "temperature": 2.5, "top_k": 1}).encode()
+        r = urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            ), timeout=60,
+        )
+        assert json.loads(r.read())["tokens"] == ref
+    finally:
+        srv.shutdown()
