@@ -1,0 +1,441 @@
+"""Whisper encoder-decoder family (speech-to-text).
+
+TPU-native counterpart of the reference's whisper support
+(`transformers/models/whisper.py` in /root/reference, which patches HF
+WhisperAttention; the WER eval harness lives in
+`dev/benchmark/whisper/`). Instead of patching, the whole model is a
+pair of pure functions over one param pytree:
+
+- `encode`: conv1 → gelu → conv2(stride 2) → gelu → +learned positions →
+  pre-norm bidirectional transformer stack → final layernorm. One
+  `lax.scan` over stacked encoder layers (compile time O(1) in depth).
+- `forward`: the decoder — causal self-attention with the shared
+  `bigdl_tpu.kvcache` slot cache, cross-attention over encoder states
+  whose K/V are projected ONCE per utterance (`cross_kv`, the standard
+  encoder-decoder cache trick; the reference gets it for free from HF's
+  EncoderDecoderCache), pre-norm MLP, tied lm head.
+
+Quantization covers every linear projection (q/k/v/o, cross q/o, fc1/2)
+through the same QTensor machinery as the decoder-only zoo; the conv
+frontend and layernorms stay dense, mirroring the reference's policy of
+quantizing only nn.Linear (convert.py:469-750).
+
+HF weight-name translation lives in `params_from_hf` (layout identical
+to transformers WhisperForConditionalGeneration: k_proj carries no bias,
+q/v/out do; decoder positions are learned and offset by the cache
+position during decode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.kvcache import KVCache
+from bigdl_tpu.ops import attention, linear
+from bigdl_tpu.ops.norms import layer_norm
+from bigdl_tpu.quant import QTensor, quantize
+from bigdl_tpu.quant.qtypes import resolve_qtype
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class WhisperConfig:
+    model_type: str = "whisper"
+    vocab_size: int = 51865
+    num_mel_bins: int = 80
+    hidden_size: int = 384  # d_model
+    encoder_layers: int = 4
+    decoder_layers: int = 4
+    num_heads: int = 6
+    ffn_dim: int = 1536
+    max_source_positions: int = 1500
+    max_target_positions: int = 448
+    layer_norm_eps: float = 1e-5
+    scale_embedding: bool = False
+    activation: str = "gelu"
+    decoder_start_token_id: int = 50258
+    eos_token_id: int = 50257
+    pad_token_id: int = 50257
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf_config(cls, hf: dict) -> "WhisperConfig":
+        return cls(
+            vocab_size=hf["vocab_size"],
+            num_mel_bins=hf.get("num_mel_bins", 80),
+            hidden_size=hf["d_model"],
+            encoder_layers=hf["encoder_layers"],
+            decoder_layers=hf["decoder_layers"],
+            num_heads=hf["encoder_attention_heads"],
+            ffn_dim=hf.get("encoder_ffn_dim", 4 * hf["d_model"]),
+            max_source_positions=hf.get("max_source_positions", 1500),
+            max_target_positions=hf.get("max_target_positions", 448),
+            scale_embedding=hf.get("scale_embedding", False),
+            activation=hf.get("activation_function", "gelu"),
+            decoder_start_token_id=hf.get("decoder_start_token_id", 50258),
+            eos_token_id=hf.get("eos_token_id", 50257),
+            pad_token_id=hf.get("pad_token_id", 50257),
+        )
+
+
+def _act(config: WhisperConfig, x: jax.Array) -> jax.Array:
+    if config.activation == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    return jax.nn.gelu(x, approximate=True)
+
+
+# ---------------------------------------------------------------------------
+# init / HF ingest / quantize
+# ---------------------------------------------------------------------------
+
+_ENC_KEYS = ("wq", "wk", "wv", "wo", "fc1", "fc2")
+_DEC_KEYS = _ENC_KEYS + ("xwq", "xwk", "xwv", "xwo")
+
+
+def init_params(config: WhisperConfig, key: jax.Array, dtype=jnp.float32,
+                scale: float = 0.02) -> Params:
+    """Random init (tests/benchmarks run without checkpoints)."""
+    H, F, V = config.hidden_size, config.ffn_dim, config.vocab_size
+    keys = iter(jax.random.split(key, 64))
+
+    def w(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
+
+    def enc_layer(L):
+        return {
+            "ln1_w": jnp.ones((L, H), dtype), "ln1_b": jnp.zeros((L, H), dtype),
+            "wq": w((L, H, H)), "bq": jnp.zeros((L, H), dtype),
+            "wk": w((L, H, H)),
+            "wv": w((L, H, H)), "bv": jnp.zeros((L, H), dtype),
+            "wo": w((L, H, H)), "bo": jnp.zeros((L, H), dtype),
+            "ln2_w": jnp.ones((L, H), dtype), "ln2_b": jnp.zeros((L, H), dtype),
+            "fc1": w((L, F, H)), "b1": jnp.zeros((L, F), dtype),
+            "fc2": w((L, H, F)), "b2": jnp.zeros((L, H), dtype),
+        }
+
+    Le, Ld = config.encoder_layers, config.decoder_layers
+    dec = enc_layer(Ld)
+    dec.update({
+        "lnx_w": jnp.ones((Ld, H), dtype), "lnx_b": jnp.zeros((Ld, H), dtype),
+        "xwq": w((Ld, H, H)), "xbq": jnp.zeros((Ld, H), dtype),
+        "xwk": w((Ld, H, H)),
+        "xwv": w((Ld, H, H)), "xbv": jnp.zeros((Ld, H), dtype),
+        "xwo": w((Ld, H, H)), "xbo": jnp.zeros((Ld, H), dtype),
+    })
+    return {
+        "conv1_w": w((H, config.num_mel_bins, 3)), "conv1_b": jnp.zeros((H,), dtype),
+        "conv2_w": w((H, H, 3)), "conv2_b": jnp.zeros((H,), dtype),
+        "enc_pos": w((config.max_source_positions, H)),
+        "enc": enc_layer(Le),
+        "enc_ln_w": jnp.ones((H,), dtype), "enc_ln_b": jnp.zeros((H,), dtype),
+        "embed": w((V, H)),
+        "dec_pos": w((config.max_target_positions, H)),
+        "dec": dec,
+        "dec_ln_w": jnp.ones((H,), dtype), "dec_ln_b": jnp.zeros((H,), dtype),
+    }
+
+
+def params_from_hf(config: WhisperConfig, get, qtype: str = "bf16",
+                   dtype=jnp.float32) -> Params:
+    """Translate a transformers WhisperForConditionalGeneration state dict
+    (accessor `get(name) -> np.ndarray`) into our pytree, quantizing the
+    linear projections."""
+    spec = resolve_qtype(qtype)
+
+    def q(arr):
+        if spec.is_dense:
+            return jnp.asarray(arr, dtype)
+        return quantize(jnp.asarray(arr, jnp.float32), spec.name)
+
+    def d(arr):
+        return jnp.asarray(arr, dtype)
+
+    def attn(p, pre):
+        return {
+            f"{pre}wq": [p + "q_proj.weight", q],
+            f"{pre}bq": [p + "q_proj.bias", d],
+            f"{pre}wk": [p + "k_proj.weight", q],  # k_proj: no bias in HF
+            f"{pre}wv": [p + "v_proj.weight", q],
+            f"{pre}bv": [p + "v_proj.bias", d],
+            f"{pre}wo": [p + "out_proj.weight", q],
+            f"{pre}bo": [p + "out_proj.bias", d],
+        }
+
+    def stack(side: str, n: int) -> dict:
+        per = []
+        for i in range(n):
+            p = f"model.{side}.layers.{i}."
+            m = {
+                "ln1_w": [p + "self_attn_layer_norm.weight", d],
+                "ln1_b": [p + "self_attn_layer_norm.bias", d],
+                **attn(p + "self_attn.", ""),
+                "ln2_w": [p + "final_layer_norm.weight", d],
+                "ln2_b": [p + "final_layer_norm.bias", d],
+                "fc1": [p + "fc1.weight", q], "b1": [p + "fc1.bias", d],
+                "fc2": [p + "fc2.weight", q], "b2": [p + "fc2.bias", d],
+            }
+            if side == "decoder":
+                m.update({
+                    "lnx_w": [p + "encoder_attn_layer_norm.weight", d],
+                    "lnx_b": [p + "encoder_attn_layer_norm.bias", d],
+                    **attn(p + "encoder_attn.", "x"),
+                })
+            per.append({k: fn(get(name)) for k, (name, fn) in m.items()})
+        out = {}
+        for k in per[0]:
+            vals = [layer[k] for layer in per]
+            if isinstance(vals[0], QTensor):
+                out[k] = QTensor(
+                    data=jnp.stack([v.data for v in vals]),
+                    scales=jnp.stack([v.scales for v in vals]),
+                    mins=(jnp.stack([v.mins for v in vals])
+                          if vals[0].mins is not None else None),
+                    qtype=vals[0].qtype,
+                )
+            else:
+                out[k] = jnp.stack(vals)
+        return out
+
+    return {
+        "conv1_w": d(get("model.encoder.conv1.weight")),
+        "conv1_b": d(get("model.encoder.conv1.bias")),
+        "conv2_w": d(get("model.encoder.conv2.weight")),
+        "conv2_b": d(get("model.encoder.conv2.bias")),
+        "enc_pos": d(get("model.encoder.embed_positions.weight")),
+        "enc": stack("encoder", config.encoder_layers),
+        "enc_ln_w": d(get("model.encoder.layer_norm.weight")),
+        "enc_ln_b": d(get("model.encoder.layer_norm.bias")),
+        "embed": d(get("model.decoder.embed_tokens.weight")),
+        "dec_pos": d(get("model.decoder.embed_positions.weight")),
+        "dec": stack("decoder", config.decoder_layers),
+        "dec_ln_w": d(get("model.decoder.layer_norm.weight")),
+        "dec_ln_b": d(get("model.decoder.layer_norm.bias")),
+    }
+
+
+def quantize_params(params: Params, qtype: str) -> Params:
+    """Quantize the linear projections of a dense whisper tree."""
+    spec = resolve_qtype(qtype)
+    if spec.is_dense:
+        return params
+    out = dict(params)
+    for side, keys in (("enc", _ENC_KEYS), ("dec", _DEC_KEYS)):
+        blk = dict(params[side])
+        for k in keys:
+            if not isinstance(blk[k], QTensor):
+                blk[k] = quantize(blk[k], spec.name)
+        out[side] = blk
+    return out
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _mha(config, x_q, k, v, mask, compute_dtype):
+    B, T = x_q.shape[:2]
+    Hd, D = config.num_heads, config.head_dim
+    return attention(
+        x_q.reshape(B, T, Hd, D), k, v, mask
+    ).reshape(B, T, Hd * D)
+
+
+def encode(config: WhisperConfig, params: Params, mel: jax.Array,
+           compute_dtype=jnp.float32) -> jax.Array:
+    """mel [B, n_mels, T_audio] → encoder states [B, T_audio//2, H].
+
+    T_audio must be 2 * max_source_positions (whisper's fixed 30 s
+    window; shorter audio is zero-padded upstream, as in HF)."""
+    H = config.hidden_size
+    Hd, D = config.num_heads, config.head_dim
+    eps = config.layer_norm_eps
+    x = mel.astype(compute_dtype)
+
+    dn = ("NCH", "OIH", "NCH")
+    x = jax.lax.conv_general_dilated(
+        x, params["conv1_w"].astype(compute_dtype), (1,), [(1, 1)],
+        dimension_numbers=dn,
+    ) + params["conv1_b"].astype(compute_dtype)[None, :, None]
+    x = jax.nn.gelu(x, approximate=False)
+    x = jax.lax.conv_general_dilated(
+        x, params["conv2_w"].astype(compute_dtype), (2,), [(1, 1)],
+        dimension_numbers=dn,
+    ) + params["conv2_b"].astype(compute_dtype)[None, :, None]
+    x = jax.nn.gelu(x, approximate=False)
+
+    h = x.transpose(0, 2, 1)  # [B, S, H]
+    B, S, _ = h.shape
+    h = h + params["enc_pos"].astype(compute_dtype)[:S]
+
+    def body(hidden, p):
+        x = layer_norm(hidden, p["ln1_w"], p["ln1_b"], eps)
+        q = linear(x, p["wq"], p["bq"], compute_dtype)
+        k = linear(x, p["wk"], None, compute_dtype).reshape(B, S, Hd, D)
+        v = linear(x, p["wv"], p["bv"], compute_dtype).reshape(B, S, Hd, D)
+        a = _mha(config, q, k, v, None, compute_dtype)
+        hidden = hidden + linear(a, p["wo"], p["bo"], compute_dtype)
+        x = layer_norm(hidden, p["ln2_w"], p["ln2_b"], eps)
+        x = _act(config, linear(x, p["fc1"], p["b1"], compute_dtype))
+        hidden = hidden + linear(x, p["fc2"], p["b2"], compute_dtype)
+        return hidden, None
+
+    h, _ = jax.lax.scan(body, h, params["enc"])
+    return layer_norm(h, params["enc_ln_w"], params["enc_ln_b"], eps)
+
+
+def cross_kv(config: WhisperConfig, params: Params, enc: jax.Array,
+             compute_dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+    """Project encoder states to per-decoder-layer cross-attention K/V
+    ONCE per utterance: [Ld, B, S, Hd, D] each."""
+    B, S, _ = enc.shape
+    Hd, D = config.num_heads, config.head_dim
+
+    def body(_, p):
+        k = linear(enc, p["xwk"], None, compute_dtype).reshape(B, S, Hd, D)
+        v = linear(enc, p["xwv"], p["xbv"], compute_dtype).reshape(B, S, Hd, D)
+        return None, (k, v)
+
+    _, (xk, xv) = jax.lax.scan(body, None, params["dec"])
+    return xk, xv
+
+
+def forward(
+    config: WhisperConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32 decoder ids
+    cache: Optional[KVCache],
+    xk: jax.Array,  # [Ld, B, S, Hd, D] from cross_kv
+    xv: jax.Array,
+    mode: str = "prefill",
+    compute_dtype=jnp.float32,
+) -> tuple[jax.Array, Optional[KVCache]]:
+    """Decoder step. Returns (logits [B, T, V] f32, advanced cache)."""
+    assert mode in ("prefill", "decode")
+    B, T = tokens.shape
+    Hd, D = config.num_heads, config.head_dim
+    eps = config.layer_norm_eps
+
+    if cache is None:
+        pos0 = jnp.zeros((), jnp.int32)
+    else:
+        pos0 = cache.pos
+    positions = pos0 + jnp.arange(T)
+
+    h = params["embed"].astype(compute_dtype)[tokens]
+    if config.scale_embedding:
+        h = h * jnp.asarray(config.hidden_size ** 0.5, compute_dtype)
+    h = h + params["dec_pos"].astype(compute_dtype)[positions]
+
+    if cache is None:
+        tj = jnp.arange(T)
+        mask = (tj[None, :] <= tj[:, None])[None, None, None]  # [1,1,1,T,T]
+    else:
+        sj = jnp.arange(cache.max_len)
+        slots = pos0 + jnp.arange(T)
+        mask = (sj[None, :] <= slots[:, None])[None, None, None]
+
+    def body(carry, xs):
+        hidden, c, idx = carry
+        p, xk_l, xv_l = xs
+
+        x = layer_norm(hidden, p["ln1_w"], p["ln1_b"], eps)
+        q = linear(x, p["wq"], p["bq"], compute_dtype)
+        k = linear(x, p["wk"], None, compute_dtype).reshape(B, T, Hd, D)
+        v = linear(x, p["wv"], p["bv"], compute_dtype).reshape(B, T, Hd, D)
+        if c is not None:
+            c = kvcache.update_layer(c, idx, k, v)
+            k_att, v_att = kvcache.read_layer(c, idx, compute_dtype)
+        else:
+            k_att, v_att = k, v
+        a = _mha(config, q, k_att, v_att, mask, compute_dtype)
+        hidden = hidden + linear(a, p["wo"], p["bo"], compute_dtype)
+
+        x = layer_norm(hidden, p["lnx_w"], p["lnx_b"], eps)
+        qx = linear(x, p["xwq"], p["xbq"], compute_dtype)
+        ax = _mha(config, qx, xk_l, xv_l, None, compute_dtype)
+        hidden = hidden + linear(ax, p["xwo"], p["xbo"], compute_dtype)
+
+        x = layer_norm(hidden, p["ln2_w"], p["ln2_b"], eps)
+        x = _act(config, linear(x, p["fc1"], p["b1"], compute_dtype))
+        hidden = hidden + linear(x, p["fc2"], p["b2"], compute_dtype)
+        return (hidden, c, idx + 1), None
+
+    (h, cache, _), _ = jax.lax.scan(
+        body, (h, cache, jnp.zeros((), jnp.int32)), (params["dec"], xk, xv)
+    )
+
+    h = layer_norm(h, params["dec_ln_w"], params["dec_ln_b"], eps)
+    logits = jnp.einsum(
+        "bth,vh->btv", h, params["embed"].astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.float32)
+    if cache is not None:
+        cache = kvcache.advance(cache, T)
+    return logits, cache
+
+
+# ---------------------------------------------------------------------------
+# generation (greedy transcription loop, one compiled program)
+# ---------------------------------------------------------------------------
+
+def generate(
+    config: WhisperConfig,
+    params: Params,
+    mel: jax.Array,  # [B, n_mels, T_audio]
+    prompt_ids: jax.Array,  # [B, P] forced decoder prefix
+    max_new_tokens: int = 64,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Greedy seq2seq decode: encode once, prefill the forced prefix,
+    then a lax.while_loop emits tokens until EOS or budget (the
+    transcription path behind the server's /v1/audio/transcriptions —
+    reference serving/fastapi/api_server.py)."""
+
+    @jax.jit
+    def run(params, mel, prompt_ids):
+        enc = encode(config, params, mel, compute_dtype)
+        xk, xv = cross_kv(config, params, enc, compute_dtype)
+        B, P = prompt_ids.shape
+        cache = kvcache.init_cache(
+            config.decoder_layers, B, P + max_new_tokens + 1,
+            config.num_heads, config.head_dim, dtype=compute_dtype,
+        )
+        logits, cache = forward(
+            config, params, prompt_ids, cache, xk, xv, mode="prefill",
+            compute_dtype=compute_dtype,
+        )
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        out = jnp.full((B, max_new_tokens), config.pad_token_id, jnp.int32)
+        out = out.at[:, 0].set(first)
+        done = first == config.eos_token_id
+
+        def cond(state):
+            i, _, _, done, _ = state
+            return (i < max_new_tokens) & ~jnp.all(done)
+
+        def step(state):
+            i, cur, cache, done, out = state
+            logits, cache = forward(
+                config, params, cur[:, None], cache, xk, xv, mode="decode",
+                compute_dtype=compute_dtype,
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, config.pad_token_id, nxt)
+            done = done | (nxt == config.eos_token_id)
+            out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+            return (i + 1, nxt, cache, done, out)
+
+        state = (jnp.ones((), jnp.int32), first, cache, done, out)
+        return jax.lax.while_loop(cond, step, state)[4]
+
+    return run(params, mel, prompt_ids)
