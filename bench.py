@@ -1,21 +1,28 @@
-"""Headline benchmark: Llama-3-8B sym_int4 decode latency, batch=1.
+"""Headline benchmark: Llama-class sym_int4 decode latency + MFU, batch=1.
 
 Protocol mirrors the reference's all-in-one benchmark (1st-token latency
 + "2+ avg latency (ms/token)", dev/benchmark/all-in-one/config.yaml
-32-32 pairs; docs/mddocs/Quickstart/benchmark_quickstart.md): prefill 32
-tokens, decode 32, report mean decode ms/token.
+32-32 pairs; docs/mddocs/Quickstart/benchmark_quickstart.md:155): prefill
+32 tokens, decode 32, report mean decode ms/token. Additionally reports
+decode MBU/MFU and a QLoRA train-step MFU (BASELINE.md north star).
 
-Weights are random (the protocol measures kernels, not text quality) and
-are materialized in ONE jitted init program directly in quantized form on
-device. Round 1 failed with per-tensor eager init: ~20 separate XLA
-executables, each a slow remote-compile round trip on the tunneled bench
-TPU (BENCH_r01.json `remote_compile HTTP 500`). Now the whole run needs
-exactly 4 compiles (init, cache, prefill, decode), each logged to stderr,
-with a SIGALRM budget per model size so a hang degrades to a smaller
-config instead of producing no number.
+Architecture — every lesson from the two failed rounds is structural:
 
-Prints ONE JSON line; vs_baseline is measured against the 20 ms/token
-north-star target (BASELINE.json): >1.0 is better than target.
+* The parent process NEVER imports jax. It runs each candidate in a
+  subprocess with a wall-clock `subprocess.run(timeout=...)` kill — the
+  only mechanism that can interrupt a hung native remote-compile call
+  (SIGALRM demonstrably cannot, BENCH_r01/r02).
+* Candidates run SMALLEST-FIRST and every success is banked; the final
+  (single) JSON line is the best banked result, so a later hang degrades
+  the headline instead of erasing it.
+* Children do ZERO device-side init: params are materialized as host
+  numpy (random packed int4 codes + constant scales — the protocol
+  measures kernels, not text quality) and jax.device_put leaf by leaf.
+  The only compiles are cache-init, prefill, decode.
+* A candidate that fails for a non-timeout reason is retried once with
+  BIGDL_TPU_PALLAS=0 so a Mosaic kernel failure degrades to the XLA
+  fallback instead of zero output.
+* Exactly one JSON line is printed to stdout, guarded by a once-flag.
 """
 
 from __future__ import annotations
@@ -23,193 +30,363 @@ from __future__ import annotations
 import json
 import os
 import signal
+import subprocess
 import sys
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-
-import jax
-
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-
-import jax.numpy as jnp
-
-from bigdl_tpu import kvcache
-from bigdl_tpu.models import llama
-from bigdl_tpu.models.config import PRESETS, ModelConfig
-from bigdl_tpu.quant import QTensor
-from bigdl_tpu.quant.qtypes import resolve_qtype
-
+T0 = time.time()
 TARGET_MS = 20.0  # BASELINE.json north star: < 20 ms/token on v5e
 PREFILL, DECODE = 32, 32
-T0 = time.time()
+TOTAL_BUDGET_S = 840  # stay under the driver's patience; parent is pure python
 
 
 def log(msg: str) -> None:
     print(f"[bench +{time.time() - T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-class BenchTimeout(Exception):
-    pass
+def remaining() -> float:
+    return TOTAL_BUDGET_S - (time.time() - T0)
 
 
-def _on_alarm(signum, frame):
-    raise BenchTimeout("per-candidate time budget exceeded")
+# --------------------------------------------------------------------------
+# child: one decode candidate
+# --------------------------------------------------------------------------
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    return env
 
 
-def make_init_fn(config: ModelConfig, qtype: str = "sym_int4"):
-    """Whole quantized param tree from one traced program (one compile)."""
-    spec = resolve_qtype(qtype)
+def _host_params(config, qtype: str = "sym_int4"):
+    """Host-numpy quantized param tree — no device ops, no compiles.
 
-    def rq(key, shape, scale=0.02):
-        out, k_in = shape[-2], shape[-1]
-        lead = shape[:-2]
-        data = jax.random.randint(
-            key, (*lead, out, k_in // 2), 0, 255, dtype=jnp.int32
-        ).astype(jnp.uint8)
-        scales = jnp.full((*lead, out, k_in // spec.block_size), scale, jnp.float16)
-        return QTensor(data=data, scales=scales, mins=None, qtype=qtype)
+    Structure comes from jax.eval_shape over the real init+quantize path
+    so it is exactly what llama.forward expects; leaves are filled with
+    random packed codes (every int4 bit pattern decodes) and constant
+    scales. ~5 GB for llama3-8b, generated at memory speed by tiling one
+    random megabyte.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
-    L, H, I = config.num_hidden_layers, config.hidden_size, config.intermediate_size
-    V, QD, KD = config.vocab_size, config.q_dim, config.kv_dim
+    from bigdl_tpu.models import llama
 
-    def init(key):
-        keys = iter(jax.random.split(key, 16))
-        layers = {
-            "attn_norm": jnp.ones((L, H), jnp.bfloat16),
-            "mlp_norm": jnp.ones((L, H), jnp.bfloat16),
-            "wq": rq(next(keys), (L, QD, H)),
-            "wk": rq(next(keys), (L, KD, H)),
-            "wv": rq(next(keys), (L, KD, H)),
-            "wo": rq(next(keys), (L, H, QD)),
-            "w_gate": rq(next(keys), (L, I, H)),
-            "w_up": rq(next(keys), (L, I, H)),
-            "w_down": rq(next(keys), (L, H, I)),
-        }
-        embed = (
-            jax.random.normal(next(keys), (V, H), jnp.float32) * 0.02
-        ).astype(jnp.bfloat16)
-        return {
-            "embed": embed,
-            "layers": layers,
-            "final_norm": jnp.ones((H,), jnp.bfloat16),
-            "lm_head": rq(next(keys), (V, H)),
-        }
+    shape_tree = jax.eval_shape(
+        lambda k: llama.quantize_params(llama.init_params(config, k), qtype),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    rng = np.random.default_rng(0)
+    block = rng.integers(0, 256, 1 << 20, dtype=np.uint8)  # 1 MB entropy
 
-    return init
+    def leaf(x):
+        np_dtype = np.dtype(x.dtype)
+        n = int(np.prod(x.shape)) if x.shape else 1
+        if np.issubdtype(np_dtype, np.unsignedinteger):
+            reps = -(-n // block.size)
+            return np.tile(block, reps)[:n].reshape(x.shape).astype(np_dtype)
+        if np.issubdtype(np_dtype, np.integer):
+            return np.zeros(x.shape, np_dtype)
+        return np.full(x.shape, 0.02, np.float32).astype(np_dtype)
+
+    return jax.tree.map(leaf, shape_tree)
 
 
-def bench(config: ModelConfig, name: str) -> dict:
-    cache_len = 128
-    B = 1
+def child_decode(preset: str) -> dict:
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    import jax
 
-    log(f"{name}: compiling init")
-    params = jax.jit(make_init_fn(config))(jax.random.PRNGKey(0))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import kvcache
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+    from bigdl_tpu.utils import flops as F
+
+    config = PRESETS[preset]
+    device = jax.devices()[0]
+    cache_len, B = 128, 1
+
+    log(f"{preset}: materializing host params")
+    host = _host_params(config)
+    sizes = jax.tree.map(lambda a: a.nbytes, host)
+    total_mb = sum(jax.tree.leaves(sizes)) / 1e6
+    log(f"{preset}: {total_mb:.0f} MB host-ready, transferring")
+    t0 = time.time()
+    params = jax.tree.map(lambda a: jax.device_put(a, device), host)
     jax.block_until_ready(params)
-    log(f"{name}: params ready")
+    del host
+    dt = time.time() - t0
+    log(f"{preset}: transferred in {dt:.1f}s ({total_mb / max(dt, 1e-9):.0f} MB/s)")
 
-    cache_fn = jax.jit(
-        lambda: kvcache.init_cache(
+    cache0 = jax.block_until_ready(
+        jax.jit(lambda: kvcache.init_cache(
             config.num_hidden_layers, B, cache_len,
             config.num_key_value_heads, config.head_dim_,
-        )
+        ))()
     )
-    cache0 = jax.block_until_ready(cache_fn())
-    log(f"{name}: cache ready")
+    log(f"{preset}: cache ready")
 
-    def prefill(params, tokens, cache):
-        return llama.forward(
-            config, params, tokens, cache, mode="prefill", last_logits_only=True
-        )
-
-    def decode(params, tokens, cache):
-        return llama.forward(config, params, tokens, cache, mode="decode")
-
-    prefill_j = jax.jit(prefill)  # cache NOT donated: cache0 is reused
-    decode_j = jax.jit(decode, donate_argnames=("cache",))
+    prefill_j = jax.jit(  # cache NOT donated: cache0 reused for timing
+        lambda p, t, c: llama.forward(
+            config, p, t, c, mode="prefill", last_logits_only=True)
+    )
+    decode_j = jax.jit(
+        lambda p, t, c: llama.forward(config, p, t, c, mode="decode"),
+        donate_argnames=("c",),
+    )
 
     tokens = jnp.ones((B, PREFILL), jnp.int32)
     one = jnp.ones((B, 1), jnp.int32)
 
-    # warmup / compile
+    # Through the axon tunnel execution is fully async and even
+    # block_until_ready returns before the device finishes; only a real
+    # device->host fetch synchronizes, at ~65 ms RPC cost (measured, round
+    # 3). So all timings are marginal-cost: run K1 and K2 chained steps,
+    # fetch the last logits each time, and divide the difference — the
+    # fetch/RPC overhead cancels exactly.
+    fetch = lambda x: np.asarray(jax.device_get(x))
+
     logits, cache = prefill_j(params, tokens, cache0)
-    logits.block_until_ready()
-    log(f"{name}: prefill compiled")
+    fetch(logits)
+    log(f"{preset}: prefill compiled")
     logits, cache = decode_j(params, one, cache)
-    logits.block_until_ready()
-    log(f"{name}: decode compiled")
+    fetch(logits)
+    log(f"{preset}: decode compiled")
 
-    # timed: first-token (prefill) latency
+    def run_prefill_and_fetch():
+        t0 = time.perf_counter()
+        lg, _ = prefill_j(params, tokens, cache0)
+        fetch(lg)
+        return (time.perf_counter() - t0) * 1000
+
+    run_prefill_and_fetch()  # warm the dispatch path
+    # fetch-only baseline: trivial jitted op + same-size fetch
+    tiny = jax.jit(lambda l: l * 1.0)
+    lg, _ = prefill_j(params, tokens, cache0)
+    fetch(lg)
+    fetch(tiny(lg))  # compile tiny outside the timed region
     t0 = time.perf_counter()
-    logits, cache = prefill_j(params, tokens, cache0)
-    logits.block_until_ready()
-    first_ms = (time.perf_counter() - t0) * 1000
+    fetch(tiny(lg))
+    t_fetch = (time.perf_counter() - t0) * 1000
+    first_ms = max(run_prefill_and_fetch() - t_fetch, 0.05)
 
-    # timed: decode loop
-    t0 = time.perf_counter()
-    for _ in range(DECODE):
-        logits, cache = decode_j(params, one, cache)
-    logits.block_until_ready()
-    ms_per_tok = (time.perf_counter() - t0) * 1000 / DECODE
-    log(f"{name}: first {first_ms:.1f} ms, decode {ms_per_tok:.2f} ms/token")
+    def decode_run(k):
+        nonlocal cache
+        t0 = time.perf_counter()
+        lg = logits
+        for _ in range(k):
+            lg, cache = decode_j(params, one, cache)
+        fetch(lg)
+        return (time.perf_counter() - t0) * 1000
 
+    k1, k2 = 4, 4 + DECODE
+    decode_run(k1)  # warm the dispatch path
+    t1 = decode_run(k1)
+    t2 = decode_run(k2)
+    ms_per_tok = max((t2 - t1) / (k2 - k1), 1e-3)
+    tps = 1000.0 / ms_per_tok
+    log(f"{preset}: first {first_ms:.1f} ms, decode {ms_per_tok:.2f} ms/token "
+        f"(t_fetch {t_fetch:.0f} ms cancelled)")
+
+    ctx = PREFILL + DECODE // 2
+    mfu = F.mfu(F.decode_flops_per_token(config, ctx), tps, device)
+    mbu = F.mbu(F.decode_bytes_per_token(config, ctx), tps, device)
     return {
-        "metric": f"{name}_sym_int4_decode_latency",
+        "metric": f"{preset}_sym_int4_decode_latency",
         "value": round(ms_per_tok, 3),
         "unit": "ms/token",
         "vs_baseline": round(TARGET_MS / ms_per_tok, 3),
         "first_token_ms": round(first_ms, 1),
-        "tokens_per_s": round(1000.0 / ms_per_tok, 1),
+        "tokens_per_s": round(tps, 1),
+        "decode_mfu": round(mfu, 4) if mfu is not None else None,
+        "decode_mbu": round(mbu, 4) if mbu is not None else None,
         "protocol": f"in{PREFILL}-out{DECODE} batch=1 greedy",
-        "device": str(jax.devices()[0].platform),
+        "device": getattr(device, "device_kind", str(device.platform)),
+        "pallas": os.environ.get("BIGDL_TPU_PALLAS", "auto"),
     }
 
 
-TOTAL_BUDGET_S = 900  # watchdog: guarantee ONE JSON line even on native hang
+# --------------------------------------------------------------------------
+# child: QLoRA train-step MFU
+# --------------------------------------------------------------------------
+
+def child_train(preset: str) -> dict:
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+    from bigdl_tpu.train import init_lora, make_train_step
+    from bigdl_tpu.utils import flops as F
+
+    config = PRESETS[preset]
+    device = jax.devices()[0]
+    B, T = 1, 1024
+
+    log(f"train {preset}: materializing host params")
+    host = _host_params(config)
+    params = jax.tree.map(lambda a: jax.device_put(a, device), host)
+    jax.block_until_ready(params)
+    del host
+    log(f"train {preset}: params on device")
+
+    lora = init_lora(config, jax.random.PRNGKey(1), rank=8)
+    optimizer = optax.adamw(1e-4)
+    opt_state = optimizer.init(lora["layers"])
+    step = make_train_step(config, llama.forward, optimizer)
+    step_j = jax.jit(step, donate_argnames=("lora", "opt_state"))
+
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(1, config.vocab_size, (B, T + 1)),
+        jnp.int32,
+    )
+    mask = jnp.ones((B, T + 1), jnp.float32)
+
+    lora, opt_state, loss = step_j(params, lora, opt_state, tokens, mask)
+    log(f"train {preset}: step compiled, loss {float(loss):.3f}")
+
+    # marginal-cost timing (async tunnel — see child_decode): k steps +
+    # fetch vs 1 step + fetch, divided by the difference
+    def run_steps(k):
+        nonlocal lora, opt_state
+        t0 = time.perf_counter()
+        for _ in range(k):
+            lora, opt_state, loss = step_j(params, lora, opt_state, tokens, mask)
+        float(loss)
+        return time.perf_counter() - t0
+
+    run_steps(1)
+    t1 = run_steps(1)
+    t2 = run_steps(5)
+    step_s = max((t2 - t1) / 4, 1e-6)
+    tok_per_s = B * T / step_s
+    mfu = F.mfu(F.train_flops_per_token(config), tok_per_s, device)
+    log(f"train {preset}: {step_s * 1000:.0f} ms/step, "
+        f"{tok_per_s:.0f} tok/s, MFU {mfu if mfu is None else round(mfu, 3)}")
+    return {
+        "metric": f"{preset}_qlora_train_step",
+        "train_ms_per_step": round(step_s * 1000, 1),
+        "train_tokens_per_s": round(tok_per_s, 1),
+        "train_mfu": round(mfu, 4) if mfu is not None else None,
+        "train_shape": f"b{B}xs{T} rank8",
+    }
 
 
-def _watchdog():
-    """SIGALRM cannot interrupt a hung native (remote-compile RPC) call —
-    the round-1 failure mode. This daemon thread guarantees the driver
-    still gets a parseable JSON line before hard exit."""
-    time.sleep(TOTAL_BUDGET_S)
-    print(json.dumps({"metric": "bench_failed", "value": 0, "unit": "none",
-                      "vs_baseline": 0,
-                      "error": f"watchdog: no result in {TOTAL_BUDGET_S}s"}),
-          flush=True)
-    log("watchdog fired — hard exit")
-    os._exit(1)
+# --------------------------------------------------------------------------
+# parent orchestrator (no jax)
+# --------------------------------------------------------------------------
+
+_printed = False
 
 
-def main():
-    import threading
+def emit(obj: dict, rc: int = 0) -> None:
+    global _printed
+    if _printed:
+        return
+    _printed = True
+    print(json.dumps(obj), flush=True)
+    sys.exit(rc)
 
-    threading.Thread(target=_watchdog, daemon=True).start()
-    signal.signal(signal.SIGALRM, _on_alarm)
+
+def run_child(mode: str, preset: str, budget: float, extra_env=None):
+    """Run one candidate in a killable subprocess; returns dict or None."""
+    env = _child_env()
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, os.path.abspath(__file__), f"--{mode}", preset]
+    log(f"spawn {mode}:{preset} budget={budget:.0f}s "
+        f"pallas={env.get('BIGDL_TPU_PALLAS', 'auto')}")
+    try:
+        proc = subprocess.run(
+            cmd, env=env, stdout=subprocess.PIPE, timeout=budget,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        log(f"{mode}:{preset} KILLED at {budget:.0f}s wall-clock")
+        return None
+    if proc.returncode != 0:
+        log(f"{mode}:{preset} failed rc={proc.returncode}")
+        return "error"  # distinguishes fast failure (retryable) from hang
+    try:
+        line = proc.stdout.decode().strip().splitlines()[-1]
+        return json.loads(line)
+    except Exception as e:
+        log(f"{mode}:{preset} unparseable stdout: {e!r}")
+        return "error"
+
+
+def main() -> None:
+    banked: list[tuple[str, dict]] = []
+
+    def on_deadline(*_):
+        # even a wedged parent must emit banked work, not erase it
+        if banked:
+            emit(banked[-1][1], 0)
+        emit({"metric": "bench_failed", "value": 0, "unit": "none",
+              "vs_baseline": 0, "error": "parent deadline"}, 1)
+
+    signal.signal(signal.SIGALRM, on_deadline)
+    signal.alarm(int(TOTAL_BUDGET_S + 10))
+
+    # smallest-first; min_s = give up if less wall-clock than this remains
     candidates = [
-        ("llama3_8b", PRESETS["llama3-8b"], 420),
-        ("llama2_7b", PRESETS["llama2-7b"], 240),
-        ("tiny_llama", PRESETS["tiny-llama"], 120),  # last-resort CI fallback
+        ("tiny_llama", "tiny-llama", 150, 60),
+        ("llama2_7b", "llama2-7b", 330, 150),
+        ("llama3_8b", "llama3-8b", 330, 180),
     ]
-    last_err = None
-    for name, config, budget in candidates:
-        try:
-            signal.alarm(budget)
-            result = bench(config, name)
-            signal.alarm(0)
-            print(json.dumps(result))
-            return
-        except Exception as e:  # OOM / timeout: fall back a size
-            signal.alarm(0)
-            log(f"{name} failed: {e!r:.300}")
-            last_err = f"{name}: {e!r}"  # string only — the exception object
-            # would pin the failed candidate's device buffers via __traceback__
+    for name, preset, budget, min_s in candidates:
+        if remaining() < min_s:
+            log(f"skip {name}: only {remaining():.0f}s left")
             continue
-    print(json.dumps({"metric": "bench_failed", "value": 0, "unit": "none",
-                      "vs_baseline": 0, "error": str(last_err)[:200]}))
-    sys.exit(1)
+        res = run_child("decode", preset, min(budget, remaining() - 20))
+        if res == "error" and remaining() > min_s:
+            res = run_child("decode", preset, min(budget, remaining() - 20),
+                            extra_env={"BIGDL_TPU_PALLAS": "0"})
+        if isinstance(res, dict):
+            banked.append((preset, res))
+            log(f"banked {res['metric']} = {res['value']} {res['unit']}")
+
+    train_res = None
+    if banked and remaining() > 200:
+        # train MFU on the biggest preset that already decoded fine
+        preset = banked[-1][0]
+        res = run_child("train", preset, remaining() - 30)
+        if isinstance(res, dict):
+            train_res = res
+            log(f"banked train MFU {res.get('train_mfu')}")
+
+    if not banked:
+        emit({"metric": "bench_failed", "value": 0, "unit": "none",
+              "vs_baseline": 0,
+              "error": "all candidates failed or timed out"}, 1)
+    best = banked[-1][1]  # largest successful model
+    if train_res:
+        train_res.pop("metric", None)
+        best.update(train_res)
+    emit(best, 0)
 
 
 if __name__ == "__main__":
-    main()
+    if "--decode" in sys.argv:
+        print(json.dumps(child_decode(sys.argv[sys.argv.index("--decode") + 1])),
+              flush=True)
+    elif "--train" in sys.argv:
+        print(json.dumps(child_train(sys.argv[sys.argv.index("--train") + 1])),
+              flush=True)
+    else:
+        main()

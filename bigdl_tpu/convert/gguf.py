@@ -309,12 +309,25 @@ _DEQUANT: dict[int, Callable[[np.ndarray], np.ndarray]] = {
 # direct repack ggml block -> QTensor fields (no dequant round trip)
 # ---------------------------------------------------------------------------
 
-def _nibbles_to_ours(qs: np.ndarray) -> np.ndarray:
-    """ggml nibble order (element j & j+16 in byte j) → ours (2i, 2i+1)."""
-    lo = qs & 0xF  # elements 0..15
+def _block_codes(qs: np.ndarray) -> np.ndarray:
+    """ggml per-block nibbles (element j & j+16 in byte j of each 32-block)
+    → element-order codes over the whole row: [..., nb, 16] → [..., nb*32]."""
+    lo = qs & 0xF  # elements 0..15 of each block
     hi = qs >> 4  # elements 16..31
-    codes = np.concatenate([lo, hi], axis=-1)  # [..., 32] in element order
-    return (codes[..., 0::2] | (codes[..., 1::2] << 4)).astype(np.uint8)
+    codes = np.concatenate([lo, hi], axis=-1)  # [..., nb, 32] element order
+    return codes.reshape(*codes.shape[:-2], -1)
+
+
+def _pack_half_split(codes: np.ndarray) -> np.ndarray:
+    """Row-wise half-split pack — must mirror quant/numerics.pack_nibbles:
+    byte j = element j (lo) | element j + K/2 (hi)."""
+    k = codes.shape[-1]
+    return (codes[..., : k // 2] | (codes[..., k // 2:] << 4)).astype(np.uint8)
+
+
+def _nibbles_to_ours(qs: np.ndarray) -> np.ndarray:
+    """ggml nibble order → our half-split row layout (zero dequant)."""
+    return _pack_half_split(_block_codes(qs))
 
 
 def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
@@ -322,13 +335,13 @@ def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
     types; data layouts match bigdl_tpu.quant.numerics exactly."""
     if ggml_type == GGML_Q4_0:
         d = _f16(blocks, 0).astype(np.float16)
-        data = _nibbles_to_ours(blocks[..., 2:18])
-        return data.reshape(*data.shape[:-2], -1), d, None, "sym_int4"
+        data = _nibbles_to_ours(blocks[..., 2:18])  # [..., K//2] row layout
+        return data, d, None, "sym_int4"
     if ggml_type == GGML_Q4_1:
         d = _f16(blocks, 0).astype(np.float16)
         m = _f16(blocks, 2).astype(np.float16)
         data = _nibbles_to_ours(blocks[..., 4:20])
-        return data.reshape(*data.shape[:-2], -1), d, m, "asym_int4"
+        return data, d, m, "asym_int4"
     if ggml_type == GGML_Q8_0:
         d = _f16(blocks, 0).astype(np.float16)
         data = blocks[..., 2:34].copy().view(np.int8)

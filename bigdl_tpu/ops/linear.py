@@ -37,7 +37,8 @@ def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
     if w.qtype != "sym_int4" or w.data.ndim != 2:
         return False
     out, kh = w.data.shape
-    if out % 128 != 0 or (kh * 2) % 32 != 0:
+    # K % 64: each half-split nibble plane must cover whole quant blocks
+    if out % 128 != 0 or (kh * 2) % 64 != 0:
         return False
     return _rows(x.shape) <= _GEMV_MAX_ROWS and use_pallas()
 

@@ -10,15 +10,20 @@ matmul) is that W crosses HBM as packed nibbles — 0.5 byte/weight + one
 f16 scale per 32 — i.e. ~4x less weight traffic than bf16, which is the
 entire cost of a GEMV.
 
-Nibble layout trick: QTensor packs elements (2i, 2i+1) into one byte
-(low, high nibble). Instead of re-interleaving inside the kernel (an
-awkward layout change on TPU), the caller splits x into its even and odd
-K columns once (x is tiny), and the kernel computes
-    y = x_even @ dq(lo).T + x_odd @ dq(hi).T
-so unpacked nibbles are used in the layout they already have.
+Layout contract (quant/numerics.py pack_nibbles): byte j of a row packs
+element j in its low nibble and element j + K/2 in its high nibble. The
+kernel therefore needs x's first and second halves — two *contiguous*
+blocks of the same array, delivered by two BlockSpecs over x with no
+data movement. (The previous interleaved layout needed a strided
+even/odd deinterleave of x per call: ~40us of XLA prologue x 224 calls
+per decode step — measured on v5e, round 3 — which dominated the kernel
+itself.)
 
-Scales: one f16 per 32 contiguous weights -> per 16 packed bytes. The
-kernel expands them with a broadcast+reshape (VMEM-local, no HBM cost).
+Mosaic constraints found on real TPU (the CPU interpreter accepts all of
+these, silently): no f16 vector type -> scales cross as uint16 bits and
+are decoded to f32 with integer ops in-kernel; no lane-collapsing
+reshape -> per-block scales expand to per-element via a one-hot matmul
+(iota compare + MXU dot), not broadcast+reshape.
 """
 
 from __future__ import annotations
@@ -33,50 +38,91 @@ from jax.experimental.pallas import tpu as pltpu
 from bigdl_tpu.utils import round_up
 
 BLOCK = 32  # quant block (elements per scale), fixed for sym_int4
-_PACKED_PER_SCALE = BLOCK // 2
 
 
-def _kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref, *, block_o: int, kh: int):
-    """One O-tile: o_ref[M, block_o] = xe @ lo^T + xo @ hi^T, dequantized."""
-    # Mosaic can't cast uint8 directly to float; widen to int32 first.
-    w = w_ref[:].astype(jnp.int32)  # [block_o, kh]
+def _f16_bits_to_f32(bits):
+    """uint16 float16 bit pattern -> f32, integer ops only (Mosaic has no
+    f16 vectors). Subnormal f16 scales flush to zero — a scale below
+    6.1e-5 only occurs for an all-zero weight block."""
+    b = bits.astype(jnp.int32)
+    sign = (b >> 15) & 1
+    exp = (b >> 10) & 0x1F
+    mant = b & 0x3FF
+    f32_bits = (sign << 31) | ((exp + 127 - 15) << 23) | (mant << 13)
+    val = jax.lax.bitcast_convert_type(f32_bits, jnp.float32)
+    return jnp.where(exp == 0, 0.0, val)
+
+
+def _expand_scales(s, kh: int, base_block: int):
+    """[block_o, nb] per-block scales -> [block_o, kh] per-element, where
+    element j of this nibble plane belongs to quant block
+    (j + base_block * kh) // 32. One-hot matmul: iota/compare/dot only."""
+    nb = s.shape[-1]
+    sel = (
+        jax.lax.broadcasted_iota(jnp.int32, (nb, kh), 1) // BLOCK
+        + base_block * (kh // BLOCK)
+        == jax.lax.broadcasted_iota(jnp.int32, (nb, kh), 0)
+    ).astype(jnp.float32)
+    return jax.lax.dot_general(
+        s, sel, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _kernel(xl_ref, xh_ref, w_ref, s_ref, o_ref, *, kh: int):
+    """One O-tile: o = x_lo @ dq(lo)^T + x_hi @ dq(hi)^T."""
+    w = w_ref[:].astype(jnp.int32)  # [block_o, kh] packed bytes
     lo = ((w & 0xF) - 8).astype(jnp.float32)
     hi = ((w >> 4) - 8).astype(jnp.float32)
 
-    s = s_ref[:].astype(jnp.float32)  # [block_o, kh // 16]
-    s = jnp.broadcast_to(
-        s[:, :, None], (block_o, kh // _PACKED_PER_SCALE, _PACKED_PER_SCALE)
-    ).reshape(block_o, kh)
+    s = _f16_bits_to_f32(s_ref[:])  # [block_o, nb]
+    wl = (lo * _expand_scales(s, kh, 0)).astype(jnp.bfloat16)
+    wh = (hi * _expand_scales(s, kh, 1)).astype(jnp.bfloat16)
 
-    wl = (lo * s).astype(jnp.bfloat16)
-    wh = (hi * s).astype(jnp.bfloat16)
-    xe = xe_ref[:].astype(jnp.bfloat16)  # [M, kh]
-    xo = xo_ref[:].astype(jnp.bfloat16)
+    xl = xl_ref[:].astype(jnp.bfloat16)  # [M, kh] first half of x
+    xh = xh_ref[:].astype(jnp.bfloat16)  # [M, kh] second half
     acc = jax.lax.dot_general(
-        xe, wl, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        xl, wl, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     acc += jax.lax.dot_general(
-        xo, wh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        xh, wh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     o_ref[:] = acc.astype(o_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("out_dtype", "block_o", "interpret")
+    jax.jit, static_argnames=("out_dtype", "block_o", "interpret", "two_view")
 )
-def _qmm(xe, xo, w, s, out_dtype, block_o: int, interpret: bool):
-    M, kh = xe.shape
+def _qmm(x2, w, s_bits, out_dtype, block_o: int, interpret: bool,
+         two_view: bool):
+    """two_view=True: x2 is [M, K] and the kernel's two x operands are
+    delivered as half-lane views of the same array by BlockSpec index
+    maps — zero data movement. Requires kh % 128 == 0 (Mosaic lane
+    rule); small-K callers pre-slice instead (still contiguous)."""
+    if two_view:
+        M, K = x2.shape
+        kh = K // 2
+        x_args = (x2, x2)
+        x_specs = [
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, kh), lambda o: (0, 1), memory_space=pltpu.VMEM),
+        ]
+    else:
+        xl, xh = x2
+        M, kh = xl.shape
+        x_args = (xl, xh)
+        x_specs = [
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+        ]
     O = w.shape[0]
     grid = (O // block_o,)
     return pl.pallas_call(
-        functools.partial(_kernel, block_o=block_o, kh=kh),
+        functools.partial(_kernel, kh=kh),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+        in_specs=x_specs + [
             pl.BlockSpec((block_o, kh), lambda o: (o, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(
-                (block_o, kh // _PACKED_PER_SCALE), lambda o: (o, 0),
+                (block_o, kh // (BLOCK // 2)), lambda o: (o, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
@@ -88,13 +134,13 @@ def _qmm(xe, xo, w, s, out_dtype, block_o: int, interpret: bool):
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,
-    )(xe, xo, w, s)
+    )(*x_args, w, s_bits)
 
 
 def qmatmul_int4(
     x: jax.Array,  # [..., K]
-    data: jax.Array,  # [O, K // 2] packed uint8 (sym_int4)
-    scales: jax.Array,  # [O, K // 32] f16
+    data: jax.Array,  # [O, K // 2] packed uint8 (sym_int4, half-split)
+    scales: jax.Array,  # [O, K // 32] f16 (or bf16)
     out_dtype=jnp.bfloat16,
     block_o: int = 256,
     interpret: bool | None = None,
@@ -106,20 +152,29 @@ def qmatmul_int4(
         interpret = interpret_mode()
     *lead, K = x.shape
     O, kh = data.shape
-    assert kh * 2 == K and K % BLOCK == 0
+    # K % 64: with half-split packing each nibble plane must cover whole
+    # quant blocks, or _expand_scales' j//32 block math is wrong
+    assert kh * 2 == K and K % (2 * BLOCK) == 0
 
     M = 1
     for d in lead:
         M *= d
-    x2 = x.reshape(M, K)
-    xe, xo = x2[:, 0::2], x2[:, 1::2]  # [M, K//2] each; tiny, XLA-side
-
     Mp = round_up(max(M, 1), 8)
-    xe = jnp.pad(xe, ((0, Mp - M), (0, 0)))
-    xo = jnp.pad(xo, ((0, Mp - M), (0, 0)))
+    x2 = x.reshape(M, K)
+    if Mp != M:
+        x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
 
     block_o = min(block_o, O)
     assert O % block_o == 0, f"O={O} not divisible by block_o={block_o}"
 
-    y = _qmm(xe, xo, data, scales, jnp.dtype(out_dtype), block_o, interpret)
+    if scales.dtype == jnp.float16:
+        s_bits = jax.lax.bitcast_convert_type(scales, jnp.uint16)
+    else:  # bf16/f32 scales: round-trip through f16 bits (test paths)
+        s_bits = jax.lax.bitcast_convert_type(
+            scales.astype(jnp.float16), jnp.uint16
+        )
+    two_view = kh % 128 == 0
+    xa = x2 if two_view else (x2[:, :kh], x2[:, kh:])
+    y = _qmm(xa, data, s_bits, jnp.dtype(out_dtype), block_o, interpret,
+             two_view)
     return y[:M].reshape(*lead, O)

@@ -6,7 +6,8 @@
 // llama/llama_cpp.py:955-1065, driven from low_bit_linear.py:104-258):
 // the checkpoint-ingest hot loop. Re-designed for our QTensor layout
 // (bigdl_tpu/quant/numerics.py): 4-bit codes packed two-per-byte along
-// the contraction axis (element 2i low nibble), float16 block scales.
+// the contraction axis in half-split order — byte j carries element j
+// (low nibble) and element j + k/2 (high nibble) — float16 block scales.
 //
 // Numerics are bit-identical to the jnp reference implementation
 // (round-half-to-even code rounding, round-to-nearest-even f16 scales,
@@ -17,6 +18,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 extern "C" {
 
@@ -74,14 +76,21 @@ static inline float f16_to_f32(uint16_t h) {
 static inline float rte(float x) { return std::nearbyintf(x); }
 
 // ---- sym_int4: block 32, d = signed-absmax / -8, codes in [0,15] ----
+static inline uint8_t sym4_code(float v, float inv) {
+  float q = rte(v * inv) + 8.0f;
+  q = q < 0 ? 0 : (q > 15 ? 15 : q);
+  return (uint8_t)q;
+}
+
 void quantize_sym_int4(const float* x, int64_t rows, int64_t k,
                        uint8_t* data, uint16_t* scales) {
-  const int64_t nb = k / 32;
+  const int64_t nb = k / 32, kh = k / 2;
 #pragma omp parallel for schedule(static)
   for (int64_t r = 0; r < rows; ++r) {
     const float* xr = x + r * k;
-    uint8_t* dr = data + r * (k / 2);
+    uint8_t* dr = data + r * kh;
     uint16_t* sr = scales + r * nb;
+    std::vector<float> inv(nb);
     for (int64_t b = 0; b < nb; ++b) {
       const float* xb = xr + b * 32;
       float smax = xb[0], amax = std::fabs(xb[0]);
@@ -89,18 +98,15 @@ void quantize_sym_int4(const float* x, int64_t rows, int64_t k,
         const float a = std::fabs(xb[j]);
         if (a > amax) { amax = a; smax = xb[j]; }
       }
-      float d = smax / -8.0f;
-      const uint16_t dh = f32_to_f16(d);
-      sr[b] = dh;
-      const float inv = d != 0.0f ? 1.0f / d : 0.0f;
-      uint8_t* db = dr + b * 16;
-      for (int j = 0; j < 16; ++j) {
-        float q0 = rte(xb[2 * j] * inv) + 8.0f;
-        float q1 = rte(xb[2 * j + 1] * inv) + 8.0f;
-        q0 = q0 < 0 ? 0 : (q0 > 15 ? 15 : q0);
-        q1 = q1 < 0 ? 0 : (q1 > 15 ? 15 : q1);
-        db[j] = (uint8_t)q0 | ((uint8_t)q1 << 4);
-      }
+      const float d = smax / -8.0f;
+      sr[b] = f32_to_f16(d);
+      inv[b] = d != 0.0f ? 1.0f / d : 0.0f;
+    }
+    // half-split pack: byte j = element j | element j + k/2 << 4
+    for (int64_t j = 0; j < kh; ++j) {
+      const uint8_t lo = sym4_code(xr[j], inv[j / 32]);
+      const uint8_t hi = sym4_code(xr[j + kh], inv[(j + kh) / 32]);
+      dr[j] = lo | (hi << 4);
     }
   }
 }
@@ -113,6 +119,8 @@ void quantize_asym_int4(const float* x, int64_t rows, int64_t k,
   for (int64_t r = 0; r < rows; ++r) {
     const float* xr = x + r * k;
     uint8_t* dr = data + r * (k / 2);
+    const int64_t kh = k / 2;
+    std::vector<float> inv(nb), mnv(nb);
     for (int64_t b = 0; b < nb; ++b) {
       const float* xb = xr + b * 32;
       float mn = xb[0], mx = xb[0];
@@ -123,15 +131,16 @@ void quantize_asym_int4(const float* x, int64_t rows, int64_t k,
       const float d = (mx - mn) / 15.0f;
       scales[r * nb + b] = f32_to_f16(d);
       mins[r * nb + b] = f32_to_f16(mn);
-      const float inv = d != 0.0f ? 1.0f / d : 0.0f;
-      uint8_t* db = dr + b * 16;
-      for (int j = 0; j < 16; ++j) {
-        float q0 = rte((xb[2 * j] - mn) * inv);
-        float q1 = rte((xb[2 * j + 1] - mn) * inv);
-        q0 = q0 < 0 ? 0 : (q0 > 15 ? 15 : q0);
-        q1 = q1 < 0 ? 0 : (q1 > 15 ? 15 : q1);
-        db[j] = (uint8_t)q0 | ((uint8_t)q1 << 4);
-      }
+      inv[b] = d != 0.0f ? 1.0f / d : 0.0f;
+      mnv[b] = mn;
+    }
+    for (int64_t j = 0; j < kh; ++j) {
+      const int64_t bl = j / 32, bh = (j + kh) / 32;
+      float q0 = rte((xr[j] - mnv[bl]) * inv[bl]);
+      float q1 = rte((xr[j + kh] - mnv[bh]) * inv[bh]);
+      q0 = q0 < 0 ? 0 : (q0 > 15 ? 15 : q0);
+      q1 = q1 < 0 ? 0 : (q1 > 15 ? 15 : q1);
+      dr[j] = (uint8_t)q0 | ((uint8_t)q1 << 4);
     }
   }
 }
@@ -175,6 +184,8 @@ void quantize_codebook4(const float* x, int64_t rows, int64_t k, int64_t bs,
   for (int64_t r = 0; r < rows; ++r) {
     const float* xr = x + r * k;
     uint8_t* dr = data + r * (k / 2);
+    const int64_t kh = k / 2;
+    std::vector<float> inv(nb);
     for (int64_t b = 0; b < nb; ++b) {
       const float* xb = xr + b * bs;
       float amax = 0.0f;
@@ -184,21 +195,22 @@ void quantize_codebook4(const float* x, int64_t rows, int64_t k, int64_t bs,
       }
       const float scale = amax / cb_absmax;
       scales[r * nb + b] = f32_to_f16(scale);
-      const float inv = scale != 0.0f ? 1.0f / scale : 0.0f;
-      for (int64_t j = 0; j < bs; j += 2) {
-        uint8_t codes[2];
-        for (int t = 0; t < 2; ++t) {
-          const float xn = xb[j + t] * inv;
-          // lower_bound over 15 boundaries == jnp.searchsorted side='left'
-          int lo = 0, hi = 15;
-          while (lo < hi) {
-            const int mid = (lo + hi) / 2;
-            if (boundaries[mid] < xn) lo = mid + 1; else hi = mid;
-          }
-          codes[t] = (uint8_t)order[lo];
+      inv[b] = scale != 0.0f ? 1.0f / scale : 0.0f;
+    }
+    for (int64_t j = 0; j < kh; ++j) {
+      uint8_t codes[2];
+      const int64_t el[2] = {j, j + kh};
+      for (int t = 0; t < 2; ++t) {
+        const float xn = xr[el[t]] * inv[el[t] / bs];
+        // lower_bound over 15 boundaries == jnp.searchsorted side='left'
+        int lo = 0, hi = 15;
+        while (lo < hi) {
+          const int mid = (lo + hi) / 2;
+          if (boundaries[mid] < xn) lo = mid + 1; else hi = mid;
         }
-        dr[(b * bs + j) / 2] = codes[0] | (codes[1] << 4);
+        codes[t] = (uint8_t)order[lo];
       }
+      dr[j] = codes[0] | (codes[1] << 4);
     }
   }
 }
@@ -211,13 +223,13 @@ void dequantize_sym_int4(const uint8_t* data, const uint16_t* scales,
   for (int64_t r = 0; r < rows; ++r) {
     const uint8_t* dr = data + r * (k / 2);
     float* yr = out + r * k;
-    for (int64_t b = 0; b < nb; ++b) {
-      const float d = f16_to_f32(scales[r * nb + b]);
-      for (int j = 0; j < 16; ++j) {
-        const uint8_t byte = dr[b * 16 + j];
-        yr[b * 32 + 2 * j] = ((int)(byte & 0xF) - 8) * d;
-        yr[b * 32 + 2 * j + 1] = ((int)(byte >> 4) - 8) * d;
-      }
+    const int64_t kh = k / 2;
+    std::vector<float> d(nb);
+    for (int64_t b = 0; b < nb; ++b) d[b] = f16_to_f32(scales[r * nb + b]);
+    for (int64_t j = 0; j < kh; ++j) {
+      const uint8_t byte = dr[j];
+      yr[j] = ((int)(byte & 0xF) - 8) * d[j / 32];
+      yr[j + kh] = ((int)(byte >> 4) - 8) * d[(j + kh) / 32];
     }
   }
 }
