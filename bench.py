@@ -58,6 +58,31 @@ def _child_env() -> dict:
     return env
 
 
+def _child_setup():
+    """Shared child preamble: compile cache + params-on-device helper.
+    Returns (jax, device). One definition so decode and train children
+    can never drift apart in jax config."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return jax, jax.devices()[0]
+
+
+def _params_on_device(jax, device, config, tag: str):
+    host = _host_params(config)
+    total_mb = sum(a.nbytes for a in jax.tree.leaves(host)) / 1e6
+    log(f"{tag}: {total_mb:.0f} MB host-ready, transferring")
+    t0 = time.time()
+    params = jax.tree.map(lambda a: jax.device_put(a, device), host)
+    jax.block_until_ready(params)
+    dt = time.time() - t0
+    log(f"{tag}: transferred in {dt:.1f}s ({total_mb / max(dt, 1e-9):.0f} MB/s)")
+    return params
+
+
 def _host_params(config, qtype: str = "sym_int4"):
     """Host-numpy quantized param tree — no device ops, no compiles.
 
@@ -94,13 +119,7 @@ def _host_params(config, qtype: str = "sym_int4"):
 
 
 def child_decode(preset: str) -> dict:
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-    import jax
-
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ["JAX_COMPILATION_CACHE_DIR"])
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-
+    jax, device = _child_setup()
     import jax.numpy as jnp
     import numpy as np
 
@@ -110,20 +129,9 @@ def child_decode(preset: str) -> dict:
     from bigdl_tpu.utils import flops as F
 
     config = PRESETS[preset]
-    device = jax.devices()[0]
     cache_len, B = 128, 1
 
-    log(f"{preset}: materializing host params")
-    host = _host_params(config)
-    sizes = jax.tree.map(lambda a: a.nbytes, host)
-    total_mb = sum(jax.tree.leaves(sizes)) / 1e6
-    log(f"{preset}: {total_mb:.0f} MB host-ready, transferring")
-    t0 = time.time()
-    params = jax.tree.map(lambda a: jax.device_put(a, device), host)
-    jax.block_until_ready(params)
-    del host
-    dt = time.time() - t0
-    log(f"{preset}: transferred in {dt:.1f}s ({total_mb / max(dt, 1e-9):.0f} MB/s)")
+    params = _params_on_device(jax, device, config, preset)
 
     cache0 = jax.block_until_ready(
         jax.jit(lambda: kvcache.init_cache(
@@ -218,13 +226,7 @@ def child_decode(preset: str) -> dict:
 # --------------------------------------------------------------------------
 
 def child_train(preset: str) -> dict:
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-    import jax
-
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ["JAX_COMPILATION_CACHE_DIR"])
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-
+    jax, device = _child_setup()
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -235,15 +237,9 @@ def child_train(preset: str) -> dict:
     from bigdl_tpu.utils import flops as F
 
     config = PRESETS[preset]
-    device = jax.devices()[0]
     B, T = 1, 1024
 
-    log(f"train {preset}: materializing host params")
-    host = _host_params(config)
-    params = jax.tree.map(lambda a: jax.device_put(a, device), host)
-    jax.block_until_ready(params)
-    del host
-    log(f"train {preset}: params on device")
+    params = _params_on_device(jax, device, config, f"train {preset}")
 
     lora = init_lora(config, jax.random.PRNGKey(1), rank=8)
     optimizer = optax.adamw(1e-4)

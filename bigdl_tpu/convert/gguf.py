@@ -57,7 +57,8 @@ _BLOCK = {
     GGML_Q4_0: (32, 18), GGML_Q4_1: (32, 20),
     GGML_Q5_0: (32, 22), GGML_Q5_1: (32, 24),
     GGML_Q8_0: (32, 34),
-    GGML_Q4_K: (256, 144), GGML_Q6_K: (256, 210),
+    GGML_Q2_K: (256, 84), GGML_Q3_K: (256, 110),
+    GGML_Q4_K: (256, 144), GGML_Q5_K: (256, 176), GGML_Q6_K: (256, 210),
 }
 
 # metadata value types
@@ -298,10 +299,32 @@ def _deq_q6_k(blocks: np.ndarray) -> np.ndarray:
     return out * sub * d[..., None]
 
 
+def _deq_kquant_np(name: str) -> Callable[[np.ndarray], np.ndarray]:
+    """k-quant numpy dequant via the jnp codec (host verification path —
+    the hot path repacks blocks verbatim and dequantizes in-graph).
+    jax imports stay inside deq() so parsing GGUF metadata never pulls in
+    the accelerator runtime."""
+
+    def deq(blocks: np.ndarray) -> np.ndarray:
+        import jax
+
+        from bigdl_tpu.quant import kquants
+
+        fn = {"q2_k": kquants.dequant_q2_k, "q3_k": kquants.dequant_q3_k,
+              "q5_k": kquants.dequant_q5_k}[name]
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            flat = np.asarray(fn(blocks[None]))[0]
+        return flat.reshape(*blocks.shape[:-1], 256)
+
+    return deq
+
+
 _DEQUANT: dict[int, Callable[[np.ndarray], np.ndarray]] = {
     GGML_Q4_0: _deq_q4_0, GGML_Q4_1: _deq_q4_1,
     GGML_Q5_0: _deq_q5_0, GGML_Q5_1: _deq_q5_1,
     GGML_Q8_0: _deq_q8_0, GGML_Q4_K: _deq_q4_k, GGML_Q6_K: _deq_q6_k,
+    GGML_Q2_K: _deq_kquant_np("q2_k"), GGML_Q3_K: _deq_kquant_np("q3_k"),
+    GGML_Q5_K: _deq_kquant_np("q5_k"),
 }
 
 
@@ -365,19 +388,23 @@ def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
             axis=-1,
         ).astype(np.int8)
         return codes.reshape(*codes.shape[:-2], -1), d, m, "asym_int5"
-    if ggml_type in (GGML_Q4_K, GGML_Q6_K):
-        # our q4_k/q6_k QTensor storage IS the ggml super-block byte
-        # layout — carry the blocks verbatim (quant/kquants.py decodes
-        # them in-graph)
-        off = 0 if ggml_type == GGML_Q4_K else 208
-        d = _f16(blocks, off).astype(np.float16)
-        name = "q4_k" if ggml_type == GGML_Q4_K else "q6_k"
+    _KQ = {GGML_Q2_K: "q2_k", GGML_Q3_K: "q3_k", GGML_Q4_K: "q4_k",
+           GGML_Q5_K: "q5_k", GGML_Q6_K: "q6_k"}
+    if ggml_type in _KQ:
+        # our k-quant QTensor storage IS the ggml super-block byte layout
+        # — carry the blocks verbatim (quant/kquants.py decodes in-graph;
+        # d offsets live in KQUANT_LAYOUT, the single layout table)
+        from bigdl_tpu.quant.kquants import KQUANT_LAYOUT
+
+        name = _KQ[ggml_type]
+        d = _f16(blocks, KQUANT_LAYOUT[name][1]).astype(np.float16)
         return blocks, d, None, name
     raise KeyError(ggml_type)
 
 
 _REPACKABLE = {
-    GGML_Q4_0, GGML_Q4_1, GGML_Q8_0, GGML_Q5_0, GGML_Q5_1, GGML_Q4_K, GGML_Q6_K,
+    GGML_Q4_0, GGML_Q4_1, GGML_Q8_0, GGML_Q5_0, GGML_Q5_1,
+    GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K,
 }
 
 

@@ -26,7 +26,11 @@ import numpy as np
 from bigdl_tpu.models.config import ModelConfig
 from bigdl_tpu.quant import QTensor
 
-FORMAT_VERSION = 1
+# v2: sym_int4/asym_int4/codebook4 nibble packing changed from
+# interleaved (2i, 2i+1 per byte) to half-split (j, j+K/2 per byte) —
+# see quant/numerics.pack_nibbles. v1 checkpoints would silently
+# dequantize scrambled, so the version gate must reject them.
+FORMAT_VERSION = 2
 
 _VIEW_DTYPES = {
     "bfloat16": np.uint16,

@@ -1,13 +1,16 @@
-"""K-quant (super-block) codecs: q4_K and q6_K.
+"""K-quant (super-block) codecs: q2_K, q3_K, q4_K, q5_K and q6_K.
 
 The reference reaches these formats through its native quantizers
 (`ggml_quantize_tensor` with q4_k/q6_k qtypes, ggml/quantize.py:28-57 +
 gguf_mixed_qtype :60-61 in /root/reference). Here:
 
-- storage is the llama.cpp super-block byte layout (256 elements; q4_K:
-  fp16 d/dmin + 12B packed 6-bit sub-scales/mins + 128B nibbles = 144B;
-  q6_K: 128B low nibbles + 64B high bits + 16 int8 sub-scales + fp16 d =
-  210B) so GGUF k-quant tensors repack into QTensor **without**
+- storage is the llama.cpp super-block byte layout (256 elements; q2_K:
+  16B 4-bit sub-scale/min pairs + 64B 2-bit quants + fp16 d/dmin = 84B;
+  q3_K: 32B high-bit mask + 64B 2-bit quants + 12B 6-bit scales + fp16 d
+  = 110B; q4_K: fp16 d/dmin + 12B packed 6-bit sub-scales/mins + 128B
+  nibbles = 144B; q5_K: q4_K's header + 32B high bits + 128B nibbles =
+  176B; q6_K: 128B low nibbles + 64B high bits + 16 int8 sub-scales +
+  fp16 d = 210B) so GGUF k-quant tensors repack into QTensor **without**
   dequantization (convert/gguf.py);
 - `dequant_q4_k` / `dequant_q6_k` are jnp (jit-safe) — they run in-graph
   on TPU, fused by XLA into the consuming matmul like the other formats;
@@ -22,6 +25,18 @@ import jax.numpy as jnp
 import numpy as np
 
 QK_K = 256
+
+# Single source of truth for the super-block byte layouts: name ->
+# (block_bytes, byte offset of the fp16 super-scale d). Consumed by
+# quant/numerics.py (encode) and convert/gguf.py (verbatim repack) so the
+# magic offsets exist in exactly one place.
+KQUANT_LAYOUT = {
+    "q2_k": (84, 80),
+    "q3_k": (110, 108),
+    "q4_k": (144, 0),
+    "q5_k": (176, 0),
+    "q6_k": (210, 208),
+}
 
 
 # ---------------------------------------------------------------------------
@@ -104,6 +119,117 @@ def dequant_q4_k(blocks: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
     return vals.reshape(*blocks.shape[:-2], -1).astype(dtype)
 
 
+def dequant_q2_k(blocks: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """blocks [..., n_sb, 84] uint8 -> [..., n_sb*256].
+
+    Layout (llama.cpp block_q2_K): scales[16] (4-bit scale | 4-bit min
+    per 16-element sub-block), qs[64] (2-bit quants), fp16 d, fp16 dmin.
+    Element 128h + 32j + 16g + l comes from bits 2j of qs[32h + 16g + l],
+    sub-block index 8h + 2j + g."""
+    sc_raw = blocks[..., 0:16]
+    qs = blocks[..., 16:80]
+    d = _read_f16(blocks, 80)
+    dmin = _read_f16(blocks, 82)
+
+    dl = d[..., None] * (sc_raw & 0xF).astype(jnp.float32)  # [..., 16]
+    ml = dmin[..., None] * (sc_raw >> 4).astype(jnp.float32)
+
+    outs = []
+    for h in range(2):
+        qh_bytes = qs[..., 32 * h:32 * (h + 1)]
+        for j in range(4):
+            q2 = ((qh_bytes >> (2 * j)) & 3).astype(jnp.float32)  # [..., 32]
+            for g in range(2):
+                i_s = 8 * h + 2 * j + g
+                outs.append(
+                    dl[..., i_s:i_s + 1] * q2[..., 16 * g:16 * (g + 1)]
+                    - ml[..., i_s:i_s + 1]
+                )
+    vals = jnp.concatenate(outs, axis=-1)
+    return vals.reshape(*blocks.shape[:-2], -1).astype(dtype)
+
+
+def _unpack_q3k_scales(sc_raw: jnp.ndarray) -> jnp.ndarray:
+    """12 bytes -> 16 6-bit scales (still biased by +32). Scale i: low 4
+    bits from bytes[0..7] nibbles, high 2 bits from bytes[8..11]."""
+    sc = []
+    for i in range(16):
+        j, grp = i & 3, i >> 2
+        if grp == 0:
+            lo4 = sc_raw[..., j] & 0xF
+        elif grp == 1:
+            lo4 = sc_raw[..., 4 + j] & 0xF
+        elif grp == 2:
+            lo4 = sc_raw[..., j] >> 4
+        else:
+            lo4 = sc_raw[..., 4 + j] >> 4
+        hi2 = (sc_raw[..., 8 + j] >> (2 * grp)) & 3
+        sc.append((lo4 | (hi2 << 4)).astype(jnp.float32))
+    return jnp.stack(sc, axis=-1)  # [..., 16]
+
+
+def dequant_q3_k(blocks: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """blocks [..., n_sb, 110] uint8 -> [..., n_sb*256].
+
+    Layout (block_q3_K): hmask[32], qs[64] (2-bit), scales[12] (6-bit,
+    bias 32), fp16 d. Element 128h + 32j + 16g + l = (qs[32h+16g+l] >>
+    2j & 3) - (hmask[16g+l] bit (4h+j) ? 0 : 4), scaled by
+    d * (scale[8h+2j+g] - 32)."""
+    hmask = blocks[..., 0:32]
+    qs = blocks[..., 32:96]
+    sc = _unpack_q3k_scales(blocks[..., 96:108]) - 32.0  # [..., 16]
+    d = _read_f16(blocks, 108)
+
+    dl = d[..., None] * sc  # [..., 16]
+    outs = []
+    for h in range(2):
+        q_bytes = qs[..., 32 * h:32 * (h + 1)]
+        for j in range(4):
+            bit = 4 * h + j
+            q2 = ((q_bytes >> (2 * j)) & 3).astype(jnp.int32)
+            hb = ((hmask >> bit) & 1).astype(jnp.int32)  # [..., 32]
+            qv = (q2 - jnp.where(hb == 1, 0, 4)).astype(jnp.float32)
+            for g in range(2):
+                i_s = 8 * h + 2 * j + g
+                outs.append(dl[..., i_s:i_s + 1] * qv[..., 16 * g:16 * (g + 1)])
+    vals = jnp.concatenate(outs, axis=-1)
+    return vals.reshape(*blocks.shape[:-2], -1).astype(dtype)
+
+
+def dequant_q5_k(blocks: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """blocks [..., n_sb, 176] uint8 -> [..., n_sb*256].
+
+    Layout (block_q5_K): fp16 d/dmin, scales[12] (q4_K packing), qh[32]
+    (5th bits), qs[128] (nibbles). 64-element pair p: lo-nibble group
+    uses qh bit 2p, hi-nibble group bit 2p+1."""
+    d = _read_f16(blocks, 0)
+    dmin = _read_f16(blocks, 2)
+    sc, mn = _unpack_q4k_scales(blocks[..., 4:16])
+    qh = blocks[..., 16:48]
+    qs = blocks[..., 48:176]
+
+    outs = []
+    for pair in range(4):
+        grp = qs[..., 32 * pair:32 * (pair + 1)]
+        lo = (grp & 0xF).astype(jnp.float32) + (
+            ((qh >> (2 * pair)) & 1) << 4
+        ).astype(jnp.float32)
+        hi = (grp >> 4).astype(jnp.float32) + (
+            ((qh >> (2 * pair + 1)) & 1) << 4
+        ).astype(jnp.float32)
+        j0, j1 = 2 * pair, 2 * pair + 1
+        outs.append(
+            d[..., None] * sc[..., j0:j0 + 1] * lo
+            - dmin[..., None] * mn[..., j0:j0 + 1]
+        )
+        outs.append(
+            d[..., None] * sc[..., j1:j1 + 1] * hi
+            - dmin[..., None] * mn[..., j1:j1 + 1]
+        )
+    vals = jnp.concatenate(outs, axis=-1)
+    return vals.reshape(*blocks.shape[:-2], -1).astype(dtype)
+
+
 # ---------------------------------------------------------------------------
 # numpy encoders (host-side ingest; RTN two-level scales)
 # ---------------------------------------------------------------------------
@@ -145,6 +271,137 @@ def quantize_q6_k(x: np.ndarray) -> np.ndarray:
         d.astype(np.float16).view(np.uint8).reshape(n, 2)
     )
     return blocks.reshape(*lead, x.shape[-1] // QK_K, 210)
+
+
+def quantize_q2_k(x: np.ndarray) -> np.ndarray:
+    """x [..., K] (K % 256 == 0) -> blocks [..., K/256, 84] uint8."""
+    x = np.asarray(x, np.float32)
+    lead = x.shape[:-1]
+    xb = x.reshape(-1, 16, 16)  # 16 sub-blocks of 16
+    n = xb.shape[0]
+
+    mins = np.minimum(xb.min(axis=-1), 0.0)
+    maxs = xb.max(axis=-1)
+    scales = (maxs - mins) / 3.0
+    d = scales.max(axis=-1) / 15.0
+    dmin = (-mins).max(axis=-1) / 15.0
+    inv_d = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
+    inv_dm = np.where(dmin == 0, 0.0, 1.0 / np.where(dmin == 0, 1, dmin))
+    sc = np.clip(np.round(scales * inv_d[:, None]), 0, 15).astype(np.uint8)
+    mn = np.clip(np.round(-mins * inv_dm[:, None]), 0, 15).astype(np.uint8)
+
+    eff_s = d[:, None] * sc.astype(np.float32)
+    eff_m = dmin[:, None] * mn.astype(np.float32)
+    inv_eff = np.where(eff_s == 0, 0.0, 1.0 / np.where(eff_s == 0, 1, eff_s))
+    q = np.clip(
+        np.round((xb + eff_m[..., None]) * inv_eff[..., None]), 0, 3
+    ).astype(np.uint8).reshape(n, QK_K)
+
+    blocks = np.zeros((n, 84), np.uint8)
+    blocks[:, 0:16] = sc | (mn << 4)
+    for h in range(2):
+        acc = np.zeros((n, 32), np.uint8)
+        for j in range(4):
+            e0 = 128 * h + 32 * j
+            acc |= (q[:, e0:e0 + 32] << (2 * j)).astype(np.uint8)
+        blocks[:, 16 + 32 * h:16 + 32 * (h + 1)] = acc
+    blocks[:, 80:82] = d.astype(np.float16).view(np.uint8).reshape(n, 2)
+    blocks[:, 82:84] = dmin.astype(np.float16).view(np.uint8).reshape(n, 2)
+    return blocks.reshape(*lead, x.shape[-1] // QK_K, 84)
+
+
+def quantize_q3_k(x: np.ndarray) -> np.ndarray:
+    """x [..., K] (K % 256 == 0) -> blocks [..., K/256, 110] uint8."""
+    x = np.asarray(x, np.float32)
+    lead = x.shape[:-1]
+    xb = x.reshape(-1, 16, 16)
+    n = xb.shape[0]
+
+    idx = np.argmax(np.abs(xb), axis=-1)
+    smax = np.take_along_axis(xb, idx[..., None], axis=-1)[..., 0]
+    s = smax / -4.0
+    d = np.max(np.abs(s), axis=-1) / 31.0
+    inv_d = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
+    sc = np.clip(np.round(s * inv_d[:, None]), -32, 31).astype(np.int32)
+
+    eff = d[:, None] * sc.astype(np.float32)
+    inv_eff = np.where(eff == 0, 0.0, 1.0 / np.where(eff == 0, 1, eff))
+    q = np.clip(np.round(xb * inv_eff[..., None]), -4, 3).astype(np.int32)
+    qp = (q + 4).astype(np.uint8).reshape(n, QK_K)  # 0..7
+
+    blocks = np.zeros((n, 110), np.uint8)
+    hmask = np.zeros((n, 32), np.uint8)
+    for h in range(2):
+        acc = np.zeros((n, 32), np.uint8)
+        for j in range(4):
+            e0 = 128 * h + 32 * j
+            grp = qp[:, e0:e0 + 32]
+            acc |= ((grp & 3) << (2 * j)).astype(np.uint8)
+            hmask |= ((grp >> 2) << (4 * h + j)).astype(np.uint8)
+        blocks[:, 32 + 32 * h:32 + 32 * (h + 1)] = acc
+    blocks[:, 0:32] = hmask
+    # 6-bit scale pack (inverse of _unpack_q3k_scales), bias +32
+    st = (sc + 32).astype(np.uint8)  # [n, 16]
+    sp = np.zeros((n, 12), np.uint8)
+    for i in range(16):
+        j, grp = i & 3, i >> 2
+        lo4, hi2 = st[:, i] & 0xF, st[:, i] >> 4
+        if grp == 0:
+            sp[:, j] |= lo4
+        elif grp == 1:
+            sp[:, 4 + j] |= lo4
+        elif grp == 2:
+            sp[:, j] |= lo4 << 4
+        else:
+            sp[:, 4 + j] |= lo4 << 4
+        sp[:, 8 + j] |= hi2 << (2 * grp)
+    blocks[:, 96:108] = sp
+    blocks[:, 108:110] = d.astype(np.float16).view(np.uint8).reshape(n, 2)
+    return blocks.reshape(*lead, x.shape[-1] // QK_K, 110)
+
+
+def quantize_q5_k(x: np.ndarray) -> np.ndarray:
+    """x [..., K] (K % 256 == 0) -> blocks [..., K/256, 176] uint8."""
+    x = np.asarray(x, np.float32)
+    lead = x.shape[:-1]
+    xb = x.reshape(-1, 8, 32)
+    n = xb.shape[0]
+
+    mins = np.minimum(xb.min(axis=-1), 0.0)
+    maxs = xb.max(axis=-1)
+    scales = (maxs - mins) / 31.0
+    d = scales.max(axis=-1) / 63.0
+    dmin = (-mins).max(axis=-1) / 63.0
+    inv_d = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
+    inv_dm = np.where(dmin == 0, 0.0, 1.0 / np.where(dmin == 0, 1, dmin))
+    sc = np.clip(np.round(scales * inv_d[:, None]), 0, 63).astype(np.uint8)
+    mn = np.clip(np.round(-mins * inv_dm[:, None]), 0, 63).astype(np.uint8)
+
+    eff_s = d[:, None] * sc.astype(np.float32)
+    eff_m = dmin[:, None] * mn.astype(np.float32)
+    inv_eff = np.where(eff_s == 0, 0.0, 1.0 / np.where(eff_s == 0, 1, eff_s))
+    q = np.clip(
+        np.round((xb + eff_m[..., None]) * inv_eff[..., None]), 0, 31
+    ).astype(np.uint8)  # [n, 8, 32]
+
+    blocks = np.zeros((n, 176), np.uint8)
+    blocks[:, 0:2] = d.astype(np.float16).view(np.uint8).reshape(n, 2)
+    blocks[:, 2:4] = dmin.astype(np.float16).view(np.uint8).reshape(n, 2)
+    packed = np.zeros((n, 12), np.uint8)  # same 6-bit pack as q4_K
+    for j in range(4):
+        packed[:, j] = sc[:, j] | ((sc[:, j + 4] >> 4) << 6)
+        packed[:, j + 4] = mn[:, j] | ((mn[:, j + 4] >> 4) << 6)
+        packed[:, j + 8] = (sc[:, j + 4] & 0xF) | ((mn[:, j + 4] & 0xF) << 4)
+    blocks[:, 4:16] = packed
+    qh = np.zeros((n, 32), np.uint8)
+    for pair in range(4):
+        lo, hi = q[:, 2 * pair], q[:, 2 * pair + 1]
+        blocks[:, 48 + 32 * pair:48 + 32 * (pair + 1)] = (
+            (lo & 0xF) | ((hi & 0xF) << 4)
+        )
+        qh |= ((lo >> 4) << (2 * pair)) | ((hi >> 4) << (2 * pair + 1))
+    blocks[:, 16:48] = qh
+    return blocks.reshape(*lead, x.shape[-1] // QK_K, 176)
 
 
 def quantize_q4_k(x: np.ndarray) -> np.ndarray:

@@ -99,14 +99,16 @@ def quantize_blockwise(x: jax.Array, spec: QTypeSpec):
         from bigdl_tpu.quant import kquants
 
         xh = np.asarray(x)  # host-side encode (ingest path)
-        if name == "q6_k":
-            blocks = kquants.quantize_q6_k(xh)
-            d = blocks[..., 208:210].copy().view(np.float16)[..., 0]
-        elif name == "q4_k":
-            blocks = kquants.quantize_q4_k(xh)
-            d = blocks[..., 0:2].copy().view(np.float16)[..., 0]
-        else:
+        _ENC = {
+            "q2_k": kquants.quantize_q2_k, "q3_k": kquants.quantize_q3_k,
+            "q4_k": kquants.quantize_q4_k, "q5_k": kquants.quantize_q5_k,
+            "q6_k": kquants.quantize_q6_k,
+        }
+        if name not in _ENC:
             raise NotImplementedError(name)
+        blocks = _ENC[name](xh)
+        d_off = kquants.KQUANT_LAYOUT[name][1]
+        d = blocks[..., d_off:d_off + 2].copy().view(np.float16)[..., 0]
         return jnp.asarray(blocks), jnp.asarray(d), None
 
     if spec.storage.startswith("fp8"):
@@ -180,11 +182,14 @@ def dequantize_blockwise(
     if spec.storage == "ggml_block":
         from bigdl_tpu.quant import kquants
 
-        if name == "q6_k":
-            return kquants.dequant_q6_k(data, dtype)
-        if name == "q4_k":
-            return kquants.dequant_q4_k(data, dtype)
-        raise NotImplementedError(name)
+        _DEC = {
+            "q2_k": kquants.dequant_q2_k, "q3_k": kquants.dequant_q3_k,
+            "q4_k": kquants.dequant_q4_k, "q5_k": kquants.dequant_q5_k,
+            "q6_k": kquants.dequant_q6_k,
+        }
+        if name not in _DEC:
+            raise NotImplementedError(name)
+        return _DEC[name](data, dtype)
 
     if spec.storage.startswith("fp8"):
         xb = _blocked(data.astype(jnp.float32), spec.block_size)

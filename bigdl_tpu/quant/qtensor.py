@@ -63,6 +63,15 @@ class QTensor:
         return n
 
 
+# k-quant fallbacks for tensors whose contraction dim is not a multiple
+# of the 256-element super-block — same policy as llama.cpp, which drops
+# incompatible tensors to a 32-block format of comparable width.
+_KQUANT_FALLBACK = {
+    "q2_k": "sym_int4", "q3_k": "sym_int4", "q4_k": "sym_int4",
+    "q5_k": "sym_int5", "q6_k": "sym_int8",
+}
+
+
 def quantize(x: jax.Array, qtype: str) -> QTensor:
     """Quantize `x` along its last axis into a QTensor.
 
@@ -72,6 +81,9 @@ def quantize(x: jax.Array, qtype: str) -> QTensor:
     spec = resolve_qtype(qtype)
     if spec.is_dense:
         raise ValueError(f"qtype {qtype} is dense; keep the array as-is")
+    if (spec.storage == "ggml_block" and x.shape[-1] % spec.block_size
+            and spec.name in _KQUANT_FALLBACK):
+        spec = resolve_qtype(_KQUANT_FALLBACK[spec.name])
     data, scales, mins = quantize_blockwise(x, spec)
     return QTensor(data=data, scales=scales, mins=mins, qtype=spec.name)
 

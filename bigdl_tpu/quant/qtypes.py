@@ -124,8 +124,19 @@ FP8_E5M2 = _register(QTypeSpec("fp8_e5m2", bits=8, block_size=128, storage="fp8_
 # k-quants: 256-element super-blocks in the llama.cpp byte layout
 # (two-level scales; ggml q4_K = 4.5 bit/weight, q6_K = 6.5625), kept
 # byte-compatible so GGUF k-quant tensors repack without dequantization.
+Q2_K = _register(QTypeSpec(
+    "q2_k", bits=2, block_size=256, storage="ggml_block", block_bytes=84,
+    asymmetric=True,
+))
+Q3_K = _register(QTypeSpec(
+    "q3_k", bits=3, block_size=256, storage="ggml_block", block_bytes=110,
+))
 Q4_K = _register(QTypeSpec(
     "q4_k", bits=4, block_size=256, storage="ggml_block", block_bytes=144,
+    asymmetric=True,
+))
+Q5_K = _register(QTypeSpec(
+    "q5_k", bits=5, block_size=256, storage="ggml_block", block_bytes=176,
     asymmetric=True,
 ))
 Q6_K = _register(QTypeSpec(
@@ -152,8 +163,13 @@ _ALIASES = {
 # gguf_mixed_qtype, ggml/quantize.py:60-61: *_s/*_m variants keep the
 # output layer at q6_k)
 MIXED_QTYPES = {
+    "q2_k_s": ("q2_k", "q4_k"),
+    "q3_k_s": ("q3_k", "q6_k"),
+    "q3_k_m": ("q3_k", "q6_k"),
     "q4_k_s": ("q4_k", "q6_k"),
     "q4_k_m": ("q4_k", "q6_k"),
+    "q5_k_s": ("q5_k", "q6_k"),
+    "q5_k_m": ("q5_k", "q6_k"),
 }
 
 

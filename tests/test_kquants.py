@@ -9,11 +9,109 @@ from bigdl_tpu.convert import gguf as G
 from bigdl_tpu.quant import QTensor, quantize
 from bigdl_tpu.quant.imatrix import quantize_with_weights
 from bigdl_tpu.quant.kquants import (
+    dequant_q2_k,
+    dequant_q3_k,
     dequant_q4_k,
+    dequant_q5_k,
     dequant_q6_k,
+    quantize_q2_k,
+    quantize_q3_k,
     quantize_q4_k,
+    quantize_q5_k,
     quantize_q6_k,
 )
+
+# (quantize, dequant, block_bytes, roundtrip rel-err bound for N(0,1))
+_KQ_CODECS = {
+    "q2_k": (quantize_q2_k, dequant_q2_k, 84, 0.40),
+    "q3_k": (quantize_q3_k, dequant_q3_k, 110, 0.20),
+    "q4_k": (quantize_q4_k, dequant_q4_k, 144, 0.10),
+    "q5_k": (quantize_q5_k, dequant_q5_k, 176, 0.06),
+    "q6_k": (quantize_q6_k, dequant_q6_k, 210, 0.02),
+}
+
+
+@pytest.mark.parametrize("name", list(_KQ_CODECS))
+def test_kquant_roundtrip(rng, name):
+    q, dq, nb, bound = _KQ_CODECS[name]
+    x = rng.standard_normal((4, 512)).astype(np.float32)
+    blocks = q(x)
+    assert blocks.shape == (4, 2, nb)
+    y = np.asarray(dq(jnp.asarray(blocks)))
+    err = np.abs(y - x).mean() / np.abs(x).mean()
+    assert err < bound, (name, err)
+    # monotone: more bits -> better reconstruction is checked by the
+    # per-codec bounds scaling with bit width (2.625 -> 6.5625 b/w)
+
+
+@pytest.mark.parametrize("name", ["q2_k", "q3_k", "q5_k"])
+def test_new_kquants_gguf_numpy_decoder_matches(rng, name):
+    """convert/gguf.py's numpy-path decoder for q2/q3/q5_k must agree
+    with the jnp codec (it is built on it — this guards the adapter's
+    shape plumbing for 2-D and 1-D tensors)."""
+    q, dq, nb, _ = _KQ_CODECS[name]
+    ggml_type = {"q2_k": G.GGML_Q2_K, "q3_k": G.GGML_Q3_K,
+                 "q5_k": G.GGML_Q5_K}[name]
+    x = rng.standard_normal((2, 256)).astype(np.float32)
+    b = q(x)
+    np.testing.assert_allclose(
+        G._DEQUANT[ggml_type](b).reshape(2, 256),
+        np.asarray(dq(jnp.asarray(b))).reshape(2, 256),
+        rtol=1e-6, atol=1e-6,
+    )
+    b1 = q(x[0])  # 1-D tensor path
+    np.testing.assert_allclose(
+        G._DEQUANT[ggml_type](b1).reshape(256),
+        np.asarray(dq(jnp.asarray(b1))).reshape(256),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("name", ["q2_k", "q3_k", "q5_k"])
+def test_new_kquant_gguf_direct_repack(rng, name):
+    """q2/q3/q5_k GGUF blocks repack verbatim and dequantize through the
+    QTensor api — the VERDICT r2 crash case (KeyError at _BLOCK) for
+    common q3_k_m checkpoints."""
+    q, dq, nb, _ = _KQ_CODECS[name]
+    ggml_type = {"q2_k": G.GGML_Q2_K, "q3_k": G.GGML_Q3_K,
+                 "q5_k": G.GGML_Q5_K}[name]
+    x = rng.standard_normal((8, 256)).astype(np.float32)
+    blocks = q(x)
+    data, scales, mins, out_name = G.repack_to_qtensor(blocks, ggml_type)
+    assert out_name == name
+    np.testing.assert_array_equal(data, blocks)
+    qt = QTensor(
+        data=jnp.asarray(data), scales=jnp.asarray(scales), mins=None,
+        qtype=name,
+    )
+    np.testing.assert_allclose(
+        np.asarray(qt.dequantize(jnp.float32)),
+        np.asarray(dq(jnp.asarray(blocks))),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_q3_k_model_forward(rng):
+    """q3_k weights through the whole model forward (the q3_k_m body)."""
+    from bigdl_tpu import kvcache
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=256, intermediate_size=256,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        head_dim=128, max_position_embeddings=64,
+    )
+    params = llama.quantize_params(
+        llama.init_params(cfg, jax.random.PRNGKey(0)), "q3_k_m"
+    )
+    assert params["layers"]["wq"].qtype == "q3_k"
+    assert params["lm_head"].qtype == "q6_k"
+    cache = kvcache.init_cache(1, 1, 16, 2, 128)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray([[1, 2, 3]], jnp.int32), cache, mode="prefill"
+    )
+    assert np.all(np.isfinite(np.asarray(logits)))
 
 
 def test_q6_k_roundtrip(rng):

@@ -35,7 +35,10 @@ _TOL = {
     "fp6": 0.08,
     "fp8_e4m3": 0.04,
     "fp8_e5m2": 0.12,
-    "q4_k": 0.13,  # two-level RTN scales (quant/kquants.py)
+    "q2_k": 0.45,  # two-level RTN scales (quant/kquants.py)
+    "q3_k": 0.25,
+    "q4_k": 0.13,
+    "q5_k": 0.07,
     "q6_k": 0.025,
 }
 
