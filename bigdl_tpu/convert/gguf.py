@@ -51,14 +51,19 @@ _TYPE_NAMES = {
     GGML_Q6_K: "q6_k",
 }
 
-# (block_elems, block_bytes)
+from bigdl_tpu.quant.qtypes import KQUANT_LAYOUT  # numpy-only module
+
+_KQUANT_TYPES = {GGML_Q2_K: "q2_k", GGML_Q3_K: "q3_k", GGML_Q4_K: "q4_k",
+                 GGML_Q5_K: "q5_k", GGML_Q6_K: "q6_k"}
+
+# (block_elems, block_bytes); k-quant sizes come from the single layout
+# table in quant/qtypes.py
 _BLOCK = {
     GGML_F32: (1, 4), GGML_F16: (1, 2), GGML_BF16: (1, 2),
     GGML_Q4_0: (32, 18), GGML_Q4_1: (32, 20),
     GGML_Q5_0: (32, 22), GGML_Q5_1: (32, 24),
     GGML_Q8_0: (32, 34),
-    GGML_Q2_K: (256, 84), GGML_Q3_K: (256, 110),
-    GGML_Q4_K: (256, 144), GGML_Q5_K: (256, 176), GGML_Q6_K: (256, 210),
+    **{t: (256, KQUANT_LAYOUT[n][0]) for t, n in _KQUANT_TYPES.items()},
 }
 
 # metadata value types
@@ -388,15 +393,11 @@ def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
             axis=-1,
         ).astype(np.int8)
         return codes.reshape(*codes.shape[:-2], -1), d, m, "asym_int5"
-    _KQ = {GGML_Q2_K: "q2_k", GGML_Q3_K: "q3_k", GGML_Q4_K: "q4_k",
-           GGML_Q5_K: "q5_k", GGML_Q6_K: "q6_k"}
-    if ggml_type in _KQ:
+    if ggml_type in _KQUANT_TYPES:
         # our k-quant QTensor storage IS the ggml super-block byte layout
         # — carry the blocks verbatim (quant/kquants.py decodes in-graph;
         # d offsets live in KQUANT_LAYOUT, the single layout table)
-        from bigdl_tpu.quant.kquants import KQUANT_LAYOUT
-
-        name = _KQ[ggml_type]
+        name = _KQUANT_TYPES[ggml_type]
         d = _f16(blocks, KQUANT_LAYOUT[name][1]).astype(np.float16)
         return blocks, d, None, name
     raise KeyError(ggml_type)

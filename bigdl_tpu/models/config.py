@@ -70,8 +70,17 @@ class ModelConfig:
     moe_intermediate_size: Optional[int] = None
     shared_expert_intermediate_size: Optional[int] = None  # qwen2_moe
     norm_topk_prob: bool = False  # renormalize top-k router weights
+    # dispatch formulation: None = auto (dense for E<=8, ragged above),
+    # or force "dense" / "ragged" (models/llama.py _moe_mlp)
+    moe_dispatch: Optional[str] = None
+    moe_capacity_factor: float = 1.25  # ragged: slots per expert vs even load
 
     def __post_init__(self):
+        if self.moe_dispatch not in (None, "dense", "ragged"):
+            raise ValueError(
+                f"moe_dispatch must be None, 'dense' or 'ragged'; "
+                f"got {self.moe_dispatch!r}"
+            )
         # ModelConfig is a static jit argument and must hash; rope_scaling
         # arrives as a dict from HF config.json (or a list-of-pairs after a
         # JSON round-trip through save_low_bit) — normalize to a tuple.

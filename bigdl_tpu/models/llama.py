@@ -230,41 +230,116 @@ def _deq(w, compute_dtype):
     return w.dequantize(compute_dtype) if isinstance(w, QTensor) else w.astype(compute_dtype)
 
 
-def _moe_mlp(config: ModelConfig, x: jax.Array, p: Params, compute_dtype) -> jax.Array:
-    """Mixture-of-experts MLP (reference models/mixtral.py, qwen2_moe.py +
-    `xe_linear.get_moe_indexes`): top-k routing with softmax weights.
+def resolve_moe_dispatch(config: ModelConfig) -> str:
+    """Auto policy: dense combine is cheaper below ~8 experts (all-matmul,
+    no gather/scatter); capacity dispatch above (FLOPs ∝ k/E)."""
+    if config.moe_dispatch is not None:
+        return config.moe_dispatch
+    return "ragged" if config.num_experts > 8 else "dense"
 
-    TPU-dense formulation: every expert computes every token and the
-    router weights (zero for unrouted experts) combine them — all-matmul,
-    no gather/scatter, MXU-friendly and exactly differentiable. Efficient
-    at mixtral scale (E=8, k=2 → 4x active FLOPs on tiny MLP blocks);
-    a capacity-based ragged dispatch is the planned upgrade for E>>k.
-    """
-    B, T, H = x.shape
-    xc = x.astype(compute_dtype)
+
+def _moe_router(config: ModelConfig, xc: jax.Array, p: Params):
+    """Top-k routing with softmax weights. Returns (topv [B,T,k] f32,
+    topi [B,T,k] i32). Mixtral renormalizes the top-k weights
+    (norm_topk_prob=True via config), qwen2_moe per its flag."""
     router_logits = jnp.einsum(
-        "bth,eh->bte", xc, p["router"].astype(compute_dtype),
+        "bth,eh->bte", xc, p["router"].astype(xc.dtype),
         preferred_element_type=jnp.float32,
     ).astype(jnp.float32)
-
-    # softmax over all experts, then top-k; mixtral renormalizes the top-k
-    # weights (norm_topk_prob=True via config), qwen2_moe per its flag
     probs_all = jax.nn.softmax(router_logits, axis=-1)
     topv, topi = jax.lax.top_k(probs_all, config.num_experts_per_tok)
     if config.norm_topk_prob:
         topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-20)
-    # scatter top-k weights back to a dense [B,T,E] combine matrix
-    onehot = jax.nn.one_hot(topi, config.num_experts, dtype=jnp.float32)
-    combine = jnp.einsum("btk,btke->bte", topv, onehot)
+    return topv, topi
 
+
+def _expert_ffn(config: ModelConfig, xe: jax.Array, p: Params, compute_dtype):
+    """Per-expert gated FFN on already-grouped tokens: [E, C, H] -> [E, C, H]."""
     wg = _deq(p["w_gate_e"], compute_dtype)  # [E, I, H]
     wu = _deq(p["w_up_e"], compute_dtype)
     wd = _deq(p["w_down_e"], compute_dtype)  # [E, H, I]
-    g = jnp.einsum("bth,eih->btei", xc, wg, preferred_element_type=compute_dtype)
-    u = jnp.einsum("bth,eih->btei", xc, wu, preferred_element_type=compute_dtype)
+    g = jnp.einsum("ech,eih->eci", xe, wg, preferred_element_type=compute_dtype)
+    u = jnp.einsum("ech,eih->eci", xe, wu, preferred_element_type=compute_dtype)
     z = _act(config.hidden_act, g) * u
-    d = jnp.einsum("btei,ehi->bteh", z, wd, preferred_element_type=compute_dtype)
-    out = jnp.einsum("bteh,bte->bth", d, combine.astype(compute_dtype))
+    return jnp.einsum("eci,ehi->ech", z, wd, preferred_element_type=compute_dtype)
+
+
+def _moe_dispatch_ragged(
+    config: ModelConfig, xc: jax.Array, p: Params, compute_dtype,
+    topv: jax.Array, topi: jax.Array,
+) -> jax.Array:
+    """Capacity-based ragged dispatch (GShard/Switch style): each expert
+    computes only its routed tokens, so FLOPs scale with k/E instead of
+    1 — the difference between mixtral (E=8, k=2: dense costs 4x) and
+    qwen2-moe (E=60, k=4: dense would cost 15x).
+
+    Static-shape formulation for XLA: per-expert slot positions come from
+    a cumulative sum over the one-hot assignment matrix; tokens beyond
+    expert capacity C = ceil(N*k/E * capacity_factor) are dropped (their
+    combine weight is zeroed — router softmax mass simply doesn't arrive,
+    matching GShard overflow semantics). Gather/scatter both
+    differentiate cleanly for MoE training.
+    """
+    B, T, H = xc.shape
+    E, k = config.num_experts, config.num_experts_per_tok
+    N = B * T
+    cf = config.moe_capacity_factor
+    C = max(1, min(N, int(-(-N * k * cf // E))))
+
+    x_flat = xc.reshape(N, H)
+    e_flat = topi.reshape(N * k)  # assignment order: token-major
+    w_flat = topv.reshape(N * k).astype(compute_dtype)
+
+    onehot = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)  # [N*k, E]
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # prior same-expert count
+    pos = jnp.sum(pos * onehot, axis=-1)  # [N*k] slot within expert
+    keep = pos < C
+    slot = jnp.where(keep, e_flat * C + pos, E * C)  # E*C = overflow bin
+
+    tok = jnp.repeat(jnp.arange(N), k)  # token of each assignment
+    x_disp = jnp.zeros((E * C + 1, H), compute_dtype).at[slot].add(
+        x_flat[tok], mode="drop"
+    )
+    y = _expert_ffn(
+        config, x_disp[:-1].reshape(E, C, H), p, compute_dtype
+    ).reshape(E * C, H)
+    y = jnp.concatenate([y, jnp.zeros((1, H), compute_dtype)], axis=0)
+
+    contrib = y[slot] * w_flat[:, None]  # overflow slots read zeros
+    out = jnp.zeros((N, H), compute_dtype).at[tok].add(contrib)
+    return out.reshape(B, T, H)
+
+
+def _moe_mlp(config: ModelConfig, x: jax.Array, p: Params, compute_dtype) -> jax.Array:
+    """Mixture-of-experts MLP (reference models/mixtral.py, qwen2_moe.py +
+    `xe_linear.get_moe_indexes`): top-k routing with softmax weights.
+
+    Two formulations, chosen by `config.moe_dispatch` (auto = by expert
+    count):
+    - "dense": every expert computes every token, router weights (zero
+      for unrouted) combine them — all-matmul, no gather/scatter,
+      MXU-friendly, exactly differentiable. Best at mixtral scale (E=8).
+    - "ragged": capacity-based dispatch, FLOPs ∝ k/E — required for
+      qwen2-moe scale (E=60, k=4). See _moe_dispatch_ragged.
+    """
+    B, T, H = x.shape
+    xc = x.astype(compute_dtype)
+    topv, topi = _moe_router(config, xc, p)
+
+    if resolve_moe_dispatch(config) == "ragged":
+        out = _moe_dispatch_ragged(config, xc, p, compute_dtype, topv, topi)
+    else:
+        # scatter top-k weights back to a dense [B,T,E] combine matrix
+        onehot = jax.nn.one_hot(topi, config.num_experts, dtype=jnp.float32)
+        combine = jnp.einsum("btk,btke->bte", topv, onehot)
+        wg = _deq(p["w_gate_e"], compute_dtype)  # [E, I, H]
+        wu = _deq(p["w_up_e"], compute_dtype)
+        wd = _deq(p["w_down_e"], compute_dtype)  # [E, H, I]
+        g = jnp.einsum("bth,eih->btei", xc, wg, preferred_element_type=compute_dtype)
+        u = jnp.einsum("bth,eih->btei", xc, wu, preferred_element_type=compute_dtype)
+        z = _act(config.hidden_act, g) * u
+        d = jnp.einsum("btei,ehi->bteh", z, wd, preferred_element_type=compute_dtype)
+        out = jnp.einsum("bteh,bte->bth", d, combine.astype(compute_dtype))
 
     if config.shared_expert_intermediate_size:
         # qwen2_moe shared expert, sigmoid-gated (models/qwen2_moe.py)

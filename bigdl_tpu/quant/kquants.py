@@ -26,17 +26,9 @@ import numpy as np
 
 QK_K = 256
 
-# Single source of truth for the super-block byte layouts: name ->
-# (block_bytes, byte offset of the fp16 super-scale d). Consumed by
-# quant/numerics.py (encode) and convert/gguf.py (verbatim repack) so the
-# magic offsets exist in exactly one place.
-KQUANT_LAYOUT = {
-    "q2_k": (84, 80),
-    "q3_k": (110, 108),
-    "q4_k": (144, 0),
-    "q5_k": (176, 0),
-    "q6_k": (210, 208),
-}
+# re-export: the layout table lives in qtypes (numpy-only module) so
+# convert/gguf.py can consume it without pulling in jax
+from bigdl_tpu.quant.qtypes import KQUANT_LAYOUT  # noqa: E402,F401
 
 
 # ---------------------------------------------------------------------------
@@ -280,22 +272,8 @@ def quantize_q2_k(x: np.ndarray) -> np.ndarray:
     xb = x.reshape(-1, 16, 16)  # 16 sub-blocks of 16
     n = xb.shape[0]
 
-    mins = np.minimum(xb.min(axis=-1), 0.0)
-    maxs = xb.max(axis=-1)
-    scales = (maxs - mins) / 3.0
-    d = scales.max(axis=-1) / 15.0
-    dmin = (-mins).max(axis=-1) / 15.0
-    inv_d = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
-    inv_dm = np.where(dmin == 0, 0.0, 1.0 / np.where(dmin == 0, 1, dmin))
-    sc = np.clip(np.round(scales * inv_d[:, None]), 0, 15).astype(np.uint8)
-    mn = np.clip(np.round(-mins * inv_dm[:, None]), 0, 15).astype(np.uint8)
-
-    eff_s = d[:, None] * sc.astype(np.float32)
-    eff_m = dmin[:, None] * mn.astype(np.float32)
-    inv_eff = np.where(eff_s == 0, 0.0, 1.0 / np.where(eff_s == 0, 1, eff_s))
-    q = np.clip(
-        np.round((xb + eff_m[..., None]) * inv_eff[..., None]), 0, 3
-    ).astype(np.uint8).reshape(n, QK_K)
+    d, dmin, sc, mn, q = _two_level_asym_scales(xb, qmax=3, super_max=15)
+    q = q.reshape(n, QK_K)
 
     blocks = np.zeros((n, 84), np.uint8)
     blocks[:, 0:16] = sc | (mn << 4)
@@ -360,6 +338,42 @@ def quantize_q3_k(x: np.ndarray) -> np.ndarray:
     return blocks.reshape(*lead, x.shape[-1] // QK_K, 110)
 
 
+def _two_level_asym_scales(xb: np.ndarray, qmax: int, super_max: int = 63):
+    """Shared q2_K/q4_K/q5_K RTN scale search over [n, n_sub, sub] blocks:
+    per-sub-block (scale, min) quantized to `super_max`-code integers
+    under fp16 super-scales. Returns (d, dmin, sc, mn, q) with q the
+    codes in [0, qmax]."""
+    mins = np.minimum(xb.min(axis=-1), 0.0)  # (m >= 0 convention)
+    maxs = xb.max(axis=-1)
+    scales = (maxs - mins) / qmax
+    d = scales.max(axis=-1) / super_max
+    dmin = (-mins).max(axis=-1) / super_max
+    inv_d = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
+    inv_dm = np.where(dmin == 0, 0.0, 1.0 / np.where(dmin == 0, 1, dmin))
+    sc = np.clip(np.round(scales * inv_d[:, None]), 0, super_max).astype(np.uint8)
+    mn = np.clip(np.round(-mins * inv_dm[:, None]), 0, super_max).astype(np.uint8)
+
+    eff_s = d[:, None] * sc.astype(np.float32)
+    eff_m = dmin[:, None] * mn.astype(np.float32)
+    inv_eff = np.where(eff_s == 0, 0.0, 1.0 / np.where(eff_s == 0, 1, eff_s))
+    q = np.clip(
+        np.round((xb + eff_m[..., None]) * inv_eff[..., None]), 0, qmax
+    ).astype(np.uint8)
+    return d, dmin, sc, mn, q
+
+
+def _pack_q4k_scales(sc: np.ndarray, mn: np.ndarray) -> np.ndarray:
+    """[n, 8] 6-bit scales/mins -> 12 packed bytes (inverse of
+    _unpack_q4k_scales); shared by q4_K and q5_K."""
+    n = sc.shape[0]
+    packed = np.zeros((n, 12), np.uint8)
+    for j in range(4):
+        packed[:, j] = sc[:, j] | ((sc[:, j + 4] >> 4) << 6)
+        packed[:, j + 4] = mn[:, j] | ((mn[:, j + 4] >> 4) << 6)
+        packed[:, j + 8] = (sc[:, j + 4] & 0xF) | ((mn[:, j + 4] & 0xF) << 4)
+    return packed
+
+
 def quantize_q5_k(x: np.ndarray) -> np.ndarray:
     """x [..., K] (K % 256 == 0) -> blocks [..., K/256, 176] uint8."""
     x = np.asarray(x, np.float32)
@@ -367,32 +381,12 @@ def quantize_q5_k(x: np.ndarray) -> np.ndarray:
     xb = x.reshape(-1, 8, 32)
     n = xb.shape[0]
 
-    mins = np.minimum(xb.min(axis=-1), 0.0)
-    maxs = xb.max(axis=-1)
-    scales = (maxs - mins) / 31.0
-    d = scales.max(axis=-1) / 63.0
-    dmin = (-mins).max(axis=-1) / 63.0
-    inv_d = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
-    inv_dm = np.where(dmin == 0, 0.0, 1.0 / np.where(dmin == 0, 1, dmin))
-    sc = np.clip(np.round(scales * inv_d[:, None]), 0, 63).astype(np.uint8)
-    mn = np.clip(np.round(-mins * inv_dm[:, None]), 0, 63).astype(np.uint8)
-
-    eff_s = d[:, None] * sc.astype(np.float32)
-    eff_m = dmin[:, None] * mn.astype(np.float32)
-    inv_eff = np.where(eff_s == 0, 0.0, 1.0 / np.where(eff_s == 0, 1, eff_s))
-    q = np.clip(
-        np.round((xb + eff_m[..., None]) * inv_eff[..., None]), 0, 31
-    ).astype(np.uint8)  # [n, 8, 32]
+    d, dmin, sc, mn, q = _two_level_asym_scales(xb, qmax=31)
 
     blocks = np.zeros((n, 176), np.uint8)
     blocks[:, 0:2] = d.astype(np.float16).view(np.uint8).reshape(n, 2)
     blocks[:, 2:4] = dmin.astype(np.float16).view(np.uint8).reshape(n, 2)
-    packed = np.zeros((n, 12), np.uint8)  # same 6-bit pack as q4_K
-    for j in range(4):
-        packed[:, j] = sc[:, j] | ((sc[:, j + 4] >> 4) << 6)
-        packed[:, j + 4] = mn[:, j] | ((mn[:, j + 4] >> 4) << 6)
-        packed[:, j + 8] = (sc[:, j + 4] & 0xF) | ((mn[:, j + 4] & 0xF) << 4)
-    blocks[:, 4:16] = packed
+    blocks[:, 4:16] = _pack_q4k_scales(sc, mn)
     qh = np.zeros((n, 32), np.uint8)
     for pair in range(4):
         lo, hi = q[:, 2 * pair], q[:, 2 * pair + 1]
@@ -411,33 +405,12 @@ def quantize_q4_k(x: np.ndarray) -> np.ndarray:
     xb = x.reshape(-1, 8, 32)  # 8 sub-blocks of 32
     n = xb.shape[0]
 
-    mins = np.minimum(xb.min(axis=-1), 0.0)  # [n, 8] (m >= 0 convention)
-    maxs = xb.max(axis=-1)
-    scales = (maxs - mins) / 15.0
-    d = scales.max(axis=-1) / 63.0
-    dmin = (-mins).max(axis=-1) / 63.0
-    inv_d = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1, d))
-    inv_dm = np.where(dmin == 0, 0.0, 1.0 / np.where(dmin == 0, 1, dmin))
-    sc = np.clip(np.round(scales * inv_d[:, None]), 0, 63).astype(np.uint8)
-    mn = np.clip(np.round(-mins * inv_dm[:, None]), 0, 63).astype(np.uint8)
-
-    eff_s = d[:, None] * sc.astype(np.float32)
-    eff_m = dmin[:, None] * mn.astype(np.float32)
-    inv_eff = np.where(eff_s == 0, 0.0, 1.0 / np.where(eff_s == 0, 1, eff_s))
-    q = np.clip(
-        np.round((xb + eff_m[..., None]) * inv_eff[..., None]), 0, 15
-    ).astype(np.uint8)
+    d, dmin, sc, mn, q = _two_level_asym_scales(xb, qmax=15)
 
     blocks = np.zeros((n, 144), np.uint8)
     blocks[:, 0:2] = d.astype(np.float16).view(np.uint8).reshape(n, 2)
     blocks[:, 2:4] = dmin.astype(np.float16).view(np.uint8).reshape(n, 2)
-    # pack 6-bit scales/mins (inverse of get_scale_min_k4)
-    packed = np.zeros((n, 12), np.uint8)
-    for j in range(4):
-        packed[:, j] = sc[:, j] | ((sc[:, j + 4] >> 4) << 6)
-        packed[:, j + 4] = mn[:, j] | ((mn[:, j + 4] >> 4) << 6)
-        packed[:, j + 8] = (sc[:, j + 4] & 0xF) | ((mn[:, j + 4] & 0xF) << 4)
-    blocks[:, 4:16] = packed
+    blocks[:, 4:16] = _pack_q4k_scales(sc, mn)
     for pair in range(4):
         lo = q[:, 2 * pair]
         hi = q[:, 2 * pair + 1]

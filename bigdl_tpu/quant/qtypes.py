@@ -124,6 +124,18 @@ FP8_E5M2 = _register(QTypeSpec("fp8_e5m2", bits=8, block_size=128, storage="fp8_
 # k-quants: 256-element super-blocks in the llama.cpp byte layout
 # (two-level scales; ggml q4_K = 4.5 bit/weight, q6_K = 6.5625), kept
 # byte-compatible so GGUF k-quant tensors repack without dequantization.
+# KQUANT_LAYOUT is the single source of truth for the byte layouts:
+# name -> (block_bytes, byte offset of the fp16 super-scale d). Consumed
+# by quant/kquants.py (codecs), quant/numerics.py (encode) and
+# convert/gguf.py (_BLOCK sizes + verbatim repack); the QTypeSpec
+# block_bytes below are checked against it at import.
+KQUANT_LAYOUT = {
+    "q2_k": (84, 80),
+    "q3_k": (110, 108),
+    "q4_k": (144, 0),
+    "q5_k": (176, 0),
+    "q6_k": (210, 208),
+}
 Q2_K = _register(QTypeSpec(
     "q2_k", bits=2, block_size=256, storage="ggml_block", block_bytes=84,
     asymmetric=True,
@@ -144,6 +156,11 @@ Q6_K = _register(QTypeSpec(
 ))
 FP16 = _register(QTypeSpec("fp16", bits=16, block_size=1, storage="dense"))
 BF16 = _register(QTypeSpec("bf16", bits=16, block_size=1, storage="dense"))
+
+for _name, (_bb, _d_off) in KQUANT_LAYOUT.items():
+    assert _REGISTRY[_name].block_bytes == _bb, (
+        f"{_name}: QTypeSpec.block_bytes != KQUANT_LAYOUT"
+    )
 
 # Aliases matching the reference's user-facing spellings
 # (transformers/model.py: load_in_low_bit values).
