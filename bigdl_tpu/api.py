@@ -53,8 +53,49 @@ class TpuModel:
     def family(self):
         return get_family(self.config.model_type)
 
+    @property
+    def pp_size(self) -> int:
+        if self.mesh is not None and "pp" in getattr(self.mesh, "axis_names", ()):
+            return self.mesh.shape["pp"]
+        return 1
+
+    @property
+    def forward_fn(self):
+        """The forward used by generate()/the serving engine: the plain
+        family forward, or — when the mesh has a pp axis — the pipeline
+        step with per-stage KV caches (parallel/pipeline.py), which keeps
+        the same (config, params, tokens, cache, mode, last_logits_only)
+        call shape so callers don't branch."""
+        if self.pp_size <= 1:
+            return self.family.forward
+        if getattr(self, "_pp_step", None) is None:
+            from bigdl_tpu.parallel.pipeline import make_pipeline_step
+
+            step = make_pipeline_step(self.config, self.family.forward,
+                                      self.mesh)
+
+            def pp_forward(config, params, tokens, cache,
+                           mode="prefill", last_logits_only=False, **kw):
+                # features beyond the plain prefill/decode step must fail
+                # loudly, not silently drop their kwargs
+                unsupported = {k: v for k, v in kw.items()
+                               if v not in (None, 0, False)}
+                if cache is None or unsupported:
+                    raise NotImplementedError(
+                        "pipeline-parallel forward supports the cached "
+                        "prefill/decode step only; got cache=None or "
+                        f"kwargs {sorted(unsupported)} — run this path on "
+                        "a tp/dp mesh (pp=1) instead"
+                    )
+                return step(params, tokens, cache, mode=mode,
+                            last_logits_only=last_logits_only)
+
+            self._pp_step = pp_forward
+        return self._pp_step
+
     def to_mesh(self, mesh=None, tp: Optional[int] = None,
-                dp: Optional[int] = None, sp: int = 1) -> "TpuModel":
+                dp: Optional[int] = None, sp: int = 1,
+                pp: int = 1) -> "TpuModel":
         """Shard the params for multi-chip inference and make generate()
         / the serving engine run SPMD over the mesh.
 
@@ -65,7 +106,12 @@ class TpuModel:
         (convert.py:152-234, low_bit_linear.py:675-682); here the
         PartitionSpecs make XLA insert the psums over ICI.
 
-        mesh=None builds a (dp, sp, tp) mesh over all visible devices
+        pp > 1 (or a mesh with a 'pp' axis) additionally shards the layer
+        stacks across pipeline stages — models bigger than one slice's
+        HBM serve via make_pipeline_step (the reference's
+        pipeline_parallel_stages=N, model.py:352-365).
+
+        mesh=None builds a (pp, dp, sp, tp) mesh over all visible devices
         (tp defaulting to every device).
         """
         from bigdl_tpu.parallel import make_mesh, shard_params
@@ -74,9 +120,24 @@ class TpuModel:
 
         if mesh is None:
             n = len(jax.devices())
-            if tp is not None and dp is not None:
-                # fully specified: use exactly dp*sp*tp devices (a subset
-                # of the host's devices is fine)
+            if pp > 1:
+                # pp requires a 4-axis mesh; fill unspecified axes so
+                # to_mesh(pp=2) works on its own instead of silently
+                # building a pp-less mesh
+                dp = dp or 1
+                tp = tp or max(1, n // (pp * dp * sp))
+                if pp * dp * sp * tp > n:
+                    raise ValueError(
+                        f"pp*dp*sp*tp = {pp * dp * sp * tp} exceeds {n} devices"
+                    )
+                mesh = make_mesh(
+                    (pp, dp, sp, tp),
+                    devices=jax.devices()[: pp * dp * sp * tp],
+                    axes=("pp", "dp", "sp", "tp"),
+                )
+            elif tp is not None and dp is not None:
+                # fully specified: use exactly dp*sp*tp devices (a
+                # subset of the host's devices is fine)
                 if dp * sp * tp > n:
                     raise ValueError(
                         f"dp*sp*tp = {dp * sp * tp} exceeds {n} devices"
@@ -98,7 +159,27 @@ class TpuModel:
                 f"not divisible by tp={tp_size}"
             )
         self.mesh = mesh
-        self.params = shard_params(self.params, param_specs(self.config), mesh)
+        specs = param_specs(self.config)
+        if "pp" in mesh.axis_names and mesh.shape["pp"] > 1:
+            from bigdl_tpu.parallel.pipeline import pp_param_specs
+
+            if self.config.num_hidden_layers % mesh.shape["pp"]:
+                raise ValueError(
+                    f"num_hidden_layers={self.config.num_hidden_layers} "
+                    f"not divisible by pp={mesh.shape['pp']}"
+                )
+            if self.config.learned_positions or self.config.embed_layernorm:
+                # the pipeline stage embeds with embed_tokens only; gpt2's
+                # wpe table and bloom's embedding layernorm would be
+                # silently skipped — refuse rather than generate garbage
+                raise NotImplementedError(
+                    f"pipeline parallelism does not yet support "
+                    f"{self.config.model_type} (learned positions / "
+                    "embedding layernorm)"
+                )
+            specs = pp_param_specs(self.config, specs)
+        self.params = shard_params(self.params, specs, mesh)
+        self._pp_step = None  # rebuilt for the new mesh on next use
         return self
 
     def _mesh_ctx(self):
@@ -161,10 +242,19 @@ class TpuModel:
                 "sliding-window/ALiBi attention for this config"
             )
             compress_kv = None
+        if self.pp_size > 1 and compress_kv is not None:
+            # the pipeline step has no collect_obs path (SnapKV needs the
+            # per-layer observation queries, api of forward_fn)
+            warnings.warn(
+                "SnapKV compress_kv skipped: not supported with "
+                "pipeline parallelism"
+            )
+            compress_kv = None
         if (
             flags.performance_mode()
             and not do_sample
             and compress_kv is None  # lookup path has no SnapKV support
+            and self.pp_size <= 1  # lookup jits family.forward directly
             and max(len(p) for p in prompts) >= 256
         ):
             return self.generate_lookup(
@@ -196,7 +286,7 @@ class TpuModel:
                 jnp.asarray(start),
                 jax.random.PRNGKey(seed),
                 gen,
-                self.family.forward,
+                self.forward_fn,
                 cache_len=cache_len,
                 quantize_kv=quantize_kv,
                 compress_budget=budget,
@@ -218,6 +308,13 @@ class TpuModel:
         IPEX_LLM_PERFORMANCE_MODE): n-gram candidates, one verify forward."""
         from bigdl_tpu.decode import lookup_generate
 
+        if self.pp_size > 1:
+            raise NotImplementedError(
+                "lookup decoding jits the family forward directly and "
+                "would gather pp-sharded layer stacks onto every stage; "
+                "use plain generate() under pipeline parallelism"
+            )
+
         return lookup_generate(
             self.config, self.params, prompts, self.family.forward,
             max_new_tokens=max_new_tokens, lookahead=lookahead,
@@ -238,6 +335,13 @@ class TpuModel:
         only meaningful when this model holds higher-precision weights.
         The self-draft is built once and cached on the model."""
         from bigdl_tpu.decode import speculative_generate
+
+        if self.pp_size > 1:
+            raise NotImplementedError(
+                "speculative decoding jits the family forward directly "
+                "and would gather pp-sharded layer stacks onto every "
+                "stage; use plain generate() under pipeline parallelism"
+            )
 
         if draft_params is None:
             from bigdl_tpu.quant.qtypes import resolve_qtype

@@ -53,6 +53,10 @@ def perplexity(
     if max_tokens:
         ids = ids[:max_tokens]
     stride = stride or window
+    # family.forward, NOT forward_fn: ppl scores the cache-free path with
+    # start offsets, which the pipeline step doesn't implement — under a
+    # pp mesh GSPMD still runs this correctly (with cross-stage gathers;
+    # acceptable for offline eval)
     fwd = model.family.forward
 
     total, count = 0.0, 0.0

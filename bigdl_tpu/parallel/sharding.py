@@ -107,23 +107,34 @@ def lora_specs(config: ModelConfig, targets: tuple[str, ...]) -> dict:
     return {"layers": layers, "scale": _REP}
 
 
-def sharding_tree(specs: dict, mesh: Mesh, params) -> dict:
-    """Expand a PartitionSpec tree into a NamedSharding tree exactly
-    matching `params` structure (QTensor nodes expand field-wise)."""
+def expand_specs_for_params(specs, params, wrap=lambda spec: spec):
+    """Match a per-leaf spec tree against `params`' exact structure:
+    QTensor pytree nodes expand field-wise (data/scales share the spec,
+    mins only when present). `wrap` maps each spec to its final leaf
+    (e.g. NamedSharding). The ONE place this QTensor trick lives — used
+    by sharding_tree and both pipeline spec builders."""
 
     def expand(spec, param):
         if isinstance(param, QTensor):
-            ns = NamedSharding(mesh, spec)
+            w = wrap(spec)
             return QTensor(
-                data=ns,
-                scales=ns,
-                mins=None if param.mins is None else ns,
+                data=w,
+                scales=w,
+                mins=None if param.mins is None else w,
                 qtype=param.qtype,
             )
-        return NamedSharding(mesh, spec)
+        return wrap(spec)
 
     return jax.tree.map(
         expand, specs, params, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def sharding_tree(specs: dict, mesh: Mesh, params) -> dict:
+    """Expand a PartitionSpec tree into a NamedSharding tree exactly
+    matching `params` structure (QTensor nodes expand field-wise)."""
+    return expand_specs_for_params(
+        specs, params, wrap=lambda spec: NamedSharding(mesh, spec)
     )
 
 

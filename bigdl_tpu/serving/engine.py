@@ -107,12 +107,15 @@ class InferenceEngine:
                              np.float32)
         self._dosample = np.full((n_slots,), g.do_sample, bool)
 
+        # forward_fn: the family forward, or the pipeline step when the
+        # mesh has a pp axis (api.TpuModel.forward_fn)
+        fwd = getattr(model, "forward_fn", None) or model.family.forward
         self._decode = self._with_mesh(jax.jit(
-            functools.partial(self._decode_impl, self.model.family.forward),
+            functools.partial(self._decode_impl, fwd),
             donate_argnames=("cache",),
         ))
         self._prefill = self._with_mesh(jax.jit(
-            functools.partial(self._prefill_impl, self.model.family.forward),
+            functools.partial(self._prefill_impl, fwd),
             static_argnames=("bucket",),
         ))
         self._insert = self._with_mesh(jax.jit(
@@ -143,7 +146,9 @@ class InferenceEngine:
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            kv_sh = NamedSharding(self._mesh, P(None, None, None, "tp", None))
+            # layer axis over pp stages (when present), kv heads over tp
+            pp = "pp" if "pp" in self._mesh.axis_names else None
+            kv_sh = NamedSharding(self._mesh, P(pp, None, None, "tp", None))
             rep = NamedSharding(self._mesh, P())
             cache = dataclasses.replace(
                 cache,

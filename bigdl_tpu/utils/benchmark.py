@@ -58,7 +58,7 @@ class BenchmarkedModel:
         B, T = tokens_np.shape
         cache_len = cache_len_for(T, max_new_tokens)
 
-        fwd = model.family.forward
+        fwd = getattr(model, "forward_fn", None) or model.family.forward
 
         def prefill(params, tokens, cache):
             return fwd(config, params, tokens, cache, mode="prefill")

@@ -1,0 +1,98 @@
+"""Pipeline-parallel decode + serving tests (VERDICT r2 item 6).
+
+The reference serves models bigger than one card via its PP worker
+(transformers/pipeline_parallel.py:300-929 in /root/reference: p2p
+send/recv token loop + serving-grade PPModelWorker). Our counterpart is
+make_pipeline_step: per-stage KV caches, hidden states ppermuted stage
+to stage inside one SPMD program, exposed through TpuModel.forward_fn so
+generate() and the InferenceEngine run unchanged over a (pp, tp) mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.api import TpuModel, optimize_model
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+    max_position_embeddings=128,
+)
+PROMPTS = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8]]
+
+
+def build(pp=1, tp=1):
+    params = optimize_model(
+        llama.init_params(CFG, jax.random.PRNGKey(0)), CFG, "sym_int4"
+    )
+    model = TpuModel(CFG, params, "sym_int4")
+    if pp > 1 or tp > 1:
+        if pp * tp > len(jax.devices()):
+            pytest.skip(f"needs {pp * tp} devices")
+        model = model.to_mesh(pp=pp, tp=tp, dp=1)
+    return model
+
+
+def test_pp_generate_matches_single_device():
+    ref = build().generate(PROMPTS, max_new_tokens=12)
+    out = build(pp=4).generate(PROMPTS, max_new_tokens=12)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_pp_plus_tp_generate_matches_single_device():
+    ref = build().generate(PROMPTS, max_new_tokens=10)
+    out = build(pp=2, tp=2).generate(PROMPTS, max_new_tokens=10)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_pp_layers_divisibility_error():
+    model = TpuModel(CFG, optimize_model(
+        llama.init_params(CFG, jax.random.PRNGKey(0)), CFG, "sym_int4"
+    ), "sym_int4")
+    with pytest.raises(ValueError, match="not divisible by pp"):
+        model.to_mesh(pp=3, tp=1, dp=1)
+
+
+def test_engine_over_pp_tp_mesh():
+    """Continuous-batching engine with the KV pool's layer axis over pp
+    and kv heads over tp — greedy outputs must match the single-device
+    engine token for token."""
+    from bigdl_tpu.serving.engine import InferenceEngine
+
+    def run(model):
+        eng = InferenceEngine(model, n_slots=2, max_len=128)
+        reqs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+        eng.run_until_idle()
+        assert all(r.done for r in reqs)
+        return [r.out_tokens for r in reqs]
+
+    ref = run(build())
+    out = run(build(pp=2, tp=2))
+    assert out == ref
+
+
+def test_engine_pp_mid_flight_admission():
+    """A request admitted while another decodes (slot insert into the
+    pp-sharded pool) still completes correctly."""
+    from bigdl_tpu.serving.engine import InferenceEngine
+
+    model = build(pp=2, tp=2)
+    eng = InferenceEngine(model, n_slots=2, max_len=128)
+    r1 = eng.submit(PROMPTS[0], max_new_tokens=12)
+    for _ in range(4):
+        eng.step()
+    r2 = eng.submit(PROMPTS[1], max_new_tokens=6)
+    eng.run_until_idle()
+    assert r1.done and r2.done
+    assert len(r1.out_tokens) > 0 and len(r2.out_tokens) > 0
+    # same prompts through a fresh single-device engine agree (greedy)
+    ref_eng = InferenceEngine(build(), n_slots=2, max_len=128)
+    ref1 = ref_eng.submit(PROMPTS[0], max_new_tokens=12)
+    ref2 = ref_eng.submit(PROMPTS[1], max_new_tokens=6)
+    ref_eng.run_until_idle()
+    assert r1.out_tokens == ref1.out_tokens
+    assert r2.out_tokens == ref2.out_tokens
