@@ -77,14 +77,19 @@ class TpuModel:
             def pp_forward(config, params, tokens, cache,
                            mode="prefill", last_logits_only=False, **kw):
                 # features beyond the plain prefill/decode step must fail
-                # loudly, not silently drop their kwargs
-                unsupported = {k: v for k, v in kw.items()
-                               if v not in (None, 0, False)}
+                # loudly, not silently drop their kwargs (array-safe:
+                # no truthiness on jax arrays)
+                unsupported = sorted(
+                    k for k, v in kw.items()
+                    if v is not None and (
+                        not isinstance(v, (bool, int, float)) or v
+                    )
+                )
                 if cache is None or unsupported:
                     raise NotImplementedError(
                         "pipeline-parallel forward supports the cached "
                         "prefill/decode step only; got cache=None or "
-                        f"kwargs {sorted(unsupported)} — run this path on "
+                        f"kwargs {unsupported} — run this path on "
                         "a tp/dp mesh (pp=1) instead"
                     )
                 return step(params, tokens, cache, mode=mode,

@@ -1,0 +1,113 @@
+"""Audio frontend: whisper-compatible log-mel spectrograms, pure numpy.
+
+The reference feeds whisper through the HF WhisperFeatureExtractor /
+openai-whisper `log_mel_spectrogram` (dev/benchmark/whisper/ drives it
+via the processor); this is the same pipeline without the torch
+dependency: hann-windowed STFT (n_fft 400, hop 160), slaney-scale mel
+filterbank, log10 with the whisper dynamic-range normalization
+(max - 8, /4 + 1). Verified bit-close against WhisperFeatureExtractor
+in tests/test_audio.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP_LENGTH = 160
+CHUNK_LENGTH = 30  # seconds per whisper window
+N_SAMPLES = CHUNK_LENGTH * SAMPLE_RATE
+
+
+def _hz_to_mel(f):
+    """Slaney mel scale (librosa default, what whisper's filters use):
+    linear below 1 kHz, logarithmic above."""
+    f = np.asarray(f, np.float64)
+    mel = f / (200.0 / 3)
+    log_region = f >= 1000.0
+    mel = np.where(
+        log_region,
+        15.0 + np.log(np.maximum(f, 1e-10) / 1000.0) / (np.log(6.4) / 27.0),
+        mel,
+    )
+    return mel
+
+
+def _mel_to_hz(m):
+    m = np.asarray(m, np.float64)
+    f = m * (200.0 / 3)
+    log_region = m >= 15.0
+    return np.where(log_region, 1000.0 * np.exp((np.log(6.4) / 27.0) * (m - 15.0)), f)
+
+
+def mel_filterbank(n_mels: int = 80, n_fft: int = N_FFT,
+                   sr: int = SAMPLE_RATE) -> np.ndarray:
+    """[n_mels, n_fft//2 + 1] slaney-normalized triangular filters."""
+    fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_pts = np.linspace(_hz_to_mel(0.0), _hz_to_mel(sr / 2.0), n_mels + 2)
+    hz_pts = _mel_to_hz(mel_pts)
+
+    fdiff = np.diff(hz_pts)
+    ramps = hz_pts[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0, np.minimum(lower, upper))
+    # slaney normalization: equal area per filter
+    enorm = 2.0 / (hz_pts[2:n_mels + 2] - hz_pts[:n_mels])
+    return (fb * enorm[:, None]).astype(np.float32)
+
+
+def log_mel_spectrogram(
+    audio: np.ndarray,  # [T] float waveform at 16 kHz
+    n_mels: int = 80,
+    pad_to_chunk: bool = True,
+) -> np.ndarray:
+    """[n_mels, frames] whisper-normalized log-mel features."""
+    audio = np.asarray(audio, np.float32)
+    if pad_to_chunk:
+        audio = audio[:N_SAMPLES]
+        audio = np.pad(audio, (0, max(0, N_SAMPLES - len(audio))))
+    # center-padded (reflect) framing, exactly torch.stft(center=True)
+    audio = np.pad(audio, (N_FFT // 2, N_FFT // 2), mode="reflect")
+    window = np.hanning(N_FFT + 1)[:-1].astype(np.float32)
+    n_frames = 1 + (len(audio) - N_FFT) // HOP_LENGTH
+    idx = (
+        np.arange(N_FFT)[None, :]
+        + HOP_LENGTH * np.arange(n_frames)[:, None]
+    )
+    frames = audio[idx] * window  # [frames, N_FFT]
+    stft = np.fft.rfft(frames, axis=-1)
+    magnitudes = (np.abs(stft) ** 2).astype(np.float32)[:-1]  # drop last frame
+    mel = magnitudes @ mel_filterbank(n_mels).T  # [frames, n_mels]
+    log_spec = np.log10(np.maximum(mel, 1e-10))
+    log_spec = np.maximum(log_spec, log_spec.max() - 8.0)
+    return ((log_spec + 4.0) / 4.0).T.astype(np.float32)  # [n_mels, frames]
+
+
+def read_wav(data: bytes) -> np.ndarray:
+    """Minimal PCM WAV decoder (stdlib only): [T] float32 mono @ 16 kHz.
+    Raises on non-PCM or non-16k files — the server surfaces the message."""
+    import io
+    import wave
+
+    with wave.open(io.BytesIO(data)) as w:
+        rate = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        raw = w.readframes(n)
+        channels = w.getnchannels()
+    if width == 2:
+        x = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 4:
+        x = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if channels > 1:
+        x = x.reshape(-1, channels).mean(axis=1)
+    if rate != SAMPLE_RATE:
+        # naive linear resample (stdlib-only path; a real deployment would
+        # use a proper resampler upstream)
+        t = np.linspace(0, len(x) - 1, int(len(x) * SAMPLE_RATE / rate))
+        x = np.interp(t, np.arange(len(x)), x).astype(np.float32)
+    return x

@@ -234,6 +234,74 @@ def _glm_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     return out
 
 
+def _chatglm_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """THUDM chatglm2/3 + glm-4 layout: fused query_key_value
+    [QD+2*KD, H] (+bias) and swiglu dense_h_to_4h [2I, H] (reference
+    models/chatglm2.py:229 reads the fused qkv; split_mlp in
+    convert.py:1048-1055 splits the MLP the same way)."""
+    p = f"transformer.encoder.layers.{i}."
+    qkv = get(p + "self_attention.query_key_value.weight")
+    QD, KD = config.q_dim, config.kv_dim
+    h4h = get(p + "mlp.dense_h_to_4h.weight")
+    I = h4h.shape[0] // 2
+    out = {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wq": qkv[:QD],
+        "wk": qkv[QD:QD + KD],
+        "wv": qkv[QD + KD:],
+        "wo": get(p + "self_attention.dense.weight"),
+        "w_gate": h4h[:I],  # swiglu: silu(chunk0) * chunk1
+        "w_up": h4h[I:],
+        "w_down": get(p + "mlp.dense_4h_to_h.weight"),
+    }
+    if config.attention_bias:
+        b = get(p + "self_attention.query_key_value.bias")
+        out["bq"], out["bk"], out["bv"] = b[:QD], b[QD:QD + KD], b[QD + KD:]
+    return out
+
+
+def _chatglm_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    out = {
+        "embed": get("transformer.embedding.word_embeddings.weight"),
+        "final_norm": get("transformer.encoder.final_layernorm.weight"),
+    }
+    if not config.tie_word_embeddings:
+        out["lm_head"] = get("transformer.output_layer.weight")
+    return out
+
+
+def _qwen2_vl_get(get: Get):
+    """Qwen2-VL text keys moved across transformers versions:
+    `model.layers.*` (original checkpoints) vs `model.language_model.
+    layers.*` (HF >= 4.52 refactor). Try both."""
+
+    def g(name: str):
+        try:
+            return get(name.replace("model.", "model.language_model.", 1))
+        except KeyError:
+            return get(name)
+
+    return g
+
+
+def _qwen2_vl_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    return _llama_layer(config, i, _qwen2_vl_get(get))
+
+
+def _qwen2_vl_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    g = _qwen2_vl_get(get)
+
+    def top_get(name: str):
+        if name == "model.embed_tokens.weight":
+            return g(name)
+        if name == "model.norm.weight":
+            return g(name)
+        return get(name)  # lm_head.weight stays top-level
+
+    return _llama_top(config, top_get)
+
+
 def _gpt2_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     """GPT-2 stores linears as Conv1D ([in, out] — transposed) with a fused
     c_attn [in, 3H]."""
@@ -416,6 +484,8 @@ _FAMILY_LAYER = {
     "internlm2": _internlm2_layer,
     "starcoder2": _starcoder2_layer,
     "glm": _glm_layer,
+    "chatglm": _chatglm_layer,
+    "qwen2_vl": _qwen2_vl_layer,
     "gpt2": _gpt2_layer,
     "bloom": _bloom_layer,
     "gpt_neox": _gptneox_layer,
@@ -426,6 +496,8 @@ _FAMILY_LAYER = {
 _FAMILY_TOP = {
     "baichuan": _baichuan_top,
     "internlm2": _internlm2_top,
+    "chatglm": _chatglm_top,
+    "qwen2_vl": _qwen2_vl_top,
     "gpt2": _gpt2_top,
     "bloom": _bloom_top,
     "gpt_neox": _gptneox_top,
@@ -578,7 +650,7 @@ def load_hf_checkpoint(
 
 # families whose layer builders slice/merge raw arrays (fused checkpoints) —
 # they must receive fp32, never packed QTensors
-_SPLIT_FAMILIES = {"phi3", "baichuan", "internlm2", "glm"}
+_SPLIT_FAMILIES = {"phi3", "baichuan", "internlm2", "glm", "chatglm"}
 
 
 def _wrap_quantized(get_tensor, quant_config: dict, model_type: str, qtype: str):

@@ -28,8 +28,10 @@ _FAMILIES = {
     "stablelm": llama,
     "minicpm": llama,
     "glm": llama,
-    # chatglm (THUDM trust_remote_code schema) needs its own config/weights
-    # translator before it can be registered — not silently aliased to glm.
+    # THUDM chatglm2/3 + glm-4 remote-code schema: interleaved half-dim
+    # rope + fused checkpoints, translated in config._hf_chatglm and
+    # convert/hf._chatglm_layer
+    "chatglm": llama,
     "gpt2": llama,
     "bloom": llama,
     "gpt_neox": llama,
@@ -37,6 +39,16 @@ _FAMILIES = {
     "qwen2_moe": llama,
     "yi": llama,
 }
+
+from bigdl_tpu.models import qwen2_vl  # noqa: E402  (delegates text to llama)
+
+_FAMILIES["qwen2_vl"] = qwen2_vl
+
+# whisper (models/whisper.py) is an encoder-decoder family with its own
+# WhisperConfig and (params, mel, prompt) call shape — deliberately NOT in
+# _FAMILIES, whose consumers (optimize_model, TpuModel.generate) assume
+# the decoder signature; it is served through the api_server's
+# /v1/audio/transcriptions endpoint (whisper= kwarg) instead
 
 
 def get_family(model_type: str):

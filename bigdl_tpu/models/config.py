@@ -74,6 +74,12 @@ class ModelConfig:
     # or force "dense" / "ragged" (models/llama.py _moe_mlp)
     moe_dispatch: Optional[str] = None
     moe_capacity_factor: float = 1.25  # ragged: slots per expert vs even load
+    # multimodal (qwen2_vl): M-RoPE channel sections for (t, h, w) position
+    # components; standard rope when the three components are equal
+    mrope_section: Optional[tuple] = None
+    image_token_id: Optional[int] = None
+    video_token_id: Optional[int] = None
+    vision_start_token_id: Optional[int] = None
 
     def __post_init__(self):
         if self.moe_dispatch not in (None, "dense", "ragged"):
@@ -130,6 +136,13 @@ class ModelConfig:
         """Build from a HuggingFace config.json dict (the ingest path the
         reference drives through transformers AutoConfig, model.py:111)."""
         model_type = hf.get("model_type", "llama")
+        if isinstance(hf.get("text_config"), dict):
+            # multimodal configs nest the decoder fields (HF >= 4.52
+            # qwen2_vl etc.); original checkpoints keep them at top level
+            # — merge with the nested values winning
+            hf = {**hf, **{k: v for k, v in hf["text_config"].items()
+                           if v is not None}}
+            hf["model_type"] = model_type
         known = {
             "vocab_size", "hidden_size", "intermediate_size",
             "num_hidden_layers", "num_attention_heads", "num_key_value_heads",
@@ -320,8 +333,50 @@ def _hf_qwen2_moe(hf, kw):
     kw["norm_topk_prob"] = hf.get("norm_topk_prob", False)
 
 
+def _hf_chatglm(hf, kw):
+    """THUDM chatglm2/3 and glm-4 trust_remote_code config schema
+    (reference models/chatglm2.py, chatglm4.py: interleaved rope on the
+    first half of kv_channels, MQA via multi_query_group_num, fused
+    query_key_value / dense_h_to_4h checkpoints)."""
+    kw["num_hidden_layers"] = hf.get("num_layers", 28)
+    kw["intermediate_size"] = hf.get("ffn_hidden_size", 13696)
+    kw["vocab_size"] = hf.get("padded_vocab_size", hf.get("vocab_size", 65024))
+    kw["head_dim"] = hf.get("kv_channels")
+    if hf.get("multi_query_attention"):
+        kw["num_key_value_heads"] = hf.get("multi_query_group_num", 2)
+    kw["rms_norm_eps"] = hf.get("layernorm_epsilon", 1e-5)
+    kw["partial_rotary_factor"] = 0.5
+    kw["rope_interleaved"] = True
+    # chatglm2-32k / glm-4 scale the base by rope_ratio
+    # (chatglm2.py:102-109: base = 10000 * rope_ratio)
+    kw["rope_theta"] = 10000.0 * hf.get("rope_ratio", 1.0)
+    kw["attention_bias"] = bool(hf.get("add_qkv_bias", False))
+    kw["max_position_embeddings"] = hf.get("seq_length", 8192)
+    kw["tie_word_embeddings"] = bool(hf.get("tie_word_embeddings", False))
+    if not hf.get("rmsnorm", True):
+        kw["norm_type"] = "layernorm"
+
+
+def _hf_qwen2_vl(hf, kw):
+    """Qwen2-VL text side: qwen2 layout + M-RoPE. The mrope inv_freq is
+    the standard one — only the application is sectioned — so
+    rope_scaling is consumed here, not by make_inv_freq_scaled."""
+    kw.setdefault("attention_bias", True)
+    rs = kw.pop("rope_scaling", None) or {}
+    if isinstance(rs, (list, tuple)):
+        rs = dict(rs)
+    sections = rs.get("mrope_section")
+    if sections:
+        kw["mrope_section"] = tuple(int(s) for s in sections)
+    kw["image_token_id"] = hf.get("image_token_id", 151655)
+    kw["video_token_id"] = hf.get("video_token_id", 151656)
+    kw["vision_start_token_id"] = hf.get("vision_start_token_id", 151652)
+
+
 _HF_BUILDERS = {
     "qwen2": _hf_qwen2,
+    "qwen2_vl": _hf_qwen2_vl,
+    "chatglm": _hf_chatglm,
     "gemma": _hf_gemma,
     "gemma2": _hf_gemma2,
     "phi3": _hf_phi3,

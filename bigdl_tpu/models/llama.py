@@ -371,6 +371,8 @@ def forward(
     input_is_hidden: bool = False,  # static: tokens is [B,T,H] hidden states
     return_hidden: bool = False,  # static: skip final norm/head, return h
     layer_offset=0,  # global index of params['layers'][0] (pipeline stages)
+    position_grid=None,  # [3, B, T] M-RoPE (t, h, w) positions — multimodal
+    # prefill only (models/qwen2_vl.py); None = standard 1-D positions
     last_logits_only: bool = False,  # static: lm head on the last position
     # only — prefill skips the [B,T,V] logits (reference
     # reshape_lm_head_input / IPEX_LLM_LAST_LM_HEAD,
@@ -435,10 +437,18 @@ def forward(
             config.rotary_dim, config.rope_theta, config.rope_scaling_dict,
             seq_len=(cache.max_len if cache is not None else T),
         )
-        cos, sin = rope_cos_sin(
-            positions, inv_freq, interleaved=config.rope_interleaved,
-            scale=att_scale,
-        )
+        if position_grid is not None and config.mrope_section:
+            from bigdl_tpu.ops.rope import mrope_cos_sin
+
+            cos, sin = mrope_cos_sin(
+                position_grid, inv_freq, config.mrope_section,
+                scale=att_scale,
+            )
+        else:
+            cos, sin = rope_cos_sin(
+                positions, inv_freq, interleaved=config.rope_interleaved,
+                scale=att_scale,
+            )
     else:
         cos = sin = None
 

@@ -1,0 +1,275 @@
+"""Qwen2-VL: vision tower + M-RoPE multimodal glue over the qwen2 decoder.
+
+TPU-native counterpart of the reference's qwen2-vl support
+(models/qwen2_vl.py in /root/reference patches
+Qwen2VisionTransformerPretrainedModel and the text forwards; dispatch at
+convert.py:1251-2027). Architecture per the HF implementation:
+
+- vision tower: Conv3d patch embed (expressed as one linear over the
+  flattened [C * t_patch * p * p] patch vector), blocks of
+  LayerNorm -> full attention with 2-D rope -> LayerNorm -> MLP, then a
+  PatchMerger (LayerNorm + 2-layer MLP over spatial_merge^2 grouped
+  patches) projecting into the text hidden size;
+- 2-D vision rope: each patch's (h, w) grid position rotates half the
+  head dim each (VisionRotaryEmbedding(head_dim // 2), rotate_half
+  convention over the duplicated (h, w) angle pairs);
+- text side: the qwen2 decoder with M-RoPE (ops/rope.mrope_cos_sin) —
+  image tokens carry (t, h, w) grid positions, text tokens equal
+  components; decode continues at max(position) + 1 via the cache's
+  rope_base field.
+
+The text weights use the standard qwen2 names, so ingest/quantize/TP all
+reuse the llama-family path; the vision tower stays bf16 (the reference
+likewise only low-bits the language model for multimodal families).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import layer_norm
+
+# the text side delegates wholesale to the llama family
+init_params = llama.init_params
+quantize_params = llama.quantize_params
+forward = llama.forward
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    embed_dim: int = 1280
+    depth: int = 32
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    in_channels: int = 3
+    hidden_size: int = 3584  # output (text hidden) size
+    hidden_act: str = "quick_gelu"
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "VisionConfig":
+        keys = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in hf.items() if k in keys})
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.in_channels * self.temporal_patch_size * self.patch_size ** 2
+
+
+def vision_params_from_state_dict(vcfg: VisionConfig, get) -> dict:
+    """HF `visual.*` checkpoint -> stacked param tree (blocks stacked on a
+    leading depth axis for lax.scan)."""
+    E = vcfg.embed_dim
+
+    def g(name):
+        # `visual.*` (original checkpoints) vs `model.visual.*` (HF >=4.52)
+        try:
+            return np.asarray(get("visual." + name), np.float32)
+        except KeyError:
+            return np.asarray(get("model.visual." + name), np.float32)
+
+    blocks: dict[str, list] = {}
+    names = [
+        ("norm1_w", "norm1.weight"), ("norm1_b", "norm1.bias"),
+        ("norm2_w", "norm2.weight"), ("norm2_b", "norm2.bias"),
+        ("qkv_w", "attn.qkv.weight"), ("qkv_b", "attn.qkv.bias"),
+        ("proj_w", "attn.proj.weight"), ("proj_b", "attn.proj.bias"),
+        ("fc1_w", "mlp.fc1.weight"), ("fc1_b", "mlp.fc1.bias"),
+        ("fc2_w", "mlp.fc2.weight"), ("fc2_b", "mlp.fc2.bias"),
+    ]
+    for i in range(vcfg.depth):
+        for key, suffix in names:
+            blocks.setdefault(key, []).append(g(f"blocks.{i}.{suffix}"))
+    params = {
+        # Conv3d [E, C, t, p, p] with stride == kernel == one linear over
+        # the flattened patch vector
+        "patch_proj": g("patch_embed.proj.weight").reshape(E, -1),
+        "blocks": {k: jnp.asarray(np.stack(v)) for k, v in blocks.items()},
+        "merger_ln_w": g("merger.ln_q.weight"),
+        "merger_ln_b": g("merger.ln_q.bias"),
+        "merger_fc1_w": g("merger.mlp.0.weight"),
+        "merger_fc1_b": g("merger.mlp.0.bias"),
+        "merger_fc2_w": g("merger.mlp.2.weight"),
+        "merger_fc2_b": g("merger.mlp.2.bias"),
+    }
+    return jax.tree.map(jnp.asarray, params)
+
+
+def _vision_rot_pos(vcfg: VisionConfig, grid_thw: np.ndarray) -> np.ndarray:
+    """[N, 2] (h, w) grid position per patch, in the spatial-merge-window
+    traversal order the processor emits (HF rot_pos_emb)."""
+    m = vcfg.spatial_merge_size
+    out = []
+    for t, h, w in np.asarray(grid_thw):
+        hpos = np.broadcast_to(np.arange(h)[:, None], (h, w))
+        wpos = np.broadcast_to(np.arange(w)[None, :], (h, w))
+
+        def windowed(x):
+            return (
+                x.reshape(h // m, m, w // m, m)
+                .transpose(0, 2, 1, 3)
+                .reshape(-1)
+            )
+
+        hw = np.stack([windowed(hpos), windowed(wpos)], axis=-1)
+        out.append(np.tile(hw, (int(t), 1)))
+    return np.concatenate(out, axis=0)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def vision_forward(
+    vcfg: VisionConfig,
+    vparams: dict,
+    patches: jax.Array,  # [N, patch_dim] flattened pixel patches
+    grid_thw: np.ndarray,  # [n_images, 3] static per call
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """[N, patch_dim] -> [N / merge^2, text_hidden] image embeddings."""
+    from bigdl_tpu.ops.rope import apply_rotary_emb
+
+    N = patches.shape[0]
+    E, Hh, D = vcfg.embed_dim, vcfg.num_heads, vcfg.head_dim
+
+    h = jnp.einsum(
+        "nd,ed->ne", patches.astype(jnp.float32), vparams["patch_proj"]
+    )
+
+    # 2-D rope: (h, w) each rotate head_dim/2 lanes (freq dim head_dim/4)
+    pos = _vision_rot_pos(vcfg, grid_thw)  # [N, 2] host-side, static shape
+    dim_q = vcfg.head_dim // 2
+    inv_freq = 1.0 / (10000.0 ** (np.arange(0, dim_q, 2) / dim_q))  # [D/4]
+    freqs = pos[..., None] * inv_freq[None, None, :]  # [N, 2, D/4]
+    half = jnp.asarray(freqs.reshape(N, -1), jnp.float32)  # [N, D/2]
+    emb = jnp.concatenate([half, half], axis=-1)  # [N, D]
+    cos, sin = jnp.cos(emb)[None], jnp.sin(emb)[None]  # [1, N, D]
+
+    # attention within each image: block-diagonal mask from grid sizes
+    sizes = [int(t * hh * ww) for t, hh, ww in np.asarray(grid_thw)]
+    seg = np.repeat(np.arange(len(sizes)), sizes)
+    mask = jnp.asarray(seg[:, None] == seg[None, :])  # [N, N]
+
+    def block(h, p):
+        x = layer_norm(h, p["norm1_w"], p["norm1_b"], 1e-6)
+        qkv = jnp.einsum("ne,fe->nf", x, p["qkv_w"]) + p["qkv_b"]
+        # HF layout: fused rows are [3, heads, D] per token
+        qkv = qkv.reshape(N, 3, Hh, D)
+        q, k, v = (qkv[None, :, 0], qkv[None, :, 1], qkv[None, :, 2])
+        q, k = apply_rotary_emb(q, k, cos, sin)  # [1, N, Hh, D]
+        att = jnp.einsum("bnhd,bmhd->bhnm", q, k) / np.sqrt(D)
+        att = jnp.where(mask[None, None], att, -jnp.inf)
+        att = jax.nn.softmax(att, axis=-1)
+        ctx = jnp.einsum("bhnm,bmhd->bnhd", att, v).reshape(N, E)
+        h = h + jnp.einsum("ne,fe->nf", ctx, p["proj_w"]) + p["proj_b"]
+
+        x = layer_norm(h, p["norm2_w"], p["norm2_b"], 1e-6)
+        x = jnp.einsum("ne,fe->nf", x, p["fc1_w"]) + p["fc1_b"]
+        x = _quick_gelu(x) if vcfg.hidden_act == "quick_gelu" else jax.nn.gelu(x)
+        h = h + jnp.einsum("nf,ef->ne", x, p["fc2_w"]) + p["fc2_b"]
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, vparams["blocks"])
+
+    # PatchMerger: LN then group merge^2 consecutive patches
+    x = layer_norm(h, vparams["merger_ln_w"], vparams["merger_ln_b"], 1e-6)
+    g = vcfg.spatial_merge_size ** 2
+    x = x.reshape(N // g, g * E)
+    x = jnp.einsum("nk,fk->nf", x, vparams["merger_fc1_w"]) + vparams["merger_fc1_b"]
+    x = jax.nn.gelu(x, approximate=False)
+    x = jnp.einsum("nf,of->no", x, vparams["merger_fc2_w"]) + vparams["merger_fc2_b"]
+    return x.astype(out_dtype)
+
+
+def get_rope_index(
+    config: ModelConfig,
+    input_ids: np.ndarray,  # [B, T]
+    image_grid_thw: Optional[np.ndarray],  # [n_images, 3]
+    spatial_merge_size: int = 2,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Port of HF Qwen2VLModel.get_rope_index (images; host-side):
+    text spans get sequential equal (t,h,w); each image span gets a
+    constant t and its (h, w) grid, all offset so positions never
+    collide. Returns (position_grid [3, B, T], next_pos [B])."""
+    B, T = input_ids.shape
+    grid = np.ones((3, B, T), np.int32)
+    next_pos = np.zeros((B,), np.int32)
+    image_index = 0
+    for b in range(B):
+        ids = input_ids[b].tolist()
+        parts = []
+        st = 0
+        while config.image_token_id in ids[st:]:
+            ed = ids.index(config.image_token_id, st)
+            t, h, w = image_grid_thw[image_index]
+            image_index += 1
+            lh, lw = int(h) // spatial_merge_size, int(w) // spatial_merge_size
+            lt = int(t)
+            base = parts[-1].max() + 1 if parts else 0
+            text_len = ed - st
+            parts.append(
+                np.broadcast_to(np.arange(text_len), (3, text_len)) + base
+            )
+            t_idx = np.repeat(np.arange(lt), lh * lw)
+            h_idx = np.tile(np.repeat(np.arange(lh), lw), lt)
+            w_idx = np.tile(np.arange(lw), lt * lh)
+            parts.append(np.stack([t_idx, h_idx, w_idx]) + base + text_len)
+            st = ed + lt * lh * lw
+        if st < len(ids):
+            base = parts[-1].max() + 1 if parts else 0
+            tl = len(ids) - st
+            parts.append(np.broadcast_to(np.arange(tl), (3, tl)) + base)
+        pos = np.concatenate(parts, axis=1)
+        grid[:, b, :] = pos
+        next_pos[b] = pos.max() + 1
+    return grid, next_pos
+
+
+def multimodal_prefill(
+    config: ModelConfig,
+    vcfg: VisionConfig,
+    params: dict,
+    vparams: dict,
+    input_ids: np.ndarray,  # [B, T] with image_token_id placeholders
+    patches: jax.Array,  # [N, patch_dim]
+    grid_thw: np.ndarray,
+    cache,
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = True,
+):
+    """Vision tower -> scatter image embeds over the placeholder tokens ->
+    M-RoPE text prefill. Returns (logits, cache with rope_base set so
+    plain decode steps continue at the right positions)."""
+    img = vision_forward(vcfg, vparams, patches, grid_thw, jnp.float32)
+    h = llama.embed_tokens(config, params, jnp.asarray(input_ids), compute_dtype)
+    mask = jnp.asarray(input_ids == config.image_token_id)
+    idx = jnp.cumsum(mask.reshape(-1)) - 1  # row-major image-embed order
+    gathered = img[jnp.clip(idx, 0, img.shape[0] - 1)].reshape(
+        *input_ids.shape, -1
+    ).astype(compute_dtype)
+    h = jnp.where(mask[..., None], gathered, h)
+
+    pos_grid, next_pos = get_rope_index(
+        config, np.asarray(input_ids), grid_thw, vcfg.spatial_merge_size
+    )
+    logits, cache = llama.forward(
+        config, params, h, cache, mode="prefill", input_is_hidden=True,
+        position_grid=jnp.asarray(pos_grid), compute_dtype=compute_dtype,
+        last_logits_only=last_logits_only,
+    )
+    cache = dataclasses.replace(cache, rope_base=jnp.asarray(next_pos))
+    return logits, cache

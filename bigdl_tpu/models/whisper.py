@@ -29,6 +29,7 @@ position during decode).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import jax
@@ -70,6 +71,18 @@ class WhisperConfig:
 
     @classmethod
     def from_hf_config(cls, hf: dict) -> "WhisperConfig":
+        # all released whisper sizes use symmetric encoder/decoder heads
+        # and ffn; this implementation shares one num_heads/ffn_dim, so
+        # reject (rather than silently mistranslate) asymmetric configs
+        for enc, dec in (
+            ("encoder_attention_heads", "decoder_attention_heads"),
+            ("encoder_ffn_dim", "decoder_ffn_dim"),
+        ):
+            if dec in hf and enc in hf and hf[dec] != hf[enc]:
+                raise NotImplementedError(
+                    f"asymmetric whisper config: {enc}={hf[enc]} vs "
+                    f"{dec}={hf[dec]}"
+                )
         return cls(
             vocab_size=hf["vocab_size"],
             num_mel_bins=hf.get("num_mel_bins", 80),
@@ -86,6 +99,13 @@ class WhisperConfig:
             eos_token_id=hf.get("eos_token_id", 50257),
             pad_token_id=hf.get("pad_token_id", 50257),
         )
+
+
+def default_prompt_ids(config: WhisperConfig) -> list[int]:
+    """Minimal forced decoder prefix: <|startoftranscript|>. Callers with
+    a tokenizer prepend language/task tokens (<|en|><|transcribe|>...)
+    the way the HF processor does."""
+    return [config.decoder_start_token_id]
 
 
 def _act(config: WhisperConfig, x: jax.Array) -> jax.Array:
@@ -400,6 +420,15 @@ def generate(
     then a lax.while_loop emits tokens until EOS or budget (the
     transcription path behind the server's /v1/audio/transcriptions —
     reference serving/fastapi/api_server.py)."""
+    run = _generate_jit(config, max_new_tokens, jnp.dtype(compute_dtype))
+    return run(params, mel, prompt_ids)
+
+
+@functools.lru_cache(maxsize=32)
+def _generate_jit(config: WhisperConfig, max_new_tokens: int, compute_dtype):
+    """Compiled-program cache: generate() is called per HTTP request by
+    the transcription endpoint — a closure-level @jax.jit would retrace
+    and recompile every call."""
 
     @jax.jit
     def run(params, mel, prompt_ids):
@@ -438,4 +467,4 @@ def generate(
         state = (jnp.ones((), jnp.int32), first, cache, done, out)
         return jax.lax.while_loop(cond, step, state)[4]
 
-    return run(params, mel, prompt_ids)
+    return run

@@ -181,6 +181,32 @@ def rope_cos_sin(
     )
 
 
+def mrope_cos_sin(
+    position_grid: jax.Array,  # [3, B, T] int: (t, h, w) components
+    inv_freq: jax.Array,  # [R/2]
+    sections,  # e.g. (16, 24, 24); sum == R/2
+    dtype=jnp.float32,
+    scale: float = 1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Qwen2-VL multimodal rope (M-RoPE): the frequency channels are split
+    into (t, h, w) sections, each rotated by its own position component
+    (HF apply_multimodal_rotary_pos_emb). When all three components are
+    equal this reduces exactly to rope_cos_sin. Half-duplicated (llama)
+    layout."""
+    angles = position_grid.astype(jnp.float32)[..., None] * inv_freq  # [3,B,T,R/2]
+    parts = []
+    off = 0
+    for i, sec in enumerate(sections):
+        parts.append(angles[i, ..., off:off + sec])
+        off += sec
+    half = jnp.concatenate(parts, axis=-1)  # [B, T, R/2]
+    full = jnp.concatenate([half, half], axis=-1)
+    return (
+        (jnp.cos(full) * scale).astype(dtype),
+        (jnp.sin(full) * scale).astype(dtype),
+    )
+
+
 def _rotate_half(x: jax.Array) -> jax.Array:
     half = x.shape[-1] // 2
     return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)

@@ -13,9 +13,13 @@ Endpoints:
     POST /generate_stream            same, server-sent events
     POST /v1/completions             OpenAI completion schema (subset)
     POST /v1/chat/completions        OpenAI chat schema (subset), streaming
+    POST /v1/audio/transcriptions    whisper (pass whisper=(config, params));
+                                     body: raw audio/wav, or JSON
+                                     {"audio": [floats @ 16 kHz]}
 
 Text prompts need a tokenizer (pass tokenizer= or a HF model_path);
-token-id list prompts work without one.
+token-id list prompts work without one. Transcriptions return text when
+a whisper_tokenizer is set, raw token ids otherwise.
 """
 
 from __future__ import annotations
@@ -87,9 +91,13 @@ class ApiServer:
         n_slots: int = 8,
         max_len: int = 1024,
         gen=None,
+        whisper=None,  # (WhisperConfig, params) enables /v1/audio/*
+        whisper_tokenizer=None,
     ):
         self.engine = InferenceEngine(model, n_slots=n_slots, max_len=max_len, gen=gen)
         self.tokenizer = tokenizer
+        self.whisper = whisper
+        self.whisper_tokenizer = whisper_tokenizer
         self.worker = _EngineThread(self.engine)
         outer = self
 
@@ -113,7 +121,16 @@ class ApiServer:
             def do_POST(self):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    raw = self.rfile.read(n)
+                except Exception as e:
+                    return self._json(400, {"error": f"bad request: {e}"})
+                if self.path == "/v1/audio/transcriptions":
+                    try:
+                        return self._transcribe(raw)
+                    except Exception as e:  # noqa: BLE001
+                        return self._json(500, {"error": str(e)})
+                try:
+                    payload = json.loads(raw or b"{}")
                 except Exception as e:
                     return self._json(400, {"error": f"bad json: {e}"})
                 try:
@@ -128,6 +145,47 @@ class ApiServer:
                 except Exception as e:  # noqa: BLE001
                     return self._json(500, {"error": str(e)})
                 return self._json(404, {"error": "not found"})
+
+            def _transcribe(self, raw: bytes):
+                if outer.whisper is None:
+                    return self._json(
+                        400, {"error": "no whisper model loaded "
+                              "(pass whisper=(config, params) to ApiServer)"}
+                    )
+                import numpy as np
+
+                from bigdl_tpu import audio as A
+                from bigdl_tpu.models import whisper as W
+
+                ctype = self.headers.get("Content-Type", "")
+                if ctype.startswith("application/json"):
+                    payload = json.loads(raw or b"{}")
+                    wave = np.asarray(payload.get("audio", []), np.float32)
+                    if wave.size == 0:
+                        return self._json(400, {"error": "empty audio"})
+                else:  # raw WAV body
+                    wave = A.read_wav(raw)
+                wcfg, wparams = outer.whisper
+                mel = A.log_mel_spectrogram(wave, n_mels=wcfg.num_mel_bins)
+                # the conv stack halves the frame count; positions cap it
+                mel = mel[:, : 2 * wcfg.max_source_positions]
+                import jax.numpy as jnp
+
+                prompt = W.default_prompt_ids(wcfg)
+                toks = W.generate(
+                    wcfg, wparams, jnp.asarray(mel[None]),
+                    jnp.asarray([prompt], jnp.int32),
+                    max_new_tokens=int(
+                        self.headers.get("X-Max-New-Tokens", 128)
+                    ),
+                )
+                ids = [int(t) for t in toks[0] if t != wcfg.eos_token_id]
+                if outer.whisper_tokenizer is not None:
+                    text = outer.whisper_tokenizer.decode(
+                        ids, skip_special_tokens=True
+                    )
+                    return self._json(200, {"text": text})
+                return self._json(200, {"tokens": ids})
 
             # ---- endpoint bodies ----
             def _generate(self, payload, stream: bool):

@@ -1,0 +1,130 @@
+"""Audio frontend + transcription endpoint tests (VERDICT r2 weak 7 /
+ADVICE r2: whisper was unreachable through the public API and had no
+log-mel frontend)."""
+
+import io
+import json
+import urllib.error
+import urllib.request
+import wave
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.audio import log_mel_spectrogram, mel_filterbank, read_wav
+
+
+def test_log_mel_matches_hf_feature_extractor():
+    transformers = pytest.importorskip("transformers")
+    fe = transformers.WhisperFeatureExtractor()
+    rng = np.random.default_rng(0)
+    audio = (rng.standard_normal(16000 * 3) * 0.1).astype(np.float32)
+    ref = fe(audio, sampling_rate=16000, return_tensors="np")["input_features"][0]
+    ours = log_mel_spectrogram(audio)
+    assert ours.shape == ref.shape == (80, 3000)
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_mel_filterbank_shape_and_coverage():
+    fb = mel_filterbank(80)
+    assert fb.shape == (80, 201)
+    assert (fb >= 0).all()
+    # every filter has support
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def _wav_bytes(audio: np.ndarray, rate=16000) -> bytes:
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes((audio * 32767).astype(np.int16).tobytes())
+    return buf.getvalue()
+
+
+def test_read_wav_roundtrip():
+    rng = np.random.default_rng(0)
+    audio = np.clip(
+        rng.standard_normal(16000) * 0.3, -0.9, 0.9
+    ).astype(np.float32)
+    back = read_wav(_wav_bytes(audio))
+    assert back.shape == audio.shape
+    np.testing.assert_allclose(back, audio, atol=1e-3)
+
+
+def test_transcription_endpoint():
+    import jax
+
+    from bigdl_tpu.api import TpuModel, optimize_model
+    from bigdl_tpu.models import llama, whisper as W
+    from bigdl_tpu.models.config import PRESETS
+    from bigdl_tpu.serving.api_server import ApiServer
+
+    wcfg = W.WhisperConfig(
+        vocab_size=64, num_mel_bins=80, hidden_size=32, encoder_layers=1,
+        decoder_layers=1, num_heads=2, ffn_dim=64, max_source_positions=64,
+        max_target_positions=32, decoder_start_token_id=1, eos_token_id=2,
+        pad_token_id=0,
+    )
+    wparams = W.init_params(wcfg, jax.random.PRNGKey(0))
+
+    cfg = PRESETS["tiny-llama"]
+    model = TpuModel(cfg, optimize_model(
+        llama.init_params(cfg, jax.random.PRNGKey(1)), cfg
+    ), "sym_int4")
+    server = ApiServer(model, port=0, n_slots=2, max_len=128,
+                       whisper=(wcfg, wparams))
+    server.start()
+    try:
+        port = server.httpd.server_address[1]
+        rng = np.random.default_rng(0)
+        audio = (rng.standard_normal(16000) * 0.1).astype(np.float32)
+
+        # raw WAV body
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/audio/transcriptions",
+            data=_wav_bytes(audio),
+            headers={"Content-Type": "audio/wav", "X-Max-New-Tokens": "4"},
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        assert "tokens" in out and len(out["tokens"]) <= 4
+
+        # JSON float-array body
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/audio/transcriptions",
+            data=json.dumps({"audio": audio[:1600].tolist()}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Max-New-Tokens": "4"},
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        assert "tokens" in out
+    finally:
+        server.shutdown()
+
+
+def test_transcription_endpoint_without_whisper_model():
+    import jax
+
+    from bigdl_tpu.api import TpuModel, optimize_model
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+    from bigdl_tpu.serving.api_server import ApiServer
+
+    cfg = PRESETS["tiny-llama"]
+    model = TpuModel(cfg, optimize_model(
+        llama.init_params(cfg, jax.random.PRNGKey(1)), cfg
+    ), "sym_int4")
+    server = ApiServer(model, port=0, n_slots=2, max_len=128)
+    server.start()
+    try:
+        port = server.httpd.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/audio/transcriptions",
+            data=b"{}", headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=60)
+        assert e.value.code == 400
+    finally:
+        server.shutdown()
