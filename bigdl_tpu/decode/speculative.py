@@ -48,10 +48,18 @@ def _emit(out, choice, n_acc, n_gen, max_k):
     return jax.lax.fori_loop(0, max_k, body, out)
 
 
+# auto_th_stop_draft update constants — the reference's auto_parameters
+# defaults (speculative.py:810: [1, 0.5, 0.9, 1e-2, 0.9]): update every
+# round, matchness EMA 0.5, target matchness 0.9, threshold step 1e-2,
+# threshold EMA 0.9.
+_AUTO_EMA, _AUTO_TARGET, _AUTO_STEP, _AUTO_TH_EMA = 0.5, 0.9, 1e-2, 0.9
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "config", "gen", "model_forward", "cache_len", "draft_k", "quantize_kv"
+        "config", "gen", "model_forward", "cache_len", "draft_k",
+        "quantize_kv", "adaptive", "min_step_draft",
     ),
 )
 def speculative_tokens(
@@ -66,9 +74,22 @@ def speculative_tokens(
     cache_len: int,
     draft_k: int = 4,
     quantize_kv: bool = False,
-) -> tuple[jax.Array, jax.Array]:
-    """Returns (out [1, max_new_tokens], n_rounds) — n_rounds counts
-    verify forwards, for the acceptance-rate diagnostic."""
+    adaptive: bool = True,
+    th_stop_draft: float = 0.8,
+    min_step_draft: int = 3,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (out [1, max_new_tokens], n_rounds, n_drafted, n_matched).
+
+    adaptive=True is the reference's th_stop_draft mechanism
+    (speculative.py:827-1269): drafting early-stops once the draft's
+    confidence (its greedy token probability) drops below a threshold —
+    a dynamic-trip-count while_loop, so unproductive draft forwards are
+    genuinely skipped — and the threshold itself tracks an EMA of the
+    acceptance rate: low matchness raises it (stop drafting sooner),
+    saturated drafting lowers it. The threshold rides the decode loop as
+    a traced scalar; verify stays a static-K forward with acceptance
+    capped at the drafted count.
+    """
     B, T = tokens.shape
     assert B == 1, "speculative decoding is batch-1 (same as the reference)"
     K = draft_k
@@ -97,28 +118,44 @@ def speculative_tokens(
     done = cur == eos if eos is not None else jnp.zeros((B,), jnp.bool_)
 
     def cond(state):
-        n_gen, _, _, _, done, _, _, _ = state
-        return (n_gen < max_new) & ~jnp.all(done)
+        return (state["n_gen"] < max_new) & ~jnp.all(state["done"])
 
     def round_fn(state):
-        n_gen, cur, tcache, dcache, done, out, key, n_rounds = state
+        n_gen, cur, key = state["n_gen"], state["cur"], state["key"]
+        tcache, dcache = state["tcache"], state["dcache"]
+        th, out = state["th"], state["out"]
 
-        # --- draft K tokens greedily (writes K KV entries: cur, d0..d_{K-2})
-        def draft_step(i, carry):
-            tok, dcache, drafts = carry
+        # --- draft up to K tokens greedily, early-stopping on confidence
+        # (writes n_draft KV entries: cur, d0..d_{n_draft-2})
+        def draft_cond(carry):
+            i, _, _, _, go = carry
+            return (i < K) & go
+
+        def draft_step(carry):
+            i, tok, dcache, drafts, _ = carry
             logits, dcache = model_forward(
                 config, draft_params, tok[:, None], dcache, mode="decode"
             )
+            probs = jax.nn.softmax(logits[:, -1].astype(jnp.float32), axis=-1)
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            conf = jnp.max(probs, axis=-1)[0]
             drafts = jax.lax.dynamic_update_slice(drafts, nxt[:, None], (0, i))
-            return (nxt, dcache, drafts)
+            # reference early-stop (speculative.py:1049): confidence below
+            # threshold after min_step_draft drafts ends the phase
+            # (adaptive is a static python bool — no bitwise ~ on it)
+            go = jnp.asarray(not adaptive) | (conf >= th) | (i + 1 < min_step_draft)
+            return (i + 1, nxt, dcache, drafts, go)
 
         drafts0 = jnp.zeros((B, K), jnp.int32)
-        _, dcache, drafts = jax.lax.fori_loop(
-            0, K, draft_step, (cur, dcache, drafts0)
+        n_draft, _, dcache, drafts, _ = jax.lax.while_loop(
+            draft_cond, draft_step,
+            (jnp.zeros((), jnp.int32), cur, dcache, drafts0,
+             jnp.ones((), jnp.bool_)),
         )
 
-        # --- verify: one target forward over [cur, d0..d_{K-2}]  (T = K)
+        # --- verify: one target forward over [cur, d0..d_{K-2}]  (T = K;
+        # static shape — positions past n_draft carry stale drafts that the
+        # acceptance cap below excludes)
         verify_in = jnp.concatenate([cur[:, None], drafts[:, : K - 1]], axis=1)
         tlogits, tcache = model_forward(
             config, target_params, verify_in, tcache, mode="prefill"
@@ -129,31 +166,69 @@ def speculative_tokens(
             [sample_token(tlogits[:, i], keys[i], gen) for i in range(K)], axis=1
         )  # [1, K] target's token for each position
 
-        # --- longest matching prefix, capped at K-1 (cache discipline)
-        match = drafts[:, : K - 1] == choice[:, : K - 1]  # [1, K-1]
+        # --- longest matching prefix, capped at K-1 AND n_draft-1: the
+        # draft cache only holds KV for cur, d0..d_{n_draft-2}, so
+        # accepting d_{n_draft-1} would advance past a never-written slot
+        # and corrupt every later draft prediction
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, K - 1), 1)
+        match = (drafts[:, : K - 1] == choice[:, : K - 1]) & (idx < n_draft - 1)
         n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)[0]
 
         out = _emit(out, choice, n_acc, n_gen, K)
         cur = jax.lax.dynamic_slice(choice, (0, n_acc), (1, 1))[:, 0]
 
-        # crop both caches to the accepted length
+        # crop both caches to the accepted length (true pos = old + n_acc+1;
+        # the draft cache advanced n_draft, the target K)
         new_pos = tcache.pos - K + n_acc + 1
         tcache = dataclasses.replace(tcache, pos=new_pos)
         dcache = dataclasses.replace(dcache, pos=new_pos)
 
+        # --- adaptive threshold (reference speculative.py:1225-1236).
+        # Matchness normalizes by the ACCEPTABLE drafts (n_draft - 1, our
+        # static-cache cap) rather than the raw draft count — otherwise a
+        # perfect draft tops out at (K-1)/K < target and the threshold
+        # ratchets upward forever, degrading drafting to min_step_draft.
+        matchness = (
+            _AUTO_EMA * state["matchness"]
+            + (1 - _AUTO_EMA) * n_acc.astype(jnp.float32)
+            / jnp.maximum(n_draft.astype(jnp.float32) - 1.0, 1.0)
+        )
+        new_th = jnp.where(
+            matchness < _AUTO_TARGET,
+            th + _AUTO_STEP,  # low acceptance: stop drafting sooner
+            jnp.where(n_draft == K, th, th - _AUTO_STEP),
+        )
+        new_th = jnp.clip(new_th, 0.05, 0.99)
+        th = jnp.where(
+            adaptive, _AUTO_TH_EMA * th + (1 - _AUTO_TH_EMA) * new_th, th
+        )
+
+        done = state["done"]
         if eos is not None:
             emitted = jax.lax.dynamic_slice(out, (0, n_gen), (1, K))
-            idx = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
-            hit = (emitted == eos) & (idx <= n_acc)
-            done = done | jnp.any(hit, axis=1)
-        return (n_gen + n_acc + 1, cur, tcache, dcache, done, out, key, n_rounds + 1)
+            kidx = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
+            done = done | jnp.any((emitted == eos) & (kidx <= n_acc), axis=1)
+        return {
+            "n_gen": n_gen + n_acc + 1, "cur": cur, "tcache": tcache,
+            "dcache": dcache, "done": done, "out": out, "key": key,
+            "n_rounds": state["n_rounds"] + 1,
+            "n_drafted": state["n_drafted"] + n_draft,
+            "n_matched": state["n_matched"] + n_acc,
+            "th": th, "matchness": matchness,
+        }
 
-    state = (
-        jnp.ones((), jnp.int32), cur, tcache, dcache, done, out, key,
-        jnp.zeros((), jnp.int32),
-    )
-    n_gen, _, _, _, _, out, _, n_rounds = jax.lax.while_loop(cond, round_fn, state)
-    return out[:, :max_new], n_rounds
+    state = {
+        "n_gen": jnp.ones((), jnp.int32), "cur": cur, "tcache": tcache,
+        "dcache": dcache, "done": done, "out": out, "key": key,
+        "n_rounds": jnp.zeros((), jnp.int32),
+        "n_drafted": jnp.zeros((), jnp.int32),
+        "n_matched": jnp.zeros((), jnp.int32),
+        "th": jnp.asarray(th_stop_draft, jnp.float32),
+        "matchness": jnp.zeros((), jnp.float32),
+    }
+    state = jax.lax.while_loop(cond, round_fn, state)
+    return (state["out"][:, :max_new], state["n_rounds"],
+            state["n_drafted"], state["n_matched"])
 
 
 def mask_after_eos(out: np.ndarray, eos: int | None, pad: int) -> np.ndarray:
@@ -185,8 +260,12 @@ def speculative_generate(
     pad_token_id: int = 0,
     seed: int = 0,
     quantize_kv: bool = False,
+    adaptive: bool = True,
+    th_stop_draft: float = 0.8,
+    min_step_draft: int = 3,
 ) -> np.ndarray:
-    """Host entry point mirroring `speculative_generate` (speculative.py:803)."""
+    """Host entry point mirroring `speculative_generate` (speculative.py:803);
+    adaptive/th_stop_draft/min_step_draft mirror its th_stop_draft knobs."""
     from bigdl_tpu.generate import pad_prompts
 
     tokens, start = pad_prompts(prompts, pad_token_id)
@@ -198,10 +277,11 @@ def speculative_generate(
     from bigdl_tpu.utils import cache_len_for
 
     cache_len = cache_len_for(tokens.shape[1], max_new_tokens + draft_k + 1)
-    out, _ = speculative_tokens(
+    out, _, _, _ = speculative_tokens(
         config, target_params, draft_params,
         jnp.asarray(tokens), jnp.asarray(start), jax.random.PRNGKey(seed),
         gen, model_forward, cache_len=cache_len, draft_k=draft_k,
-        quantize_kv=quantize_kv,
+        quantize_kv=quantize_kv, adaptive=adaptive,
+        th_stop_draft=th_stop_draft, min_step_draft=min_step_draft,
     )
     return mask_after_eos(np.asarray(out), eos_token_id, pad_token_id)

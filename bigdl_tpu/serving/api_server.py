@@ -98,6 +98,10 @@ class ApiServer:
         self.tokenizer = tokenizer
         self.whisper = whisper
         self.whisper_tokenizer = whisper_tokenizer
+        # serializes whisper device work: handler threads must not race
+        # each other (or pile unbounded compute onto the chip) the way
+        # the engine thread already serializes text decode
+        self._whisper_lock = threading.Lock()
         self.worker = _EngineThread(self.engine)
         outer = self
 
@@ -166,20 +170,40 @@ class ApiServer:
                 else:  # raw WAV body
                     wave = A.read_wav(raw)
                 wcfg, wparams = outer.whisper
-                mel = A.log_mel_spectrogram(wave, n_mels=wcfg.num_mel_bins)
-                # the conv stack halves the frame count; positions cap it
-                mel = mel[:, : 2 * wcfg.max_source_positions]
+                try:
+                    max_new = int(self.headers.get("X-Max-New-Tokens", 128))
+                except ValueError as e:
+                    return self._json(400, {"error": f"bad X-Max-New-Tokens: {e}"})
+                # clamp + bucket to multiples of 32: max_new_tokens is a
+                # compile-time constant (whisper._generate_jit) — raw
+                # client values would compile a fresh program each
+                cap = max(1, wcfg.max_target_positions - 8)
+                max_new = min(max(max_new, 1), cap)
+                max_new = min(-(-max_new // 32) * 32, cap)
+
                 import jax.numpy as jnp
 
                 prompt = W.default_prompt_ids(wcfg)
-                toks = W.generate(
-                    wcfg, wparams, jnp.asarray(mel[None]),
-                    jnp.asarray([prompt], jnp.int32),
-                    max_new_tokens=int(
-                        self.headers.get("X-Max-New-Tokens", 128)
-                    ),
-                )
-                ids = [int(t) for t in toks[0] if t != wcfg.eos_token_id]
+                ids: list[int] = []
+                frames_per_chunk = 2 * wcfg.max_source_positions
+                with outer._whisper_lock:
+                    # 30-second windows over the full clip (the reference
+                    # serving path chunks long audio the same way) —
+                    # truncating would silently drop the tail
+                    for off in range(0, max(len(wave), 1), A.N_SAMPLES):
+                        chunk = wave[off:off + A.N_SAMPLES]
+                        mel = A.log_mel_spectrogram(
+                            chunk, n_mels=wcfg.num_mel_bins
+                        )[:, :frames_per_chunk]
+                        toks = W.generate(
+                            wcfg, wparams, jnp.asarray(mel[None]),
+                            jnp.asarray([prompt], jnp.int32),
+                            max_new_tokens=max_new,
+                        )
+                        ids.extend(
+                            int(t) for t in toks[0]
+                            if t not in (wcfg.eos_token_id, wcfg.pad_token_id)
+                        )
                 if outer.whisper_tokenizer is not None:
                     text = outer.whisper_tokenizer.decode(
                         ids, skip_special_tokens=True

@@ -25,14 +25,15 @@ def tiny_model():
     return TpuModel(config=config, params=params, qtype="bf16")
 
 
-def test_speculative_greedy_matches_plain(tiny_model):
+@pytest.mark.parametrize("adaptive", [False, True])
+def test_speculative_greedy_matches_plain(tiny_model, adaptive):
     m = tiny_model
     prompts = [[5, 6, 7, 8, 9, 10, 11]]
     plain = m.generate(prompts, max_new_tokens=24)
     draft = optimize_model(m.params, m.config, "sym_int4")
     spec = speculative_generate(
         m.config, m.params, draft, prompts, llama.forward,
-        max_new_tokens=24, draft_k=4,
+        max_new_tokens=24, draft_k=4, adaptive=adaptive,
     )
     np.testing.assert_array_equal(plain, spec)
 
@@ -59,12 +60,43 @@ def test_speculative_accepts_with_perfect_draft(tiny_model):
     m = tiny_model
     tokens, start = pad_prompts([[5, 6, 7, 8, 9, 10, 11]], 0)
     gen = GenerationConfig(max_new_tokens=24)
-    out, n_rounds = speculative_tokens(
+    out, n_rounds, _, _ = speculative_tokens(
         m.config, m.params, m.params, jnp.asarray(tokens), jnp.asarray(start),
         jax.random.PRNGKey(0), gen, llama.forward, cache_len=128, draft_k=4,
+        adaptive=False,
     )
     # perfect draft: every round emits draft_k tokens (K-1 accepted + bonus)
     assert int(n_rounds) <= (24 + 3) // 4 + 1
+
+
+def test_adaptive_drafting_saves_draft_forwards(tiny_model):
+    """On a low-acceptance stream (garbage draft) the th_stop_draft
+    early-stop must cut drafted tokens versus fixed-K drafting, while
+    keeping output identical (reference speculative.py:827-1269)."""
+    from bigdl_tpu.decode.speculative import speculative_tokens
+    from bigdl_tpu.generate import GenerationConfig, pad_prompts
+
+    m = tiny_model
+    garbage = llama.init_params(m.config, jax.random.PRNGKey(99))
+    tokens, start = pad_prompts([[3, 1, 4, 1, 5, 9, 2, 6]], 0)
+    gen = GenerationConfig(max_new_tokens=20)
+
+    def run(adaptive):
+        return speculative_tokens(
+            m.config, m.params, garbage, jnp.asarray(tokens),
+            jnp.asarray(start), jax.random.PRNGKey(0), gen, llama.forward,
+            cache_len=128, draft_k=6, adaptive=adaptive, min_step_draft=1,
+            th_stop_draft=0.95,
+        )
+
+    out_f, rounds_f, drafted_f, matched_f = run(False)
+    out_a, rounds_a, drafted_a, matched_a = run(True)
+    np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_a))
+    # fixed mode drafts K per round; adaptive must draft fewer per round
+    assert float(drafted_f) / float(rounds_f) == 6.0
+    assert float(drafted_a) / float(rounds_a) < 6.0, (
+        int(drafted_a), int(rounds_a)
+    )
 
 
 def test_lookup_greedy_matches_plain(tiny_model):
