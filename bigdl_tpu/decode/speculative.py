@@ -188,10 +188,15 @@ def speculative_tokens(
         # static-cache cap) rather than the raw draft count — otherwise a
         # perfect draft tops out at (K-1)/K < target and the threshold
         # ratchets upward forever, degrading drafting to min_step_draft.
-        matchness = (
+        # n_draft <= 1 carries no acceptance signal (zero acceptable
+        # drafts): skip the EMA update or the threshold ratchets to its
+        # cap and permanently collapses drafting
+        matchness = jnp.where(
+            n_draft > 1,
             _AUTO_EMA * state["matchness"]
             + (1 - _AUTO_EMA) * n_acc.astype(jnp.float32)
-            / jnp.maximum(n_draft.astype(jnp.float32) - 1.0, 1.0)
+            / jnp.maximum(n_draft.astype(jnp.float32) - 1.0, 1.0),
+            state["matchness"],
         )
         new_th = jnp.where(
             matchness < _AUTO_TARGET,

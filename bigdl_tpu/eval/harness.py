@@ -1,0 +1,176 @@
+"""lm-eval-harness adapter.
+
+Counterpart of the reference's harness integration
+(dev/benchmark/harness/ipexllm.py:38 in /root/reference, which subclasses
+AutoCausalLM so `lm_eval --model ipexllm` scores quantized models). Here
+the adapter implements the lm-eval 0.4 `LM` interface over a TpuModel:
+
+    from bigdl_tpu.eval.harness import BigdlTpuLM
+    lm = BigdlTpuLM(model, tokenizer)
+    results = lm_eval.simple_evaluate(model=lm, tasks=["hellaswag"])
+
+The scoring core (`score_continuations`) is plain JAX and testable
+without lm-eval installed; the class registers itself with the harness
+("bigdl-tpu") only when lm_eval is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # lm-eval is optional (pyproject [eval] extra)
+    from lm_eval.api.model import LM as _LMBase
+    from lm_eval.api.registry import register_model as _register_model
+
+    HAVE_LM_EVAL = True
+except Exception:  # pragma: no cover - environment without lm-eval
+    _LMBase = object
+    _register_model = None
+    HAVE_LM_EVAL = False
+
+
+def score_continuations(
+    model,
+    pairs: Sequence[tuple[Sequence[int], Sequence[int]]],
+    max_length: int = 2048,
+    batch_size: int = 8,
+) -> list[tuple[float, bool]]:
+    """[(context_ids, continuation_ids)] -> [(sum logprob, is_greedy)].
+
+    Cache-free scoring forward per bucketed batch (the same path QLoRA
+    differentiates through); contexts longer than max_length - len(cont)
+    are left-truncated, matching the harness convention.
+    """
+    from bigdl_tpu.generate import pad_prompts
+
+    fwd = model.family.forward
+    config = model.config
+    results: list[Optional[tuple[float, bool]]] = [None] * len(pairs)
+
+    order = sorted(
+        range(len(pairs)),
+        key=lambda i: len(pairs[i][0]) + len(pairs[i][1]),
+        reverse=True,
+    )
+    for i0 in range(0, len(order), batch_size):
+        chunk = order[i0:i0 + batch_size]
+        seqs, cont_lens = [], []
+        for i in chunk:
+            ctx = list(pairs[i][0]) or [0]
+            cont = list(pairs[i][1])
+            keep = max_length - len(cont)
+            seqs.append(ctx[-keep:] + cont)
+            cont_lens.append(len(cont))
+        tokens, start = pad_prompts(seqs, 0)
+        B, T = tokens.shape
+        logits, _ = fwd(
+            config, model.params, jnp.asarray(tokens), None,
+            mode="prefill", start=jnp.asarray(start),
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = np.asarray(logp)
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))
+        for b, i in enumerate(chunk):
+            n = cont_lens[b]
+            # positions T-n .. T-1 hold the continuation; its token at
+            # position p is predicted by logits at p-1
+            tgt = tokens[b, T - n:]
+            pred_rows = logp[b, T - n - 1:T - 1]
+            ll = float(pred_rows[np.arange(n), tgt].sum())
+            is_greedy = bool((greedy[b, T - n - 1:T - 1] == tgt).all())
+            results[i] = (ll, is_greedy)
+    return results  # type: ignore[return-value]
+
+
+class BigdlTpuLM(_LMBase):
+    """lm-eval 0.4 `LM` over a TpuModel + HF tokenizer."""
+
+    def __init__(self, model, tokenizer, batch_size: int = 8,
+                 max_length: int = 2048, max_gen_toks: int = 256):
+        if HAVE_LM_EVAL:
+            super().__init__()
+        self.model = model
+        self.tokenizer = tokenizer
+        self.batch_size_ = int(batch_size)
+        self.max_length = int(max_length)
+        self.max_gen_toks = int(max_gen_toks)
+
+    # -- helpers -----------------------------------------------------------
+    def _encode(self, s: str) -> list[int]:
+        return self.tokenizer.encode(s, add_special_tokens=False)
+
+    @staticmethod
+    def _args(req):
+        """lm-eval Instance (.args) or a plain tuple/str (tests)."""
+        if hasattr(req, "args"):
+            return req.args
+        return req if isinstance(req, tuple) else (req,)
+
+    def _pairs(self, requests):
+        out = []
+        for req in requests:
+            ctx, cont = self._args(req)
+            ctx_ids = self._encode(ctx) if ctx else []
+            cont_ids = self._encode(cont)
+            out.append((ctx_ids, cont_ids))
+        return out
+
+    # -- LM interface ------------------------------------------------------
+    def loglikelihood(self, requests) -> list[tuple[float, bool]]:
+        return score_continuations(
+            self.model, self._pairs(requests),
+            max_length=self.max_length, batch_size=self.batch_size_,
+        )
+
+    def loglikelihood_rolling(self, requests) -> list[float]:
+        pairs = []
+        for req in requests:
+            (text,) = self._args(req)
+            ids = self._encode(text)[: self.max_length]
+            pairs.append(([ids[0]], ids[1:]))  # condition on the first token
+        return [ll for ll, _ in score_continuations(
+            self.model, pairs, max_length=self.max_length,
+            batch_size=self.batch_size_,
+        )]
+
+    def generate_until(self, requests) -> list[str]:
+        outs = []
+        for req in requests:
+            ctx, kw = self._args(req)
+            until = (kw or {}).get("until", [])
+            max_new = int((kw or {}).get("max_gen_toks", self.max_gen_toks))
+            ids = self._encode(ctx)[-self.max_length + max_new:]
+            toks = self.model.generate([ids], max_new_tokens=max_new)[0]
+            text = self.tokenizer.decode(
+                [int(t) for t in toks], skip_special_tokens=True
+            )
+            for stop in until:
+                if stop in text:
+                    text = text.split(stop)[0]
+                    break
+            outs.append(text)
+        return outs
+
+
+if _register_model is not None:  # pragma: no cover - needs lm-eval
+    @_register_model("bigdl-tpu")
+    class _RegisteredBigdlTpuLM(BigdlTpuLM):
+        """CLI spelling: lm_eval --model bigdl-tpu
+        --model_args pretrained=<path>,load_in_low_bit=sym_int4"""
+
+        def __init__(self, pretrained: str, load_in_low_bit: str = "sym_int4",
+                     batch_size: int = 8, max_length: int = 2048, **kw):
+            from transformers import AutoTokenizer
+
+            from bigdl_tpu.api import AutoModelForCausalLM
+
+            model = AutoModelForCausalLM.from_pretrained(
+                pretrained, load_in_low_bit=load_in_low_bit
+            )
+            tok = AutoTokenizer.from_pretrained(pretrained)
+            super().__init__(model, tok, batch_size=batch_size,
+                             max_length=max_length)

@@ -169,17 +169,20 @@ class ApiServer:
                         return self._json(400, {"error": "empty audio"})
                 else:  # raw WAV body
                     wave = A.read_wav(raw)
+                if wave.size == 0:
+                    return self._json(400, {"error": "empty audio"})
                 wcfg, wparams = outer.whisper
                 try:
-                    max_new = int(self.headers.get("X-Max-New-Tokens", 128))
+                    requested = int(self.headers.get("X-Max-New-Tokens", 128))
                 except ValueError as e:
                     return self._json(400, {"error": f"bad X-Max-New-Tokens: {e}"})
                 # clamp + bucket to multiples of 32: max_new_tokens is a
                 # compile-time constant (whisper._generate_jit) — raw
-                # client values would compile a fresh program each
+                # client values would compile a fresh program each. The
+                # response is still sliced back to the requested count.
                 cap = max(1, wcfg.max_target_positions - 8)
-                max_new = min(max(max_new, 1), cap)
-                max_new = min(-(-max_new // 32) * 32, cap)
+                requested = min(max(requested, 1), cap)
+                max_new = min(-(-requested // 32) * 32, cap)
 
                 import jax.numpy as jnp
 
@@ -190,7 +193,7 @@ class ApiServer:
                     # 30-second windows over the full clip (the reference
                     # serving path chunks long audio the same way) —
                     # truncating would silently drop the tail
-                    for off in range(0, max(len(wave), 1), A.N_SAMPLES):
+                    for off in range(0, len(wave), A.N_SAMPLES):
                         chunk = wave[off:off + A.N_SAMPLES]
                         mel = A.log_mel_spectrogram(
                             chunk, n_mels=wcfg.num_mel_bins
@@ -200,10 +203,11 @@ class ApiServer:
                             jnp.asarray([prompt], jnp.int32),
                             max_new_tokens=max_new,
                         )
-                        ids.extend(
+                        chunk_ids = [
                             int(t) for t in toks[0]
                             if t not in (wcfg.eos_token_id, wcfg.pad_token_id)
-                        )
+                        ]
+                        ids.extend(chunk_ids[:requested])
                 if outer.whisper_tokenizer is not None:
                     text = outer.whisper_tokenizer.decode(
                         ids, skip_special_tokens=True

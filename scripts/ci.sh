@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI entry: the counterpart of the reference's per-PR test workflows
+# (.github/workflows/llm_tests_for_stable_version_on_arc.yml runs the
+# unit suites on self-hosted hardware; here everything runs on a virtual
+# 8-device CPU mesh, so any machine can gate a change).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+
+echo "== unit + distributed tests (8-device CPU mesh)"
+python -m pytest tests/ -x -q
+
+echo "== driver contract: single-chip entry + multi-chip dryrun"
+python -c "
+import jax; jax.config.update('jax_platforms','cpu')
+import __graft_entry__ as g
+fn, a = g.entry(); jax.jit(fn)(*a)
+g.dryrun_multichip(8)"
+
+echo "== packaging smoke"
+python -c "import bigdl_tpu; print('bigdl_tpu', bigdl_tpu.__version__)"
+python -m bigdl_tpu.cli --help > /dev/null
+
+echo "CI OK"

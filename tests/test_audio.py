@@ -88,8 +88,9 @@ def test_transcription_endpoint():
             headers={"Content-Type": "audio/wav", "X-Max-New-Tokens": "4"},
         )
         out = json.loads(urllib.request.urlopen(req, timeout=300).read())
-        # max-new-tokens buckets up to a multiple of 32 (compile reuse)
-        assert "tokens" in out and len(out["tokens"]) <= 32
+        # compile buckets to multiples of 32 internally, but the response
+        # honors the requested cap
+        assert "tokens" in out and len(out["tokens"]) <= 4
 
         # JSON float-array body
         req = urllib.request.Request(
