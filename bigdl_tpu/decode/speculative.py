@@ -205,7 +205,8 @@ def speculative_tokens(
         )
         new_th = jnp.clip(new_th, 0.05, 0.99)
         th = jnp.where(
-            adaptive, _AUTO_TH_EMA * th + (1 - _AUTO_TH_EMA) * new_th, th
+            adaptive & (n_draft > 1),  # no-signal rounds must not ratchet
+            _AUTO_TH_EMA * th + (1 - _AUTO_TH_EMA) * new_th, th,
         )
 
         done = state["done"]

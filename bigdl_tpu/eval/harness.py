@@ -61,8 +61,11 @@ def score_continuations(
         seqs, cont_lens = [], []
         for i in chunk:
             ctx = list(pairs[i][0]) or [0]
-            cont = list(pairs[i][1])
-            keep = max_length - len(cont)
+            # over-long continuations keep their tail-most max_length-1
+            # tokens (one context token must remain as the predictor);
+            # note lst[-0:] is the WHOLE list, so keep must stay >= 1
+            cont = list(pairs[i][1])[-(max_length - 1):]
+            keep = max(max_length - len(cont), 1)
             seqs.append(ctx[-keep:] + cont)
             cont_lens.append(len(cont))
         tokens, start = pad_prompts(seqs, 0)
@@ -127,15 +130,20 @@ class BigdlTpuLM(_LMBase):
         )
 
     def loglikelihood_rolling(self, requests) -> list[float]:
-        pairs = []
-        for req in requests:
+        pairs, slots = [], []
+        out = [0.0] * len(requests)  # empty documents score 0, not crash
+        for pos, req in enumerate(requests):
             (text,) = self._args(req)
             ids = self._encode(text)[: self.max_length]
-            pairs.append(([ids[0]], ids[1:]))  # condition on the first token
-        return [ll for ll, _ in score_continuations(
+            if len(ids) >= 2:
+                pairs.append(([ids[0]], ids[1:]))  # condition on token 0
+                slots.append(pos)
+        for pos, (ll, _) in zip(slots, score_continuations(
             self.model, pairs, max_length=self.max_length,
             batch_size=self.batch_size_,
-        )]
+        )):
+            out[pos] = ll
+        return out
 
     def generate_until(self, requests) -> list[str]:
         outs = []

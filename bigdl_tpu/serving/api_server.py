@@ -39,11 +39,20 @@ def _sampling_kwargs(payload: dict) -> dict:
     """OpenAI-ish request fields → per-request engine sampling kwargs.
     temperature<=0 means greedy (the OpenAI convention); presence of a
     positive temperature / top_p<1 / top_k>0 implies sampling unless
-    do_sample is given explicitly."""
+    do_sample is given explicitly. do_sample:true with temperature<=0 is
+    contradictory and rejected (it would silently sample at the engine
+    default temperature)."""
+    from bigdl_tpu.utils.errors import invalid_input_error
+
     kw: dict = {}
     if "temperature" in payload:
         t = float(payload["temperature"])
         if t <= 0:
+            invalid_input_error(
+                not payload.get("do_sample"),
+                "do_sample=true with temperature<=0 is contradictory; "
+                "drop do_sample for greedy or set temperature>0",
+            )
             kw["do_sample"] = False
         else:
             kw.update(do_sample=True, temperature=t)
@@ -56,6 +65,8 @@ def _sampling_kwargs(payload: dict) -> dict:
         if kw["top_k"] > 0:
             kw.setdefault("do_sample", True)
     if "do_sample" in payload:
+        # explicit value wins over implied sampling (the t<=0 contradiction
+        # was already rejected above)
         kw["do_sample"] = bool(payload["do_sample"])
     if "eos_token_id" in payload:
         kw["eos_token_id"] = int(payload["eos_token_id"])
@@ -94,10 +105,13 @@ class ApiServer:
         whisper=None,  # (WhisperConfig, params) enables /v1/audio/*
         whisper_tokenizer=None,
     ):
+        from bigdl_tpu.serving.metrics import Metrics
+
         self.engine = InferenceEngine(model, n_slots=n_slots, max_len=max_len, gen=gen)
         self.tokenizer = tokenizer
         self.whisper = whisper
         self.whisper_tokenizer = whisper_tokenizer
+        self.metrics = Metrics(self.engine)
         # serializes whisper device work: handler threads must not race
         # each other (or pile unbounded compute onto the chip) the way
         # the engine thread already serializes text decode
@@ -109,7 +123,7 @@ class ApiServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _json(self, code: int, obj: Any):
+            def _json_raw(self, code: int, obj: Any):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -117,37 +131,68 @@ class ApiServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _json(self, code: int, obj: Any):  # annotated for metrics
+                self._status = code
+                return self._json_raw(code, obj)
+
             def do_GET(self):
                 if self.path == "/health":
                     return self._json(200, {"status": "ok"})
+                if self.path == "/metrics":
+                    body = outer.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
                 return self._json(404, {"error": "not found"})
 
+            _KNOWN_POSTS = {
+                "/generate", "/generate_stream", "/v1/completions",
+                "/v1/chat/completions", "/v1/audio/transcriptions",
+            }
+
             def do_POST(self):
+                from bigdl_tpu.utils.errors import (
+                    InvalidInputError, request_timer,
+                )
+
+                self._status = 200
+                # unknown paths share one metrics label — raw paths would
+                # let a scanner grow the registry without bound
+                label = self.path if self.path in self._KNOWN_POSTS else "other"
+                with request_timer(outer.metrics, label) as timer:
+                    try:
+                        self._route_post()
+                    except InvalidInputError as e:
+                        self._json(400, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001
+                        self._json(500, {"error": str(e)})
+                    timer.status = self._status
+
+            def _route_post(self):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     raw = self.rfile.read(n)
                 except Exception as e:
                     return self._json(400, {"error": f"bad request: {e}"})
                 if self.path == "/v1/audio/transcriptions":
-                    try:
-                        return self._transcribe(raw)
-                    except Exception as e:  # noqa: BLE001
-                        return self._json(500, {"error": str(e)})
+                    return self._transcribe(raw)
                 try:
                     payload = json.loads(raw or b"{}")
                 except Exception as e:
                     return self._json(400, {"error": f"bad json: {e}"})
-                try:
-                    if self.path == "/generate":
-                        return self._generate(payload, stream=False)
-                    if self.path == "/generate_stream":
-                        return self._generate(payload, stream=True)
-                    if self.path == "/v1/completions":
-                        return self._completions(payload)
-                    if self.path == "/v1/chat/completions":
-                        return self._chat(payload)
-                except Exception as e:  # noqa: BLE001
-                    return self._json(500, {"error": str(e)})
+                if self.path == "/generate":
+                    return self._generate(payload, stream=False)
+                if self.path == "/generate_stream":
+                    return self._generate(payload, stream=True)
+                if self.path == "/v1/completions":
+                    return self._completions(payload)
+                if self.path == "/v1/chat/completions":
+                    return self._chat(payload)
                 return self._json(404, {"error": "not found"})
 
             def _transcribe(self, raw: bytes):
@@ -207,7 +252,7 @@ class ApiServer:
                             int(t) for t in toks[0]
                             if t not in (wcfg.eos_token_id, wcfg.pad_token_id)
                         ]
-                        ids.extend(chunk_ids[:requested])
+                        ids.extend(chunk_ids[:max(0, requested - len(ids))])
                 if outer.whisper_tokenizer is not None:
                     text = outer.whisper_tokenizer.decode(
                         ids, skip_special_tokens=True
@@ -371,12 +416,15 @@ class ApiServer:
                 return
             if tok is None:
                 return
+            self.metrics.count_tokens(1)
             yield tok
 
     def _wait(self, req, timeout: float = 300.0):
         t0 = time.time()
         while not req.done and time.time() - t0 < timeout:
             time.sleep(0.005)
+        if req.done and not req.error:
+            self.metrics.count_tokens(len(req.out_tokens))
 
     # ---- lifecycle ---------------------------------------------------------
 

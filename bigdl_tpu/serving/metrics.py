@@ -1,0 +1,104 @@
+"""Serving metrics: counters / histogram / gauges with a Prometheus
+text-format endpoint.
+
+The reference exposes Prometheus through its vLLM fork
+(vllm/xpu/entrypoints/openai/api_server.py, PROMETHEUS_MULTIPROC_DIR in
+/root/reference); this is the stdlib-only equivalent for our engine —
+the /metrics endpoint renders the standard exposition format, so a
+Prometheus scraper pointed at the server just works.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+# request latency histogram bucket upper bounds (seconds)
+_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Metrics:
+    def __init__(self, engine=None):
+        self._lock = threading.Lock()
+        self.engine = engine
+        self.requests = defaultdict(int)  # (endpoint, status) -> count
+        self.tokens_generated = 0
+        self.requests_failed = 0
+        self.hist_counts = defaultdict(lambda: [0] * (len(_BUCKETS) + 1))
+        self.hist_sum = defaultdict(float)
+
+    # -- recording ----------------------------------------------------------
+    def observe_request(self, endpoint: str, status: int, seconds: float):
+        with self._lock:
+            self.requests[(endpoint, status)] += 1
+            if status >= 500:
+                self.requests_failed += 1
+            counts = self.hist_counts[endpoint]
+            for i, ub in enumerate(_BUCKETS):
+                if seconds <= ub:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self.hist_sum[endpoint] += seconds
+
+    def count_tokens(self, n: int):
+        with self._lock:
+            self.tokens_generated += n
+
+    # -- exposition ---------------------------------------------------------
+    def render(self) -> str:
+        lines = [
+            "# HELP bigdl_tpu_requests_total HTTP requests by endpoint/status",
+            "# TYPE bigdl_tpu_requests_total counter",
+        ]
+        with self._lock:
+            for (ep, status), n in sorted(self.requests.items()):
+                lines.append(
+                    f'bigdl_tpu_requests_total{{endpoint="{ep}",'
+                    f'status="{status}"}} {n}'
+                )
+            lines += [
+                "# HELP bigdl_tpu_tokens_generated_total tokens emitted",
+                "# TYPE bigdl_tpu_tokens_generated_total counter",
+                f"bigdl_tpu_tokens_generated_total {self.tokens_generated}",
+                "# HELP bigdl_tpu_requests_failed_total 5xx responses",
+                "# TYPE bigdl_tpu_requests_failed_total counter",
+                f"bigdl_tpu_requests_failed_total {self.requests_failed}",
+                "# HELP bigdl_tpu_request_seconds request latency",
+                "# TYPE bigdl_tpu_request_seconds histogram",
+            ]
+            for ep, counts in sorted(self.hist_counts.items()):
+                cum = 0
+                for i, ub in enumerate(_BUCKETS):
+                    cum += counts[i]
+                    lines.append(
+                        f'bigdl_tpu_request_seconds_bucket{{endpoint="{ep}",'
+                        f'le="{ub}"}} {cum}'
+                    )
+                cum += counts[-1]
+                lines.append(
+                    f'bigdl_tpu_request_seconds_bucket{{endpoint="{ep}",'
+                    f'le="+Inf"}} {cum}'
+                )
+                lines.append(
+                    f'bigdl_tpu_request_seconds_sum{{endpoint="{ep}"}} '
+                    f"{self.hist_sum[ep]:.6f}"
+                )
+                lines.append(
+                    f'bigdl_tpu_request_seconds_count{{endpoint="{ep}"}} {cum}'
+                )
+        if self.engine is not None:
+            busy = int(self.engine.active.sum())
+            lines += [
+                "# HELP bigdl_tpu_busy_slots decode slots in use",
+                "# TYPE bigdl_tpu_busy_slots gauge",
+                f"bigdl_tpu_busy_slots {busy}",
+                "# HELP bigdl_tpu_total_slots decode slot pool size",
+                "# TYPE bigdl_tpu_total_slots gauge",
+                f"bigdl_tpu_total_slots {self.engine.n_slots}",
+                "# HELP bigdl_tpu_queue_depth requests waiting for a slot",
+                "# TYPE bigdl_tpu_queue_depth gauge",
+                f"bigdl_tpu_queue_depth {self.engine._queue.qsize()}",
+            ]
+        return "\n".join(lines) + "\n"

@@ -24,14 +24,18 @@ def get_logger(name: str = "bigdl_tpu") -> logging.Logger:
     return logger
 
 
+class InvalidInputError(ValueError):
+    """Caller mistakes (bad request shapes, contradictory options) — the
+    serving layer maps this to HTTP 400."""
+
+
 def invalid_input_error(condition: Any, msg: str, fix: Optional[str] = None) -> None:
-    """Raise ValueError with an actionable message unless `condition`
-    (reference invalidInputError: logs then raises RuntimeError; here the
-    exception type matches the error class)."""
+    """Raise InvalidInputError with an actionable message unless
+    `condition` (reference invalidInputError: logs then raises)."""
     if not condition:
         full = msg if fix is None else f"{msg}. {fix}"
         get_logger().error(full)
-        raise ValueError(full)
+        raise InvalidInputError(full)
 
 
 def invalid_operation_error(condition: Any, msg: str) -> None:

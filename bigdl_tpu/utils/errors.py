@@ -1,0 +1,58 @@
+"""Error contract + structured logging.
+
+Counterpart of the reference's `invalidInputError` helper
+(utils/common/log4Error.py in /root/reference): user-facing entry points
+raise a typed, logged error instead of letting raw assertion tracebacks
+surface through the HTTP layer, and log lines are structured (single-line
+key=value) so serving logs stay grep/ingest-friendly.
+
+The error class and assert-style guard live in utils/common.py (the
+original home); this module adds the structured-event and request-timing
+pieces and re-exports the contract for one import site.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from bigdl_tpu.utils.common import (  # noqa: F401  (re-exports)
+    InvalidInputError,
+    get_logger,
+    invalid_input_error,
+)
+
+
+def log_event(event: str, **fields: Any) -> None:
+    """One structured line: `event key=value ...` at INFO."""
+    parts = [event]
+    for k, v in fields.items():
+        if isinstance(v, float):
+            v = f"{v:.4f}"
+        parts.append(f"{k}={v}")
+    get_logger().info(" ".join(parts))
+
+
+class request_timer:
+    """Context manager stamping wall-clock duration into log_event +
+    a metrics histogram."""
+
+    def __init__(self, metrics, endpoint: str):
+        self.metrics = metrics
+        self.endpoint = endpoint
+        self.status = 200
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self.t0
+        status = 500 if exc_type is not None else self.status
+        if self.metrics is not None:
+            self.metrics.observe_request(self.endpoint, status, dt)
+        log_event(
+            "http_request", endpoint=self.endpoint, status=status,
+            seconds=dt,
+        )
+        return False
