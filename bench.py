@@ -99,7 +99,9 @@ def _host_params(config, qtype: str = "sym_int4"):
     from bigdl_tpu.models import llama
 
     shape_tree = jax.eval_shape(
-        lambda k: llama.quantize_params(llama.init_params(config, k), qtype),
+        lambda k: llama.merge_fused_params(
+            llama.quantize_params(llama.init_params(config, k), qtype), config
+        ),
         jax.ShapeDtypeStruct((2,), jnp.uint32),
     )
     rng = np.random.default_rng(0)

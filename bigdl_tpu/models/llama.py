@@ -132,6 +132,7 @@ def init_params(
 
 _QUANT_TARGETS = (
     "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    "wqkv", "w_gateup",  # fused layout (merge_fused_params)
     "w_gate_e", "w_up_e", "w_down_e", "w_gate_s", "w_up_s", "w_down_s",
 )
 
@@ -163,6 +164,112 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
         lm_spec = resolve_qtype(lm_head_qtype) if lm_head_qtype else spec
         if not lm_spec.is_dense:
             out["lm_head"] = quantize(params["lm_head"], lm_spec.name)
+    return out
+
+
+def _concat_weights(ws, axis=-2):
+    """Concatenate dense arrays or QTensors along the output axis.
+    Returns None when the formats can't merge losslessly (mixed qtypes,
+    ggml super-block storage whose O axis isn't -2)."""
+    if all(isinstance(w, jax.Array) for w in ws):
+        return jnp.concatenate(ws, axis=axis)
+    if not all(isinstance(w, QTensor) for w in ws):
+        return None
+    q0 = ws[0]
+    if any(w.qtype != q0.qtype for w in ws):
+        return None
+    spec = q0.spec
+    if spec.storage not in ("packed_u8", "int8", "fp8_e4m3", "fp8_e5m2"):
+        return None  # k-quant blocks keep an extra trailing axis
+    return QTensor(
+        data=jnp.concatenate([w.data for w in ws], axis=axis),
+        scales=jnp.concatenate([w.scales for w in ws], axis=axis),
+        mins=(
+            jnp.concatenate([w.mins for w in ws], axis=axis)
+            if q0.mins is not None else None
+        ),
+        qtype=q0.qtype,
+    )
+
+
+def unmerge_fused_params(params: Params, config: ModelConfig) -> Params:
+    """Inverse of merge_fused_params: split fused weights back into their
+    parts (row slices — lossless). Used before tensor-parallel sharding:
+    a column-parallel fused weight would put the q/k/v split boundaries
+    off shard boundaries for GQA models, forcing GSPMD resharding
+    collectives on every layer."""
+    layers = params.get("layers", {})
+    if "wqkv" not in layers and "w_gateup" not in layers:
+        return params
+    out = dict(params)
+    lay = dict(layers)
+
+    def rows(w, a, b):
+        if isinstance(w, QTensor):
+            return QTensor(
+                data=w.data[..., a:b, :], scales=w.scales[..., a:b, :],
+                mins=None if w.mins is None else w.mins[..., a:b, :],
+                qtype=w.qtype,
+            )
+        return w[..., a:b, :]
+
+    if "wqkv" in lay:
+        QD, KD = config.q_dim, config.kv_dim
+        w = lay.pop("wqkv")
+        lay["wq"] = rows(w, 0, QD)
+        lay["wk"] = rows(w, QD, QD + KD)
+        lay["wv"] = rows(w, QD + KD, QD + 2 * KD)
+        if "bqkv" in lay:
+            b = lay.pop("bqkv")
+            lay["bq"], lay["bk"], lay["bv"] = (
+                b[..., :QD], b[..., QD:QD + KD], b[..., QD + KD:]
+            )
+    if "w_gateup" in lay:
+        w = lay.pop("w_gateup")
+        I = (w.shape[-2] if not isinstance(w, QTensor)
+             else w.data.shape[-2]) // 2
+        lay["w_gate"] = rows(w, 0, I)
+        lay["w_up"] = rows(w, I, 2 * I)
+        if "b_gateup" in lay:
+            b = lay.pop("b_gateup")
+            lay["b_gate"], lay["b_up"] = b[..., :I], b[..., I:]
+    out["layers"] = lay
+    return out
+
+
+def merge_fused_params(params: Params, config: ModelConfig) -> Params:
+    """Fuse qkv and gate/up into single linears (the reference's
+    merge_qkv / mlp fusion, models/common.py:22-53 + _optimize_pre
+    convert.py:886): one kernel call streams one larger weight — fewer
+    per-call fixed costs on the decode hot path. The forward splits the
+    fused output, so results are bit-identical to the unmerged layout.
+    Falls back silently (returns the tree unchanged) for formats that
+    can't concatenate losslessly."""
+    layers = params.get("layers", {})
+    if "wqkv" in layers or "wq" not in layers:
+        return params
+    out = dict(params)
+    lay = dict(layers)
+
+    wqkv = _concat_weights([lay["wq"], lay["wk"], lay["wv"]])
+    if wqkv is not None:
+        lay["wqkv"] = wqkv
+        for k in ("wq", "wk", "wv"):
+            del lay[k]
+        if "bq" in lay:
+            lay["bqkv"] = jnp.concatenate(
+                [lay.pop("bq"), lay.pop("bk"), lay.pop("bv")], axis=-1
+            )
+    if config.gated_mlp and not config.is_moe and "w_gate" in lay:
+        gu = _concat_weights([lay["w_gate"], lay["w_up"]])
+        if gu is not None:
+            lay["w_gateup"] = gu
+            del lay["w_gate"], lay["w_up"]
+            if "b_gate" in lay:
+                lay["b_gateup"] = jnp.concatenate(
+                    [lay.pop("b_gate"), lay.pop("b_up")], axis=-1
+                )
+    out["layers"] = lay
     return out
 
 
@@ -527,9 +634,25 @@ def forward(
         p, lp = xs if lora is not None else (xs, None)
 
         x = norm(hidden, p["attn_norm"], p.get("attn_norm_b"))
-        q = proj(x, p, lp, "wq", "bq").reshape(B, T, Hq, D)
-        k = proj(x, p, lp, "wk", "bk").reshape(B, T, Hkv, D)
-        v = proj(x, p, lp, "wv", "bv").reshape(B, T, Hkv, D)
+        if "wqkv" in p:  # merged layout (merge_fused_params)
+            QD, KD = Hq * D, Hkv * D
+            qkv = linear(x, p["wqkv"], p.get("bqkv"), compute_dtype)
+            q, k, v = (qkv[..., :QD], qkv[..., QD:QD + KD],
+                       qkv[..., QD + KD:])
+            if lp is not None:  # lora stays keyed by the unmerged names
+                if "wq" in lp:
+                    q = q + _lora_delta(x, lp["wq"], lora_scale, compute_dtype)
+                if "wk" in lp:
+                    k = k + _lora_delta(x, lp["wk"], lora_scale, compute_dtype)
+                if "wv" in lp:
+                    v = v + _lora_delta(x, lp["wv"], lora_scale, compute_dtype)
+            q = q.reshape(B, T, Hq, D)
+            k = k.reshape(B, T, Hkv, D)
+            v = v.reshape(B, T, Hkv, D)
+        else:
+            q = proj(x, p, lp, "wq", "bq").reshape(B, T, Hq, D)
+            k = proj(x, p, lp, "wk", "bk").reshape(B, T, Hkv, D)
+            v = proj(x, p, lp, "wv", "bv").reshape(B, T, Hkv, D)
         if config.qk_norm:
             q = rms_norm(q, p["q_norm"], eps, offset=config.rms_norm_offset)
             k = rms_norm(k, p["k_norm"], eps, offset=config.rms_norm_offset)
@@ -577,6 +700,18 @@ def forward(
         x = mlp_in
         if config.is_moe:
             down = _moe_mlp(config, x, p, compute_dtype)
+        elif "w_gateup" in p:  # merged layout (merge_fused_params)
+            gu = linear(x, p["w_gateup"], p.get("b_gateup"), compute_dtype)
+            I2 = gu.shape[-1] // 2
+            gate, up = gu[..., :I2], gu[..., I2:]
+            if lp is not None:
+                if "w_gate" in lp:
+                    gate = gate + _lora_delta(x, lp["w_gate"], lora_scale,
+                                              compute_dtype)
+                if "w_up" in lp:
+                    up = up + _lora_delta(x, lp["w_up"], lora_scale,
+                                          compute_dtype)
+            down = proj(_act(config.hidden_act, gate) * up, p, lp, "w_down", "b_down")
         elif config.gated_mlp:
             gate = proj(x, p, lp, "w_gate", "b_gate")
             up = proj(x, p, lp, "w_up", "b_up")

@@ -40,6 +40,8 @@ from bigdl_tpu.ops import layer_norm
 init_params = llama.init_params
 quantize_params = llama.quantize_params
 forward = llama.forward
+merge_fused_params = llama.merge_fused_params
+unmerge_fused_params = llama.unmerge_fused_params
 
 
 @dataclasses.dataclass(frozen=True)

@@ -37,6 +37,12 @@ def layer_specs(config: ModelConfig) -> dict:
         "wq": _COL,
         "wk": _COL,
         "wv": _COL,
+        # merged layout (models/llama.merge_fused_params): still
+        # column-parallel — GSPMD reshards the post-split slices as needed
+        "wqkv": _COL,
+        "bqkv": P(None, "tp"),
+        "w_gateup": _COL,
+        "b_gateup": P(None, "tp"),
         "wo": _ROW,
     }
     if config.is_moe:
@@ -110,9 +116,19 @@ def lora_specs(config: ModelConfig, targets: tuple[str, ...]) -> dict:
 def expand_specs_for_params(specs, params, wrap=lambda spec: spec):
     """Match a per-leaf spec tree against `params`' exact structure:
     QTensor pytree nodes expand field-wise (data/scales share the spec,
-    mins only when present). `wrap` maps each spec to its final leaf
-    (e.g. NamedSharding). The ONE place this QTensor trick lives — used
-    by sharding_tree and both pipeline spec builders."""
+    mins only when present), and spec dicts are pruned to the keys the
+    params actually carry (layer_specs lists both the split and merged
+    qkv/gate-up layouts; a tree holds one or the other). `wrap` maps each
+    spec to its final leaf (e.g. NamedSharding). The ONE place this
+    QTensor trick lives — used by sharding_tree and both pipeline spec
+    builders."""
+
+    def prune(s, p):
+        if isinstance(s, dict) and isinstance(p, dict):
+            return {k: prune(s[k], p[k]) for k in p.keys()}
+        return s
+
+    specs = prune(specs, params)
 
     def expand(spec, param):
         if isinstance(param, QTensor):

@@ -62,27 +62,74 @@ def init_lora(
     return {"layers": layers, "scale": jnp.asarray(alpha / rank, dtype)}
 
 
+# lora target -> (merged base name, row-slice index) for the fused layout
+# (models/llama.merge_fused_params)
+_MERGED_HOME = {
+    "wq": ("wqkv", 0), "wk": ("wqkv", 1), "wv": ("wqkv", 2),
+    "w_gate": ("w_gateup", 0), "w_up": ("w_gateup", 1),
+}
+
+
 def merge_lora(params: dict, lora: dict, requantize: Optional[str] = None) -> dict:
     """Fold adapters into the base (ReLoRA's merge step, relora.py:64-150).
 
     Dense bases merge exactly; quantized bases are dequantized, merged,
     and re-quantized to `requantize` (defaults to their own qtype).
+    Handles both the split layout and the fused one (merge_fused_params):
+    deltas land in each target's row slice of the fused base, located
+    from the lora pairs' own output widths, and every base is requantized
+    at most once (deltas into the same fused weight are accumulated
+    first, so quantization noise doesn't compound per target).
     """
     from bigdl_tpu.quant import QTensor, quantize
 
     out_layers = dict(params["layers"])
     scale = jnp.asarray(lora["scale"], jnp.float32)
+
+    # row offsets inside fused bases come from each target's own lora B
+    # width ([L, out, r]) — no config needed
+    widths = {t: p["b"].shape[-2] for t, p in lora["layers"].items()}
+
+    def row_start(target: str) -> int:
+        name, idx = _MERGED_HOME[target]
+        if name == "wqkv":
+            qd = widths.get("wq", 0)
+            kd = widths.get("wk", widths.get("wv", 0))
+            return [0, qd, qd + kd][idx]
+        return [0, widths.get("w_gate", widths.get("w_up", 0))][idx]
+
+    # base name -> list of (row_offset|None, delta)
+    pending: dict[str, list] = {}
     for t, pair in lora["layers"].items():
-        base = params["layers"][t]
         delta = (
             jnp.einsum("lor,lri->loi", pair["b"].astype(jnp.float32),
                        pair["a"].astype(jnp.float32)) * scale
         )
-        if isinstance(base, QTensor):
-            dense = base.dequantize(jnp.float32) + delta
-            out_layers[t] = quantize(dense, requantize or base.qtype)
+        if t in params["layers"]:
+            pending.setdefault(t, []).append((None, delta))
+        elif t in _MERGED_HOME and _MERGED_HOME[t][0] in params["layers"]:
+            pending.setdefault(_MERGED_HOME[t][0], []).append(
+                (row_start(t), delta)
+            )
         else:
-            out_layers[t] = (base.astype(jnp.float32) + delta).astype(base.dtype)
+            raise KeyError(
+                f"lora target {t!r} not found in params (neither split nor "
+                f"fused layout)"
+            )
+
+    for name, deltas in pending.items():
+        base = params["layers"][name]
+        quantized = isinstance(base, QTensor)
+        dense = base.dequantize(jnp.float32) if quantized else base.astype(jnp.float32)
+        for off, delta in deltas:
+            if off is None:
+                dense = dense + delta
+            else:
+                dense = dense.at[..., off:off + delta.shape[-2], :].add(delta)
+        out_layers[name] = (
+            quantize(dense, requantize or base.qtype) if quantized
+            else dense.astype(base.dtype)
+        )
     out = dict(params)
     out["layers"] = out_layers
     return out

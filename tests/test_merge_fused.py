@@ -1,0 +1,161 @@
+"""Merged qkv / gate-up layout tests (the reference's merge_qkv,
+models/common.py:22-53 + _optimize_pre convert.py:886 in
+/root/reference): fusing is a lossless concat, so every output must be
+bit-identical to the split layout."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.api import TpuModel, optimize_model
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig, PRESETS
+
+CFG = PRESETS["tiny-llama"]
+PROMPTS = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8]]
+
+
+def split_and_merged(cfg=CFG, qtype="sym_int4", seed=0):
+    dense = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    split = optimize_model(dense, cfg, qtype, merge_fused=False)
+    merged = optimize_model(dense, cfg, qtype, merge_fused=True)
+    return split, merged
+
+
+def test_merged_layout_keys():
+    split, merged = split_and_merged()
+    assert "wq" in split["layers"] and "w_gate" in split["layers"]
+    lay = merged["layers"]
+    assert "wqkv" in lay and "w_gateup" in lay
+    assert "wq" not in lay and "w_gate" not in lay
+    # merged output dim = sum of parts
+    assert lay["wqkv"].shape[-2] == CFG.q_dim + 2 * CFG.kv_dim
+
+
+@pytest.mark.parametrize("qtype", ["sym_int4", "nf4", "bf16"])
+def test_merged_generate_bit_identical(qtype):
+    split, merged = split_and_merged(qtype=qtype)
+    a = TpuModel(CFG, split, qtype).generate(PROMPTS, max_new_tokens=12)
+    b = TpuModel(CFG, merged, qtype).generate(PROMPTS, max_new_tokens=12)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_merged_with_attention_bias():
+    cfg = ModelConfig(
+        model_type="qwen2", vocab_size=128, hidden_size=64,
+        intermediate_size=96, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, attention_bias=True,
+        max_position_embeddings=64,
+    )
+    dense = llama.init_params(cfg, jax.random.PRNGKey(1))
+    a = TpuModel(cfg, optimize_model(dense, cfg, merge_fused=False),
+                 "sym_int4").generate(PROMPTS, max_new_tokens=8)
+    b = TpuModel(cfg, optimize_model(dense, cfg, merge_fused=True),
+                 "sym_int4").generate(PROMPTS, max_new_tokens=8)
+    np.testing.assert_array_equal(a, b)
+    m = optimize_model(dense, cfg, merge_fused=True)
+    assert "bqkv" in m["layers"] and "bq" not in m["layers"]
+
+
+def test_kquant_formats_stay_split():
+    """ggml super-block storage can't concat on the O axis — merging must
+    be a silent no-op, not a crash. (Needs dims >= 256 so q4_k actually
+    applies instead of falling back to sym_int4.)"""
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=256, intermediate_size=256,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        head_dim=128, max_position_embeddings=64,
+    )
+    dense = llama.init_params(cfg, jax.random.PRNGKey(0))
+    split = optimize_model(dense, cfg, "q4_k", merge_fused=False)
+    merged = optimize_model(dense, cfg, "q4_k", merge_fused=True)
+    assert split["layers"]["wq"].qtype == "q4_k"
+    assert "wq" in merged["layers"] and "wqkv" not in merged["layers"]
+    a = TpuModel(cfg, split, "q4_k").generate(PROMPTS, max_new_tokens=8)
+    b = TpuModel(cfg, merged, "q4_k").generate(PROMPTS, max_new_tokens=8)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_merged_under_tp_mesh():
+    """to_mesh(tp>1) splits fused weights back (shard-boundary alignment)
+    and the outputs stay identical."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    _, merged = split_and_merged()
+    ref = TpuModel(CFG, merged, "sym_int4").generate(PROMPTS, max_new_tokens=8)
+    m = TpuModel(CFG, merged, "sym_int4").to_mesh(tp=2, dp=1)
+    assert "wq" in m.params["layers"] and "wqkv" not in m.params["layers"]
+    out = m.generate(PROMPTS, max_new_tokens=8)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_unmerge_roundtrip_lossless():
+    split, merged = split_and_merged()
+    back = llama.unmerge_fused_params(merged, CFG)
+    for k in ("wq", "wk", "wv", "w_gate", "w_up"):
+        np.testing.assert_array_equal(
+            np.asarray(back["layers"][k].data),
+            np.asarray(split["layers"][k].data),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(back["layers"][k].scales),
+            np.asarray(split["layers"][k].scales),
+        )
+
+
+def test_merge_lora_into_fused_base():
+    """ReLoRA's merge step on a fused tree: deltas land in the right row
+    slices, outputs match merging into the split tree."""
+    from bigdl_tpu.train import init_lora
+    from bigdl_tpu.train.qlora import merge_lora
+
+    split, merged = split_and_merged(qtype="nf4")
+    lora = init_lora(CFG, jax.random.PRNGKey(3), rank=4)
+    # give B real values so deltas are nonzero
+    lora["layers"] = jax.tree.map(
+        lambda a: jax.random.normal(jax.random.PRNGKey(4), a.shape) * 0.02,
+        lora["layers"],
+    )
+    a = merge_lora(split, lora)
+    b = merge_lora(merged, lora)
+    out_a = TpuModel(CFG, a, "nf4").generate(PROMPTS, max_new_tokens=8)
+    out_b = TpuModel(CFG, b, "nf4").generate(PROMPTS, max_new_tokens=8)
+    np.testing.assert_array_equal(out_a, out_b)
+
+
+def test_fused_dense_weights_still_quantize():
+    """optimize_model('sym_int4') on an already-fused bf16 tree must
+    quantize the fused leaves (the speculative self-draft path)."""
+    from bigdl_tpu.quant import QTensor
+
+    dense = llama.init_params(CFG, jax.random.PRNGKey(5))
+    fused_bf16 = optimize_model(dense, CFG, "bf16", merge_fused=True)
+    draft = optimize_model(fused_bf16, CFG, "sym_int4", merge_fused=True)
+    assert isinstance(draft["layers"]["wqkv"], QTensor)
+    assert isinstance(draft["layers"]["w_gateup"], QTensor)
+
+
+def test_merged_qlora_train_step():
+    """LoRA stays keyed by the unmerged names; the merged forward adds
+    deltas after the split, so training still updates."""
+    import optax
+
+    from bigdl_tpu.train import init_lora, make_train_step
+
+    _, merged = split_and_merged(qtype="nf4")
+    lora = init_lora(CFG, jax.random.PRNGKey(2), rank=4)
+    opt = optax.adamw(1e-3)
+    state = opt.init(lora["layers"])
+    step = jax.jit(make_train_step(CFG, llama.forward, opt))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, CFG.vocab_size, (2, 17)), jnp.int32
+    )
+    mask = jnp.ones((2, 17), jnp.float32)
+    lora2, state2, loss = step(merged, lora, state, tokens, mask)
+    assert np.isfinite(float(loss))
+    # lora actually received gradients (b starts at zero, so only b moves
+    # on the first step — a's gradient is b-gated)
+    b0 = np.asarray(lora["layers"]["wq"]["b"])
+    b1 = np.asarray(lora2["layers"]["wq"]["b"])
+    assert np.allclose(b0, 0.0) and not np.allclose(b1, 0.0)
