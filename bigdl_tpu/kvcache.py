@@ -132,7 +132,12 @@ def update_layer(
     Does NOT advance pos (the model advances it once per forward, after the
     layer scan). jit-safe with traced `layer` and `cache.pos`. Scalar pos
     writes one contiguous slice; per-row pos scatters row by row.
+    Dispatches to the paged pool for PagedKVCache (bigdl_tpu/kvpaged.py).
     """
+    from bigdl_tpu import kvpaged
+
+    if isinstance(cache, kvpaged.PagedKVCache):
+        return kvpaged.update_layer(cache, layer, k_new, v_new)
     per_row = cache.pos.ndim == 1
     if cache.quantized:
         kq, ks = _quantize_heads(k_new)
@@ -171,6 +176,10 @@ def read_layer(
     cache: KVCache, layer: jax.Array, dtype=jnp.bfloat16
 ) -> tuple[jax.Array, jax.Array]:
     """Full [B,S,Hkv,D] k/v for one layer, dequantized to `dtype`."""
+    from bigdl_tpu import kvpaged
+
+    if isinstance(cache, kvpaged.PagedKVCache):
+        return kvpaged.read_layer(cache, layer, dtype)
     k = jax.lax.dynamic_index_in_dim(cache.k, layer, axis=0, keepdims=False)
     v = jax.lax.dynamic_index_in_dim(cache.v, layer, axis=0, keepdims=False)
     if cache.quantized:

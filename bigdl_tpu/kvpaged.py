@@ -1,0 +1,114 @@
+"""Paged KV cache: block tables over a shared physical page pool.
+
+The reference reaches paged attention through its vLLM fork
+(vllm/xpu/, 3,992 LoC in /root/reference); our engine's dense
+[slots, max_len] pool wastes HBM per idle slot and cannot share prompt
+prefixes. Here KV lives in pages of `page_size` tokens:
+
+- `k`/`v` [L, n_pages, page_size, Hkv, D] — one physical pool;
+- `block_tables` [B, max_pages] int32 map each row's logical page to a
+  physical page (unallocated entries may hold anything: reads beyond
+  `pos` are masked by attention, and the engine allocates before
+  writes);
+- writes scatter through the table; reads gather the row's pages back
+  into the dense [B, S, Hkv, D] view the attention ops consume (the
+  gather moves the same bytes attention reads — a dedicated Pallas
+  paged-attention kernel that indexes pages in place is the follow-up).
+
+Pages are allocated on demand and refcounted, so identical prompt
+prefixes share both storage and prefill compute (serving/engine.py's
+prefix cache keys full pages by their cumulative token hash).
+
+The class mirrors the KVCache interface surface the model forward uses
+(pos/start/max_len/next_positions + update/read/advance dispatched via
+bigdl_tpu.kvcache), so llama.forward runs on either cache unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    k: jax.Array  # [L, n_pages, page_size, Hkv, D]
+    v: jax.Array
+    block_tables: jax.Array  # [B, max_pages] int32 physical page ids
+    pos: jax.Array  # [B] int32 next logical slot per row
+    start: jax.Array  # [B] int32 first valid slot (left padding)
+    rope_base: Optional[jax.Array] = None  # [B] (see kvcache.KVCache)
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def max_len(self) -> int:  # logical capacity per row
+        return self.block_tables.shape[1] * self.page_size
+
+    @property
+    def quantized(self) -> bool:
+        return False  # fp8 paged pages: future work
+
+    def next_positions(self, t: int) -> jax.Array:
+        step = jnp.arange(t, dtype=jnp.int32)[None, :]
+        if self.rope_base is not None:
+            return self.rope_base[:, None] + step
+        pos = self.pos[:, None]
+        return jnp.maximum(pos + step - self.start[:, None], 0)
+
+
+def init_paged(
+    n_layers: int,
+    n_pages: int,
+    page_size: int,
+    n_kv_heads: int,
+    head_dim: int,
+    batch: int,
+    max_pages_per_row: int,
+    dtype=jnp.bfloat16,
+) -> PagedKVCache:
+    shape = (n_layers, n_pages, page_size, n_kv_heads, head_dim)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        block_tables=jnp.zeros((batch, max_pages_per_row), jnp.int32),
+        pos=jnp.zeros((batch,), jnp.int32),
+        start=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def update_layer(
+    cache: PagedKVCache, layer: jax.Array, k_new: jax.Array, v_new: jax.Array
+) -> PagedKVCache:
+    """Write k_new/v_new [B,T,Hkv,D] at each row's pos through the block
+    table. Does NOT advance pos (the model advances once per forward)."""
+    B, T = k_new.shape[:2]
+    page = cache.page_size
+    s = cache.pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B,T]
+    pg = s // page
+    off = s % page
+    phys = jnp.take_along_axis(cache.block_tables, pg, axis=1)  # [B,T]
+    k = cache.k.at[layer, phys, off].set(k_new)
+    v = cache.v.at[layer, phys, off].set(v_new)
+    return dataclasses.replace(cache, k=k, v=v)
+
+
+def read_layer(
+    cache: PagedKVCache, layer: jax.Array, dtype=jnp.bfloat16
+) -> tuple[jax.Array, jax.Array]:
+    """Gather one layer's pages into the dense [B, S, Hkv, D] view."""
+    k_l = jax.lax.dynamic_index_in_dim(cache.k, layer, 0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(cache.v, layer, 0, keepdims=False)
+    B, mp = cache.block_tables.shape
+    page = cache.page_size
+    k = k_l[cache.block_tables]  # [B, max_pages, page, Hkv, D]
+    v = v_l[cache.block_tables]
+    k = k.reshape(B, mp * page, *k.shape[3:])
+    v = v.reshape(B, mp * page, *v.shape[3:])
+    return k.astype(dtype), v.astype(dtype)

@@ -104,10 +104,16 @@ class ApiServer:
         gen=None,
         whisper=None,  # (WhisperConfig, params) enables /v1/audio/*
         whisper_tokenizer=None,
+        paged: bool = False,  # paged KV pool + prefix caching (kvpaged.py)
+        page_size: int = 64,
+        n_pages=None,
     ):
         from bigdl_tpu.serving.metrics import Metrics
 
-        self.engine = InferenceEngine(model, n_slots=n_slots, max_len=max_len, gen=gen)
+        self.engine = InferenceEngine(
+            model, n_slots=n_slots, max_len=max_len, gen=gen,
+            paged=paged, page_size=page_size, n_pages=n_pages,
+        )
         self.tokenizer = tokenizer
         self.whisper = whisper
         self.whisper_tokenizer = whisper_tokenizer

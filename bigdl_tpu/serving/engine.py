@@ -82,12 +82,45 @@ class InferenceEngine:
         max_len: int = 1024,
         gen: Optional[GenerationConfig] = None,
         seed: int = 0,
+        paged: bool = False,
+        page_size: int = 64,
+        n_pages: Optional[int] = None,
     ):
         self.model = model
         self.config: ModelConfig = model.config
         self.n_slots = n_slots
         self.max_len = max_len
         self.gen = gen or GenerationConfig()
+        # paged KV (kvpaged.py): pages allocated on demand + refcounted
+        # prefix cache, so the pool can be smaller than slots*max_len and
+        # identical prompt prefixes share storage AND prefill compute
+        # (the reference's paged attention + prefix caching live in its
+        # vLLM fork, vllm/xpu/)
+        self.paged = paged
+        self.page_size = page_size
+        self.max_pages_per_row = -(-max_len // page_size)
+        # +1: physical page 0 is the reserved scratch sink, so the default
+        # pool still covers every slot at full logical length
+        self.n_pages = n_pages or n_slots * self.max_pages_per_row + 1
+        if paged:
+            # physical page 0 is the scratch sink: idle slots still run
+            # the decode step (static-shape price) and their masked
+            # garbage writes go through their block tables — released
+            # slots point every entry at page 0 so they can never corrupt
+            # pages reallocated to live requests
+            self._free_pages = list(range(1, self.n_pages))
+            self._page_ref = [0] * self.n_pages
+            self._slot_pages: list[list[int]] = [[] for _ in range(n_slots)]
+            self._slot_written: list[int] = [0] * n_slots  # logical slots covered
+            self._prefix_cache: dict[Any, int] = {}  # chunk key -> page
+            self._page_key: dict[int, Any] = {}  # reverse map for eviction
+            self._prefix_lru: list[Any] = []  # keys, oldest first
+            self.prefix_hits = 0
+            self._bt_host = np.zeros(
+                (n_slots, self.max_pages_per_row), np.int32
+            )
+            self._bt_dirty = True
+            self._slot_pos = [0] * n_slots  # host mirror of cache.pos
         self._rng = jax.random.PRNGKey(seed)
         self._queue: "queue.SimpleQueue[Request]" = queue.SimpleQueue()
         self._slots = [_Slot() for _ in range(n_slots)]
@@ -121,6 +154,11 @@ class InferenceEngine:
         self._insert = self._with_mesh(jax.jit(
             self._insert_impl, donate_argnames=("cache",)
         ))
+        self._paged_prefill = self._with_mesh(jax.jit(
+            functools.partial(self._paged_prefill_impl, fwd),
+            donate_argnames=("k", "v"),
+        ))
+        self._waiting: Optional[Request] = None  # paged OOM retry slot
 
     def _with_mesh(self, fn):
         if self._mesh is None:
@@ -136,6 +174,14 @@ class InferenceEngine:
         """The shared KV pool, per-row positions from the start (idle rows
         park at 0); sharded over kv heads when the model is on a mesh."""
         cfg = self.config
+        if self.paged:
+            from bigdl_tpu import kvpaged
+
+            return kvpaged.init_paged(
+                cfg.num_hidden_layers, self.n_pages, self.page_size,
+                cfg.num_key_value_heads, cfg.head_dim_, self.n_slots,
+                self.max_pages_per_row,
+            )
         cache = kvcache.init_cache(
             cfg.num_hidden_layers, self.n_slots, self.max_len,
             cfg.num_key_value_heads, cfg.head_dim_,
@@ -187,6 +233,24 @@ class InferenceEngine:
         pos = cache.pos.at[slot].set(bucket)
         start = cache.start.at[slot].set(pad)
         return dataclasses.replace(cache, k=k, v=v, pos=pos, start=start)
+
+    def _paged_prefill_impl(self, forward, params, k, v, row_bt, pos0,
+                            tokens, last_idx):
+        """Tail prefill for ONE slot, writing straight into the shared
+        page pool (donated k/v): no dense mini-cache, no insert copy.
+        tokens are RIGHT-padded to a bucket; last_idx selects the real
+        last token's logits (pad writes land at slots >= pos and are
+        overwritten by decode)."""
+        from bigdl_tpu import kvpaged
+
+        cache = kvpaged.PagedKVCache(
+            k=k, v=v, block_tables=row_bt, pos=pos0,
+            start=jnp.zeros((1,), jnp.int32),
+        )
+        logits, cache = forward(
+            self.config, params, tokens, cache, mode="prefill"
+        )
+        return logits[0, last_idx], cache.k, cache.v
 
     def _decode_impl(self, forward, params, cur, cache, key,
                      temp, topk, topp, dosample):
@@ -241,50 +305,230 @@ class InferenceEngine:
                 return i
         return None
 
+    # ---- paged page management -------------------------------------------
+
+    def _prompt_key(self, prefix: list[int]):
+        # the tuple ITSELF, not its hash: dict equality then compares the
+        # actual tokens, so constructible hash collisions cannot alias two
+        # prompts onto one KV page (cross-request content leakage)
+        return tuple(prefix)
+
+    def _alloc_page(self) -> Optional[int]:
+        """A free page, evicting the LRU unreferenced prefix-cache page
+        when the free list is dry."""
+        if self._free_pages:
+            pg = self._free_pages.pop()
+            self._page_ref[pg] = 1
+            return pg
+        for key in list(self._prefix_lru):
+            pg = self._prefix_cache[key]
+            if self._page_ref[pg] == 0:
+                del self._prefix_cache[key]
+                self._prefix_lru.remove(key)
+                del self._page_key[pg]
+                self._page_ref[pg] = 1
+                return pg
+        return None
+
+    def _release_slot_pages(self, slot: int) -> None:
+        for pg in self._slot_pages[slot]:
+            self._page_ref[pg] -= 1
+            if self._page_ref[pg] == 0 and pg not in self._page_key:
+                self._free_pages.append(pg)
+        self._slot_pages[slot] = []
+        self._slot_written[slot] = 0
+        self._slot_pos[slot] = 0
+        # retarget the idle slot's garbage decode writes at the scratch
+        # page and park its position (see __init__)
+        self._bt_host[slot, :] = 0
+        self._bt_dirty = True
+        self.cache = dataclasses.replace(
+            self.cache, pos=self.cache.pos.at[slot].set(0)
+        )
+
+    def _admit_paged(self, req: Request, slot: int) -> bool:
+        """Tail-truncate, reuse cached full-page prompt prefixes (storage
+        AND prefill compute), allocate fresh pages for the tail, prefill
+        straight into the pool. False = not enough pages; retry later."""
+        page = self.page_size
+        limit = self.max_len - req.max_new_tokens
+        if len(req.prompt) > limit:
+            req.prompt = req.prompt[-limit:]
+        prompt = req.prompt
+
+        # longest run of cached full pages, leaving >= 1 tail token
+        shared: list[int] = []
+        while (len(shared) + 1) * page <= len(prompt) - 1:
+            key = self._prompt_key(prompt[: (len(shared) + 1) * page])
+            pg = self._prefix_cache.get(key)
+            if pg is None:
+                break
+            shared.append(pg)
+        n_hit = len(shared)
+        lp = n_hit * page
+        tail = prompt[lp:]
+        bucket = min(round_up(max(len(tail), 16), 32), self.max_len - lp)
+
+        need = -(-(lp + bucket) // page) - n_hit
+        if need > self.n_pages - 1:  # can NEVER be satisfied (page 0 is
+            # scratch): fail now instead of head-of-line blocking forever
+            req.error = (
+                f"prompt needs {need} pages but the pool only has "
+                f"{self.n_pages - 1}; raise n_pages or shorten the prompt"
+            )
+            req.finish_reason = "error"
+            req.done = True
+            if req.stream is not None:
+                req.stream.put(None)
+            return True  # consumed (failed), keep admitting others
+        # incref shared pages BEFORE allocating fresh ones — _alloc_page's
+        # LRU eviction must not evict a page out of this very request's
+        # prefix (refcount 0 pages are fair eviction game)
+        for pg in shared:
+            self._page_ref[pg] += 1
+        fresh: list[int] = []
+        for _ in range(need):
+            pg = self._alloc_page()
+            if pg is None:  # out of pages: roll back, retry next step
+                for q in fresh:
+                    self._page_ref[q] = 0
+                    self._free_pages.append(q)
+                for q in shared:
+                    self._page_ref[q] -= 1
+                return False
+            fresh.append(pg)
+        if n_hit:
+            self.prefix_hits += 1
+            for key in (self._prompt_key(prompt[: (i + 1) * page])
+                        for i in range(n_hit)):
+                if key in self._prefix_lru:  # refresh LRU position
+                    self._prefix_lru.remove(key)
+                    self._prefix_lru.append(key)
+
+        table = shared + fresh
+        self._slot_pages[slot] = table
+        # page-ALIGNED coverage: _ensure_decode_pages extends in whole
+        # pages, so a non-aligned start would drift the page index
+        self._slot_written[slot] = len(table) * page
+        row = np.zeros((self.max_pages_per_row,), np.int32)
+        row[: len(table)] = table
+        self._bt_host[slot] = row
+        self._bt_dirty = True
+
+        toks = np.full((1, bucket), self.gen.pad_token_id, np.int32)
+        toks[0, : len(tail)] = tail  # RIGHT pad: writes past pos get
+        # overwritten by decode and are masked meanwhile
+        logits_last, k, v = self._paged_prefill(
+            self.model.params, self.cache.k, self.cache.v,
+            jnp.asarray(row[None]), jnp.asarray([lp], jnp.int32),
+            jnp.asarray(toks), jnp.asarray(len(tail) - 1),
+        )
+        self.cache = dataclasses.replace(
+            self.cache, k=k, v=v,
+            pos=self.cache.pos.at[slot].set(len(prompt)),
+            start=self.cache.start.at[slot].set(0),
+        )
+        self._slot_pos[slot] = len(prompt)
+
+        # register the prompt's fully-covered fresh pages for future reuse
+        for i in range(n_hit, (len(prompt)) // page):
+            key = self._prompt_key(prompt[: (i + 1) * page])
+            if key not in self._prefix_cache:
+                self._prefix_cache[key] = table[i]
+                self._page_key[table[i]] = key
+                self._prefix_lru.append(key)
+
+        self._activate(slot, req, logits_last[None])
+        return True
+
+    def _ensure_decode_pages(self) -> None:
+        """Before a decode step, every active slot about to write past its
+        allocation gets one more page; a slot that can't is finished with
+        'length' (pool exhausted)."""
+        for i in np.nonzero(self.active)[0]:
+            slot = int(i)
+            if self._slot_pos[slot] < self._slot_written[slot]:
+                continue
+            idx = len(self._slot_pages[slot])
+            if idx >= self.max_pages_per_row:  # logical capacity reached
+                self._finish(slot, "length")
+                continue
+            pg = self._alloc_page()
+            if pg is None:
+                self._finish(slot, "length")
+                continue
+            self._slot_pages[slot].append(pg)
+            self._slot_written[slot] += self.page_size
+            self._bt_host[slot, idx] = pg
+            self._bt_dirty = True
+
+    # ---- admission --------------------------------------------------------
+
+    def _pop_request(self) -> Optional[Request]:
+        if self._waiting is not None:
+            req, self._waiting = self._waiting, None
+            return req
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _activate(self, slot: int, req: Request, logits_last) -> None:
+        """Shared post-prefill bookkeeping: sample the first token, arm
+        the slot's sampling params, emit."""
+        temp, topk, topp, dosample = self._slot_sampling(req)
+        self._rng, k = jax.random.split(self._rng)
+        first = int(sample_token_per_row(
+            logits_last, k,
+            jnp.asarray([temp], jnp.float32),
+            jnp.asarray([topk], jnp.int32),
+            jnp.asarray([topp], jnp.float32),
+            jnp.asarray([dosample], jnp.bool_),
+        )[0])
+        self.cur = self.cur.at[slot].set(first)
+        eos = (req.eos_token_id if req.eos_token_id is not None
+               else self.gen.eos_token_id)
+        self._slots[slot] = _Slot(
+            req=req, remaining=req.max_new_tokens - 1, eos=eos
+        )
+        self._temp[slot], self._topk[slot] = temp, topk
+        self._topp[slot], self._dosample[slot] = topp, dosample
+        self.active[slot] = True
+        self._emit(slot, first)
+
+    def _admit_dense(self, req: Request, slot: int) -> None:
+        # decode writes land at [bucket, bucket + max_new_tokens): keep
+        # that window inside the cache, tail-truncating over-long prompts
+        limit = self.max_len - req.max_new_tokens
+        bucket = min(round_up(max(len(req.prompt), 16), 64), limit)
+        if len(req.prompt) > bucket:
+            req.prompt = req.prompt[-bucket:]
+        tokens = np.full((1, bucket), self.gen.pad_token_id, np.int32)
+        tokens[0, bucket - len(req.prompt):] = req.prompt
+        pad = bucket - len(req.prompt)
+        logits_last, pcache = self._prefill(
+            self.model.params, jnp.asarray(tokens),
+            jnp.asarray([pad], jnp.int32), bucket=bucket,
+        )
+        self.cache = self._insert(
+            self.cache, pcache, jnp.asarray(slot), jnp.asarray(pad)
+        )
+        self._activate(slot, req, logits_last)
+
     def _admit(self) -> None:
         while True:
             slot = self._free_slot()
             if slot is None:
                 return
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
+            req = self._pop_request()
+            if req is None:
                 return
-            # decode writes land at [bucket, bucket + max_new_tokens): keep
-            # that window inside the cache, tail-truncating over-long prompts
-            limit = self.max_len - req.max_new_tokens
-            bucket = min(round_up(max(len(req.prompt), 16), 64), limit)
-            if len(req.prompt) > bucket:
-                req.prompt = req.prompt[-bucket:]
-            tokens = np.full((1, bucket), self.gen.pad_token_id, np.int32)
-            tokens[0, bucket - len(req.prompt):] = req.prompt
-            pad = bucket - len(req.prompt)
-            logits_last, pcache = self._prefill(
-                self.model.params, jnp.asarray(tokens),
-                jnp.asarray([pad], jnp.int32), bucket=bucket,
-            )
-            temp, topk, topp, dosample = self._slot_sampling(req)
-            self._rng, k = jax.random.split(self._rng)
-            first = int(sample_token_per_row(
-                logits_last, k,
-                jnp.asarray([temp], jnp.float32),
-                jnp.asarray([topk], jnp.int32),
-                jnp.asarray([topp], jnp.float32),
-                jnp.asarray([dosample], jnp.bool_),
-            )[0])
-            self.cache = self._insert(
-                self.cache, pcache, jnp.asarray(slot), jnp.asarray(pad)
-            )
-            self.cur = self.cur.at[slot].set(first)
-            eos = (req.eos_token_id if req.eos_token_id is not None
-                   else self.gen.eos_token_id)
-            self._slots[slot] = _Slot(
-                req=req, remaining=req.max_new_tokens - 1, eos=eos
-            )
-            self._temp[slot], self._topk[slot] = temp, topk
-            self._topp[slot], self._dosample[slot] = topp, dosample
-            self.active[slot] = True
-            self._emit(slot, first)
+            if self.paged:
+                if not self._admit_paged(req, slot):
+                    self._waiting = req  # pool full: retry after frees
+                    return
+            else:
+                self._admit_dense(req, slot)
 
     def _emit(self, slot: int, token: int) -> None:
         s = self._slots[slot]
@@ -308,6 +552,8 @@ class InferenceEngine:
         self._slots[slot] = _Slot()
         self.active[slot] = False
         self._dosample[slot] = False  # idle rows decode deterministic garbage
+        if self.paged:
+            self._release_slot_pages(slot)
 
     def _reset_state(self) -> None:
         """Rebuild the (possibly donated-away) cache after a failed decode
@@ -315,13 +561,31 @@ class InferenceEngine:
         self.cache = self._make_pool()
         self.cur = jnp.zeros((self.n_slots,), jnp.int32)
         self.active[:] = False
+        if self.paged:
+            self._free_pages = list(range(1, self.n_pages))  # 0 = scratch
+            self._page_ref = [0] * self.n_pages
+            self._slot_pages = [[] for _ in range(self.n_slots)]
+            self._slot_written = [0] * self.n_slots
+            self._slot_pos = [0] * self.n_slots
+            self._prefix_cache.clear()
+            self._page_key.clear()
+            self._prefix_lru.clear()
+            self._bt_host[:] = 0
+            self._bt_dirty = True
 
     def step(self) -> bool:
         """Admit queued requests, advance every active slot one token.
         Returns True if any work remains."""
         self._admit()
+        if self.paged:
+            self._ensure_decode_pages()
+            if self._bt_dirty:
+                self.cache = dataclasses.replace(
+                    self.cache, block_tables=jnp.asarray(self._bt_host)
+                )
+                self._bt_dirty = False
         if not self.active.any():
-            return not self._queue.empty()
+            return not self._queue.empty() or self._waiting is not None
         self._rng, k = jax.random.split(self._rng)
         try:
             nxt, self.cache = self._decode(
@@ -340,6 +604,8 @@ class InferenceEngine:
         for i in np.nonzero(self.active)[0]:
             s = self._slots[int(i)]
             s.remaining -= 1
+            if self.paged:
+                self._slot_pos[int(i)] += 1
             self._emit(int(i), int(toks[i]))
         return True
 
@@ -350,6 +616,13 @@ class InferenceEngine:
             if s.req is not None:
                 s.req.error = msg
                 self._finish(i, "error")
+        if self._waiting is not None:
+            req, self._waiting = self._waiting, None
+            req.error = msg
+            req.finish_reason = "error"
+            req.done = True
+            if req.stream is not None:
+                req.stream.put(None)
         while True:
             try:
                 req = self._queue.get_nowait()

@@ -86,17 +86,28 @@ def merge_lora(params: dict, lora: dict, requantize: Optional[str] = None) -> di
     out_layers = dict(params["layers"])
     scale = jnp.asarray(lora["scale"], jnp.float32)
 
-    # row offsets inside fused bases come from each target's own lora B
-    # width ([L, out, r]) — no config needed
+    # row offsets inside fused bases derive from the target's OWN lora B
+    # width plus the fused base's total rows — never from peer targets
+    # (a lora trained on wk/wv alone must still land in the k/v rows)
     widths = {t: p["b"].shape[-2] for t, p in lora["layers"].items()}
+
+    def base_rows(name: str) -> int:
+        from bigdl_tpu.quant import QTensor as _QT
+
+        base = params["layers"][name]
+        return base.data.shape[-2] if isinstance(base, _QT) else base.shape[-2]
 
     def row_start(target: str) -> int:
         name, idx = _MERGED_HOME[target]
+        total = base_rows(name)
         if name == "wqkv":
-            qd = widths.get("wq", 0)
-            kd = widths.get("wk", widths.get("wv", 0))
-            return [0, qd, qd + kd][idx]
-        return [0, widths.get("w_gate", widths.get("w_up", 0))][idx]
+            kd = widths[target] if target in ("wk", "wv") else None
+            if target == "wq":
+                return 0
+            # total = QD + 2*KD with KD = this target's own width
+            return total - 2 * kd if target == "wk" else total - kd
+        # w_gateup: gate rows first, both halves share width I
+        return 0 if target == "w_gate" else total // 2
 
     # base name -> list of (row_offset|None, delta)
     pending: dict[str, list] = {}

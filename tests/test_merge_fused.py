@@ -124,6 +124,26 @@ def test_merge_lora_into_fused_base():
     np.testing.assert_array_equal(out_a, out_b)
 
 
+def test_merge_lora_kv_only_targets_into_fused_base():
+    """A LoRA trained on wk/wv only (no wq) must land in the k/v rows of
+    the fused wqkv — offsets derive from the base shape, not peers."""
+    from bigdl_tpu.train import init_lora
+    from bigdl_tpu.train.qlora import merge_lora
+
+    split, merged = split_and_merged(qtype="nf4")
+    lora = init_lora(CFG, jax.random.PRNGKey(3), rank=4,
+                     targets=("wk", "wv", "w_up"))
+    lora["layers"] = jax.tree.map(
+        lambda a: jax.random.normal(jax.random.PRNGKey(4), a.shape) * 0.02,
+        lora["layers"],
+    )
+    a = merge_lora(split, lora)
+    b = merge_lora(merged, lora)
+    out_a = TpuModel(CFG, a, "nf4").generate(PROMPTS, max_new_tokens=8)
+    out_b = TpuModel(CFG, b, "nf4").generate(PROMPTS, max_new_tokens=8)
+    np.testing.assert_array_equal(out_a, out_b)
+
+
 def test_fused_dense_weights_still_quantize():
     """optimize_model('sym_int4') on an already-fused bf16 tree must
     quantize the fused leaves (the speculative self-draft path)."""

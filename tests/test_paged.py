@@ -1,0 +1,164 @@
+"""Paged KV pool + prefix caching tests (VERDICT r2 missing 8: the dense
+[slots, max_len] pool wastes HBM per slot and cannot share prefixes; the
+reference gets paged attention from its vLLM fork)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import kvcache, kvpaged
+from bigdl_tpu.api import TpuModel, optimize_model
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS
+from bigdl_tpu.serving.engine import InferenceEngine
+
+CFG = PRESETS["tiny-llama"]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TpuModel(CFG, optimize_model(
+        llama.init_params(CFG, jax.random.PRNGKey(0)), CFG
+    ), "sym_int4")
+
+
+def test_paged_forward_matches_dense(model):
+    """Prefill + decode over scattered physical pages == dense cache."""
+    tokens = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8, 2, 8, 1, 8]],
+                         jnp.int32)
+    B = 2
+    L, Hkv, D = CFG.num_hidden_layers, CFG.num_key_value_heads, CFG.head_dim_
+
+    dense = kvcache.init_cache(L, B, 32, Hkv, D)
+    dense = dataclasses.replace(dense, pos=jnp.zeros((B,), jnp.int32))
+    lg, dense = llama.forward(CFG, model.params, tokens, dense, mode="prefill")
+    ref = [jnp.argmax(lg[:, -1], -1)]
+    for _ in range(6):
+        lg, dense = llama.forward(CFG, model.params, ref[-1][:, None], dense,
+                                  mode="decode")
+        ref.append(jnp.argmax(lg[:, -1], -1))
+
+    paged = kvpaged.init_paged(L, n_pages=16, page_size=8, n_kv_heads=Hkv,
+                               head_dim=D, batch=B, max_pages_per_row=4)
+    # deliberately non-contiguous, interleaved physical pages
+    bt = np.asarray([[3, 9, 1, 12], [7, 2, 15, 4]], np.int32)
+    paged = dataclasses.replace(paged, block_tables=jnp.asarray(bt))
+    lg, paged = llama.forward(CFG, model.params, tokens, paged, mode="prefill")
+    out = [jnp.argmax(lg[:, -1], -1)]
+    for _ in range(6):
+        lg, paged = llama.forward(CFG, model.params, out[-1][:, None], paged,
+                                  mode="decode")
+        out.append(jnp.argmax(lg[:, -1], -1))
+    np.testing.assert_array_equal(
+        np.stack([np.asarray(t) for t in ref], 1),
+        np.stack([np.asarray(t) for t in out], 1),
+    )
+
+
+def _run(engine, prompts, maxnt=10):
+    reqs = [engine.submit(p, max_new_tokens=maxnt) for p in prompts]
+    engine.run_until_idle()
+    assert all(r.done for r in reqs), [r.error for r in reqs]
+    return [r.out_tokens for r in reqs]
+
+
+def test_paged_engine_matches_dense_engine(model):
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8], [11, 12, 13]]
+    ref = _run(InferenceEngine(model, n_slots=2, max_len=128), prompts)
+    out = _run(InferenceEngine(model, n_slots=2, max_len=128, paged=True,
+                               page_size=16), prompts)
+    assert out == ref
+
+
+def test_paged_pool_smaller_than_dense_worstcase(model):
+    """The pool can be much smaller than slots*max_len and still serve
+    (on-demand allocation): 4 slots x 256 logical but only 24 pages x 16
+    = 384 slots of physical KV."""
+    eng = InferenceEngine(model, n_slots=4, max_len=256, paged=True,
+                          page_size=16, n_pages=24)
+    prompts = [[i, i + 1, i + 2, i + 3] for i in range(1, 9)]
+    outs = _run(eng, prompts, maxnt=8)
+    assert len(outs) == 8 and all(len(o) == 8 for o in outs)
+    # physical memory: 24 pages vs dense 4*256/16 = 64 pages
+    assert eng.cache.k.shape[1] == 24
+
+
+def test_prefix_cache_hits_and_reuses_compute(model):
+    """Identical page-aligned prompt prefixes are served from cached
+    pages: the second request records a hit and produces identical
+    output; storage is shared (same physical page in both tables)."""
+    eng = InferenceEngine(model, n_slots=2, max_len=128, paged=True,
+                          page_size=8)
+    prefix = [5, 6, 7, 8, 9, 10, 11, 12]  # exactly one page
+    p1 = prefix + [20, 21]
+    p2 = prefix + [30, 31, 32]
+    r1 = eng.submit(p1, max_new_tokens=6)
+    eng.run_until_idle()
+    assert eng.prefix_hits == 0
+    r2 = eng.submit(p2, max_new_tokens=6)
+    eng.run_until_idle()
+    assert eng.prefix_hits == 1
+    assert r1.done and r2.done
+
+    # same prompts through a dense engine agree token for token
+    dense = InferenceEngine(model, n_slots=2, max_len=128)
+    d1 = dense.submit(p1, max_new_tokens=6)
+    d2 = dense.submit(p2, max_new_tokens=6)
+    dense.run_until_idle()
+    assert r1.out_tokens == d1.out_tokens
+    assert r2.out_tokens == d2.out_tokens
+
+
+def test_pages_released_and_reused(model):
+    eng = InferenceEngine(model, n_slots=1, max_len=64, paged=True,
+                          page_size=8, n_pages=6)
+    for round_i in range(5):  # far more logical traffic than 6 pages hold
+        out = _run(eng, [[1 + round_i, 2, 3, 4, 5]], maxnt=6)
+        assert len(out[0]) == 6
+    # after the last finish, non-cached pages returned to the free list
+    # (page 0 is the reserved scratch sink, so 5 allocatable)
+    in_cache = len(eng._page_key)
+    assert len(eng._free_pages) + in_cache == 5
+
+
+def test_long_decode_grows_pages_without_drift(model):
+    """Decode far past the admission bucket: on-demand page growth must
+    stay page-aligned (a 32-aligned start drifted the page index and
+    crashed with an out-of-bounds block-table write)."""
+    eng = InferenceEngine(model, n_slots=1, max_len=256, paged=True,
+                          page_size=64)
+    outs = _run(eng, [[3, 1, 4, 1, 5]], maxnt=200)
+    assert len(outs[0]) == 200
+    # matches the dense engine token for token over the whole run
+    dense = InferenceEngine(model, n_slots=1, max_len=256)
+    ref = _run(dense, [[3, 1, 4, 1, 5]], maxnt=200)
+    assert outs == ref
+
+
+def test_impossible_request_fails_instead_of_blocking(model):
+    """A prompt that can never fit the pool errors out immediately and
+    does not head-of-line-block the queue."""
+    eng = InferenceEngine(model, n_slots=2, max_len=256, paged=True,
+                          page_size=16, n_pages=4)  # 3 allocatable
+    big = eng.submit(list(range(1, 100)), max_new_tokens=4)
+    small = eng.submit([1, 2, 3], max_new_tokens=4)
+    eng.run_until_idle()
+    assert big.done and big.finish_reason == "error"
+    assert "pages" in big.error
+    assert small.done and not small.error and len(small.out_tokens) == 4
+
+
+def test_pool_exhaustion_requeues_and_recovers(model):
+    """More concurrent demand than pages: admission defers (request waits)
+    rather than failing, and completes once pages free up."""
+    eng = InferenceEngine(model, n_slots=2, max_len=64, paged=True,
+                          page_size=8, n_pages=5)
+    long_p = list(range(1, 25))  # 24 tokens -> 4 pages at admission
+    reqs = [eng.submit(long_p, max_new_tokens=6),
+            eng.submit(list(range(30, 54)), max_new_tokens=6)]
+    eng.run_until_idle()
+    assert all(r.done for r in reqs)
+    assert all(len(r.out_tokens) > 0 for r in reqs)
