@@ -1,0 +1,9 @@
+"""Benchmark reporting utilities (installed with the package).
+
+The config-driven all-in-one runner lives at the repo root
+(benchmark/run.py, mirroring the reference's dev/benchmark/all-in-one);
+this subpackage holds the pieces a pip-installed deployment needs —
+CSV -> HTML rendering and the perf-regression gate (report.py).
+"""
+
+from bigdl_tpu.benchmark.report import check_regressions, csv_to_html  # noqa: F401

@@ -1,0 +1,124 @@
+"""Benchmark CSV -> HTML report, with delta highlighting against a
+previous run.
+
+Counterpart of the reference's reporting pipeline
+(test/benchmark/csv_to_html.py + check_results.py in /root/reference:
+CSV results render to an HTML table, per-metric deltas beyond a
+threshold are colored, and the perf-regression CI gates on them).
+stdlib-only (the reference uses pandas Styler)."""
+
+from __future__ import annotations
+
+import csv
+import html
+from typing import Optional
+
+_NUMERIC_HINTS = ("ms", "cost", "tokens", "latency", "p90", "memory")
+
+
+def _try_float(s):
+    try:
+        return float(s)
+    except (TypeError, ValueError):
+        return None
+
+
+def read_csv(path: str) -> list[dict]:
+    with open(path, newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def diff_rows(
+    rows: list[dict], prev: list[dict], key_fields: tuple = ("name", "api"),
+) -> list[dict]:
+    """Attach `<col>_delta_pct` columns comparing numeric fields against
+    the previous run's row with the same key."""
+    def key(r):
+        return tuple(r.get(k, "") for k in key_fields)
+
+    prev_by_key = {key(r): r for r in prev}
+    out = []
+    for r in rows:
+        r = dict(r)
+        p = prev_by_key.get(key(r))
+        if p:
+            for col in list(r.keys()):
+                a, b = _try_float(r.get(col)), _try_float(p.get(col))
+                if a is not None and b not in (None, 0.0):
+                    r[f"{col}_delta_pct"] = round((a - b) / b * 100, 2)
+        out.append(r)
+    return out
+
+
+def to_html(
+    rows: list[dict],
+    title: str = "bigdl-tpu benchmark",
+    highlight_threshold: float = 3.0,
+) -> str:
+    """Render rows as a standalone HTML table; *_delta_pct cells beyond
+    the threshold are colored (regressions red, improvements green —
+    latency-style metrics, where higher is worse)."""
+    if not rows:
+        return f"<html><body><h2>{html.escape(title)}</h2><p>no rows</p></body></html>"
+    # union over ALL rows (first-seen order): a first row without a
+    # previous-run match has no *_delta_pct keys, which must not drop the
+    # delta columns for the rows that do
+    cols = list(dict.fromkeys(k for r in rows for k in r.keys()))
+    head = "".join(f"<th>{html.escape(c)}</th>" for c in cols)
+    body = []
+    for r in rows:
+        cells = []
+        for c in cols:
+            v = r.get(c, "")
+            style = ""
+            if c.endswith("_delta_pct"):
+                f = _try_float(v)
+                if f is not None and abs(f) >= highlight_threshold:
+                    color = "#fadbd8" if f > 0 else "#d5f5e3"
+                    style = f' style="background-color:{color}"'
+            cells.append(f"<td{style}>{html.escape(str(v))}</td>")
+        body.append("<tr>" + "".join(cells) + "</tr>")
+    return (
+        "<html><head><meta charset='utf-8'>"
+        "<style>table{border-collapse:collapse}td,th{border:1px solid #999;"
+        "padding:4px 8px;font-family:monospace;font-size:13px}</style>"
+        f"</head><body><h2>{html.escape(title)}</h2>"
+        f"<table><tr>{head}</tr>{''.join(body)}</table></body></html>"
+    )
+
+
+def csv_to_html(
+    csv_path: str,
+    out_path: str,
+    prev_csv: Optional[str] = None,
+    highlight_threshold: float = 3.0,
+) -> str:
+    rows = read_csv(csv_path)
+    if prev_csv:
+        rows = diff_rows(rows, read_csv(prev_csv))
+    doc = to_html(rows, title=csv_path, highlight_threshold=highlight_threshold)
+    with open(out_path, "w") as f:
+        f.write(doc)
+    return out_path
+
+
+def check_regressions(
+    csv_path: str,
+    prev_csv: str,
+    latency_cols: tuple = ("first_cost_ms", "rest_cost_mean_ms"),
+    threshold_pct: float = 5.0,
+) -> list[str]:
+    """The reference's check_results.py gate: latency columns that
+    regressed more than threshold_pct vs the previous run. Empty list =
+    gate passes."""
+    rows = diff_rows(read_csv(csv_path), read_csv(prev_csv))
+    failures = []
+    for r in rows:
+        for col in latency_cols:
+            d = _try_float(r.get(f"{col}_delta_pct"))
+            if d is not None and d > threshold_pct:
+                failures.append(
+                    f"{'/'.join(str(r.get(k, '')) for k in ('name', 'api'))}: "
+                    f"{col} +{d}%"
+                )
+    return failures

@@ -1,0 +1,82 @@
+"""Multiple-choice accuracy (C-Eval / MMLU-style) over loglikelihoods.
+
+Counterpart of the reference's C-Eval harness
+(dev/benchmark/ceval/eval.py + evaluators/ in /root/reference): each
+question scores every candidate answer's continuation loglikelihood and
+picks the argmax — the standard zero-/few-shot MCQ protocol. Reuses the
+lm-eval scoring core (eval/harness.score_continuations), so quantized
+models score through exactly the serving forward.
+
+Example item (C-Eval row):
+    {"question": "...", "choices": ["A ...", "B ...", ...], "answer": 2}
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from bigdl_tpu.eval.harness import score_continuations
+
+
+def mcq_accuracy(
+    model,
+    tokenizer,
+    items: Sequence[dict],
+    prompt_template: str = "{question}\n答案：",
+    normalize_length: bool = False,
+    batch_size: int = 8,
+    max_length: int = 2048,
+    progress: Optional[Callable[[int, int], None]] = None,
+) -> dict:
+    """Returns {"accuracy": float, "n": int, "predictions": [...]}.
+
+    normalize_length=True divides each choice's loglikelihood by its
+    token count (the acc_norm variant) — helps when options differ a lot
+    in length."""
+    pairs = []
+    spans = []  # (start, n_choices, answer)
+    for item in items:
+        ctx = tokenizer.encode(
+            prompt_template.format(**item), add_special_tokens=False
+        )
+        start = len(pairs)
+        for choice in item["choices"]:
+            cont = tokenizer.encode(str(choice), add_special_tokens=False)
+            pairs.append((ctx, cont or [0]))
+        spans.append((start, len(item["choices"]), int(item["answer"])))
+
+    scores = score_continuations(
+        model, pairs, max_length=max_length, batch_size=batch_size
+    )
+    correct = 0
+    preds = []
+    for i, (start, n, answer) in enumerate(spans):
+        lls = [scores[start + j][0] for j in range(n)]
+        if normalize_length:
+            lls = [ll / max(len(pairs[start + j][1]), 1)
+                   for j, ll in enumerate(lls)]
+        pred = max(range(n), key=lambda j: lls[j])
+        preds.append(pred)
+        correct += int(pred == answer)
+        if progress:
+            progress(i + 1, len(spans))
+    return {
+        "accuracy": correct / max(len(spans), 1),
+        "n": len(spans),
+        "predictions": preds,
+    }
+
+
+def load_ceval_csv(path: str) -> list[dict]:
+    """Parse a C-Eval val CSV (id,question,A,B,C,D,answer) into items."""
+    import csv
+
+    items = []
+    with open(path, newline="", encoding="utf-8") as f:
+        for row in csv.DictReader(f):
+            items.append({
+                "question": row["question"],
+                "choices": [row["A"], row["B"], row["C"], row["D"]],
+                "answer": "ABCD".index(row["answer"].strip()),
+            })
+    return items

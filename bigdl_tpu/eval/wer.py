@@ -1,0 +1,94 @@
+"""Word error rate for whisper quality gating.
+
+Counterpart of the reference's whisper WER harness
+(dev/benchmark/whisper/run_whisper.py in /root/reference, which scores
+librispeech transcriptions via the `evaluate` package's wer metric).
+Here the metric is self-contained (token-level Levenshtein, the standard
+WER definition: (S + D + I) / N) and `evaluate_wer` drives our whisper
+family end to end: waveform -> log-mel (bigdl_tpu.audio) -> generate ->
+tokenizer decode -> normalized WER against the references.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+
+def edit_distance(ref: Sequence, hyp: Sequence) -> int:
+    """Levenshtein distance (substitution/deletion/insertion cost 1)."""
+    n, m = len(ref), len(hyp)
+    if n == 0:
+        return m
+    prev = list(range(m + 1))
+    for i in range(1, n + 1):
+        cur = [i] + [0] * m
+        for j in range(1, m + 1):
+            cost = 0 if ref[i - 1] == hyp[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+        prev = cur
+    return prev[m]
+
+
+def normalize_text(s: str) -> list[str]:
+    """Whisper-benchmark style normalization: casefold, strip
+    punctuation, split on whitespace."""
+    out = []
+    for w in s.lower().split():
+        w = "".join(c for c in w if c.isalnum() or c == "'")
+        if w:
+            out.append(w)
+    return out
+
+
+def wer(references: Sequence[str], hypotheses: Sequence[str]) -> float:
+    """Corpus-level WER: total edits / total reference words."""
+    assert len(references) == len(hypotheses)
+    edits = words = 0
+    for ref, hyp in zip(references, hypotheses):
+        r, h = normalize_text(ref), normalize_text(hyp)
+        edits += edit_distance(r, h)
+        words += len(r)
+    return edits / max(words, 1)
+
+
+def evaluate_wer(
+    wconfig,
+    wparams,
+    samples: Sequence[tuple],  # [(waveform ndarray @16k, reference str)]
+    tokenizer,
+    prompt_ids: Optional[list[int]] = None,
+    max_new_tokens: int = 128,
+    progress: Optional[Callable[[int, int], None]] = None,
+) -> dict:
+    """Transcribe each sample with our whisper family and score WER.
+    Returns {"wer": float, "n": int, "hypotheses": [...]}."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu import audio as A
+    from bigdl_tpu.models import whisper as W
+
+    prompt = prompt_ids or W.default_prompt_ids(wconfig)
+    hyps = []
+    for i, (wave, _ref) in enumerate(samples):
+        # 30-second windows over the whole clip (matching the serving
+        # path) — truncating would count the dropped tail as deletions
+        # and silently inflate WER
+        ids: list[int] = []
+        for off in range(0, max(len(wave), 1), A.N_SAMPLES):
+            mel = A.log_mel_spectrogram(
+                wave[off:off + A.N_SAMPLES], n_mels=wconfig.num_mel_bins
+            )[:, : 2 * wconfig.max_source_positions]
+            toks = W.generate(
+                wconfig, wparams, jnp.asarray(mel[None]),
+                jnp.asarray([prompt], jnp.int32),
+                max_new_tokens=max_new_tokens,
+            )
+            ids.extend(
+                int(t) for t in toks[0]
+                if t not in (wconfig.eos_token_id, wconfig.pad_token_id)
+            )
+        hyps.append(tokenizer.decode(ids, skip_special_tokens=True))
+        if progress:
+            progress(i + 1, len(samples))
+    refs = [r for _, r in samples]
+    return {"wer": wer(refs, hyps), "n": len(samples), "hypotheses": hyps}

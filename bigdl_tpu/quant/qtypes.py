@@ -173,6 +173,14 @@ _ALIASES = {
     "int8": "sym_int8",
     "q8_0": "sym_int8",
     "fp8": "fp8_e5m2",  # reference maps plain "fp8" to e5m2 on most devices
+    # the reference's *_rtn variants (ggml/quantize.py:53-55) skip its
+    # MSE scale search; our blockwise quantizer IS round-to-nearest, so
+    # they resolve to the base formats (the searched variant is
+    # quant/imatrix.quantize_with_weights)
+    "sym_int4_rtn": "sym_int4",
+    "asym_int4_rtn": "asym_int4",
+    "sym_int8_rtn": "sym_int8",
+    "woq_int4": "sym_int4",
 }
 
 

@@ -372,14 +372,10 @@ class InferenceEngine:
         need = -(-(lp + bucket) // page) - n_hit
         if need > self.n_pages - 1:  # can NEVER be satisfied (page 0 is
             # scratch): fail now instead of head-of-line blocking forever
-            req.error = (
+            self._fail_request(req, (
                 f"prompt needs {need} pages but the pool only has "
                 f"{self.n_pages - 1}; raise n_pages or shorten the prompt"
-            )
-            req.finish_reason = "error"
-            req.done = True
-            if req.stream is not None:
-                req.stream.put(None)
+            ))
             return True  # consumed (failed), keep admitting others
         # incref shared pages BEFORE allocating fresh ones — _alloc_page's
         # LRU eviction must not evict a page out of this very request's
@@ -609,6 +605,14 @@ class InferenceEngine:
             self._emit(int(i), int(toks[i]))
         return True
 
+    def _fail_request(self, req: Request, msg: str) -> None:
+        """Terminal failure for a request not (or no longer) in a slot."""
+        req.error = msg
+        req.finish_reason = "error"
+        req.done = True
+        if req.stream is not None:
+            req.stream.put(None)
+
     def fail_all(self, msg: str) -> None:
         """Mark every in-flight and queued request failed (engine-thread
         crash path — streams get their sentinel so clients unblock)."""
@@ -618,21 +622,13 @@ class InferenceEngine:
                 self._finish(i, "error")
         if self._waiting is not None:
             req, self._waiting = self._waiting, None
-            req.error = msg
-            req.finish_reason = "error"
-            req.done = True
-            if req.stream is not None:
-                req.stream.put(None)
+            self._fail_request(req, msg)
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            req.error = msg
-            req.finish_reason = "error"
-            req.done = True
-            if req.stream is not None:
-                req.stream.put(None)
+            self._fail_request(req, msg)
         self.active[:] = False
 
     def run_until_idle(self, max_steps: int = 100000) -> None:

@@ -92,10 +92,9 @@ def merge_lora(params: dict, lora: dict, requantize: Optional[str] = None) -> di
     widths = {t: p["b"].shape[-2] for t, p in lora["layers"].items()}
 
     def base_rows(name: str) -> int:
-        from bigdl_tpu.quant import QTensor as _QT
-
-        base = params["layers"][name]
-        return base.data.shape[-2] if isinstance(base, _QT) else base.shape[-2]
+        # QTensor.shape is the LOGICAL shape for every storage (for
+        # ggml_block, data.shape[-2] would be n_superblocks, not rows)
+        return params["layers"][name].shape[-2]
 
     def row_start(target: str) -> int:
         name, idx = _MERGED_HOME[target]
