@@ -13,9 +13,6 @@ import csv
 import html
 from typing import Optional
 
-_NUMERIC_HINTS = ("ms", "cost", "tokens", "latency", "p90", "memory")
-
-
 def _try_float(s):
     try:
         return float(s)

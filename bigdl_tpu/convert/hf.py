@@ -302,6 +302,31 @@ def _qwen2_vl_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
     return _llama_top(config, top_get)
 
 
+def _mpt_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """MPT: fused Wqkv [3H, H], bias-free layernorms, non-gated gelu MLP
+    (reference models/mpt.py splits the same fused attention)."""
+    p = f"transformer.blocks.{i}."
+    H = config.hidden_size
+    wqkv = get(p + "attn.Wqkv.weight")
+    return {
+        "attn_norm": get(p + "norm_1.weight"),
+        "mlp_norm": get(p + "norm_2.weight"),
+        "wq": wqkv[:H],
+        "wk": wqkv[H:2 * H],
+        "wv": wqkv[2 * H:],
+        "wo": get(p + "attn.out_proj.weight"),
+        "w_up": get(p + "ffn.up_proj.weight"),
+        "w_down": get(p + "ffn.down_proj.weight"),
+    }
+
+
+def _mpt_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    return {
+        "embed": get("transformer.wte.weight"),
+        "final_norm": get("transformer.norm_f.weight"),
+    }  # head tied to wte
+
+
 def _gpt2_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     """GPT-2 stores linears as Conv1D ([in, out] — transposed) with a fused
     c_attn [in, 3H]."""
@@ -486,6 +511,7 @@ _FAMILY_LAYER = {
     "glm": _glm_layer,
     "chatglm": _chatglm_layer,
     "qwen2_vl": _qwen2_vl_layer,
+    "mpt": _mpt_layer,
     "gpt2": _gpt2_layer,
     "bloom": _bloom_layer,
     "gpt_neox": _gptneox_layer,
@@ -498,6 +524,7 @@ _FAMILY_TOP = {
     "internlm2": _internlm2_top,
     "chatglm": _chatglm_top,
     "qwen2_vl": _qwen2_vl_top,
+    "mpt": _mpt_top,
     "gpt2": _gpt2_top,
     "bloom": _bloom_top,
     "gpt_neox": _gptneox_top,
@@ -588,25 +615,13 @@ def params_from_state_dict(
     return params
 
 
-def load_hf_checkpoint(
-    model_path: str,
-    qtype: str = "sym_int4",
-    dtype=jnp.bfloat16,
-    config: Optional[ModelConfig] = None,
-) -> tuple[ModelConfig, dict, str]:
-    """Load an HF-format local checkpoint directory (config.json +
-    *.safetensors) into a quantized param tree.
-
-    Returns (config, params, effective_qtype) — the effective qtype can
-    differ from the request for GPTQ/AWQ checkpoints, whose packed codes
-    live in asym_int4 (see _wrap_quantized)."""
+def open_checkpoint(model_path: str):
+    """Tensor getter over a local safetensors checkpoint dir (sharded or
+    single-file): name -> np.ndarray. Floats arrive as fp32; integer
+    tensors (GPTQ/AWQ packed words) keep their dtype — fp32 has 24
+    mantissa bits and silently corrupts packed int32."""
     import torch  # lazy: only the ingest path touches torch
     from safetensors import safe_open  # lazy: heavy import
-
-    with open(os.path.join(model_path, "config.json")) as f:
-        hf_config = json.load(f)
-    if config is None:
-        config = ModelConfig.from_hf_config(hf_config)
 
     index_path = os.path.join(model_path, "model.safetensors.index.json")
     if os.path.exists(index_path):
@@ -634,11 +649,29 @@ def load_hf_checkpoint(
         t = handles[shard].get_tensor(name)
         if t.is_floating_point():
             return t.to(dtype=torch.float32).numpy()
-        # integer tensors (GPTQ/AWQ packed qweight/qzeros, g_idx) must keep
-        # their dtype: float32 has 24 mantissa bits and silently corrupts
-        # packed int32 words
         return t.numpy()
 
+    return get_tensor
+
+
+def load_hf_checkpoint(
+    model_path: str,
+    qtype: str = "sym_int4",
+    dtype=jnp.bfloat16,
+    config: Optional[ModelConfig] = None,
+) -> tuple[ModelConfig, dict, str]:
+    """Load an HF-format local checkpoint directory (config.json +
+    *.safetensors) into a quantized param tree.
+
+    Returns (config, params, effective_qtype) — the effective qtype can
+    differ from the request for GPTQ/AWQ checkpoints, whose packed codes
+    live in asym_int4 (see _wrap_quantized)."""
+    with open(os.path.join(model_path, "config.json")) as f:
+        hf_config = json.load(f)
+    if config is None:
+        config = ModelConfig.from_hf_config(hf_config)
+
+    get_tensor = open_checkpoint(model_path)
     quant_config = hf_config.get("quantization_config")
     if quant_config:
         get_tensor, qtype = _wrap_quantized(

@@ -62,31 +62,16 @@ def evaluate_wer(
 ) -> dict:
     """Transcribe each sample with our whisper family and score WER.
     Returns {"wer": float, "n": int, "hypotheses": [...]}."""
-    import jax.numpy as jnp
-
-    from bigdl_tpu import audio as A
     from bigdl_tpu.models import whisper as W
 
-    prompt = prompt_ids or W.default_prompt_ids(wconfig)
     hyps = []
     for i, (wave, _ref) in enumerate(samples):
-        # 30-second windows over the whole clip (matching the serving
-        # path) — truncating would count the dropped tail as deletions
-        # and silently inflate WER
-        ids: list[int] = []
-        for off in range(0, max(len(wave), 1), A.N_SAMPLES):
-            mel = A.log_mel_spectrogram(
-                wave[off:off + A.N_SAMPLES], n_mels=wconfig.num_mel_bins
-            )[:, : 2 * wconfig.max_source_positions]
-            toks = W.generate(
-                wconfig, wparams, jnp.asarray(mel[None]),
-                jnp.asarray([prompt], jnp.int32),
-                max_new_tokens=max_new_tokens,
-            )
-            ids.extend(
-                int(t) for t in toks[0]
-                if t not in (wconfig.eos_token_id, wconfig.pad_token_id)
-            )
+        # the serving pipeline itself (whisper.transcribe_waveform): the
+        # metric must score exactly what /v1/audio/transcriptions produces
+        ids = W.transcribe_waveform(
+            wconfig, wparams, wave, prompt_ids=prompt_ids,
+            max_new_tokens=max_new_tokens,
+        )
         hyps.append(tokenizer.decode(ids, skip_special_tokens=True))
         if progress:
             progress(i + 1, len(samples))

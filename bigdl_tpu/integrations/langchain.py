@@ -98,3 +98,57 @@ class BigdlTpuLLM(_BaseLLM):
                 if idx >= 0:
                     text = text[:idx]
         return text
+
+
+class BigdlTpuEmbeddings:
+    """LangChain-style embeddings over the BERT encoder family
+    (reference langchain/embeddings/: TransformersEmbeddings). Duck-typed
+    to the langchain Embeddings interface (embed_documents/embed_query),
+    so it works with or without langchain installed."""
+
+    def __init__(self, config, params, tokenizer, max_length: int = 256,
+                 normalize: bool = True):
+        self.config = config
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_length = max_length
+        self.normalize = normalize
+
+    @classmethod
+    def from_model_id(cls, model_id: str, qtype: str = "sym_int8", **kw):
+        import json
+        import os
+
+        from transformers import AutoTokenizer
+
+        from bigdl_tpu.convert.hf import open_checkpoint
+        from bigdl_tpu.models import bert
+
+        with open(os.path.join(model_id, "config.json")) as f:
+            config = bert.BertConfig.from_hf_config(json.load(f))
+        get = open_checkpoint(model_id)
+        params = bert.params_from_hf(config, get, qtype=qtype)
+        tok = AutoTokenizer.from_pretrained(model_id)
+        return cls(config, params, tok.encode, **kw)
+
+    def _embed(self, texts):
+        from bigdl_tpu.models import bert
+
+        tok = self.tokenizer
+        # .encode first: HF tokenizers are ALSO callable, but __call__
+        # returns a BatchEncoding dict, not ids
+        enc = tok.encode if hasattr(tok, "encode") else tok
+
+        class _T:
+            encode = staticmethod(enc)
+
+        return bert.embed_texts(
+            self.config, self.params, _T(), list(texts),
+            max_length=self.max_length, normalize=self.normalize,
+        )
+
+    def embed_documents(self, texts):
+        return [list(map(float, row)) for row in self._embed(texts)]
+
+    def embed_query(self, text: str):
+        return self.embed_documents([text])[0]

@@ -33,6 +33,7 @@ _FAMILIES = {
     # convert/hf._chatglm_layer
     "chatglm": llama,
     "gpt2": llama,
+    "mpt": llama,  # alibi + fused Wqkv, translated in config/_hf_mpt
     "bloom": llama,
     "gpt_neox": llama,
     "mixtral": llama,

@@ -1,0 +1,192 @@
+"""BERT-family encoder (embeddings / retrieval serving).
+
+Counterpart of the reference's bert support (models/bert.py in
+/root/reference, patched into its conversion engine; downstream it backs
+the LangChain embeddings path, langchain/embeddings/). Architecture per
+HF BertModel: learned word+position+token-type embeddings with LayerNorm,
+post-norm encoder blocks (self-attention with biases -> residual+LN ->
+gelu intermediate -> residual+LN), optional tanh pooler over [CLS].
+
+Like whisper, this family has its own config and call shape (encoder,
+bidirectional mask) so it is NOT in models._FAMILIES; use it directly or
+through integrations.langchain's embedding class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.ops import layer_norm, linear
+from bigdl_tpu.quant import QTensor, quantize
+
+Params = dict
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @classmethod
+    def from_hf_config(cls, hf: dict[str, Any]) -> "BertConfig":
+        keys = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in hf.items() if k in keys and v is not None})
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def params_from_hf(config: BertConfig, get, qtype: str = "bf16") -> Params:
+    """HF BertModel state dict -> stacked param tree; linear weights
+    quantized to `qtype` (dense for bf16/fp16)."""
+    H = config.hidden_size
+
+    def g(name):
+        return np.asarray(get(name), np.float32)
+
+    def maybe_q(w: np.ndarray):
+        if qtype in ("bf16", "fp16"):
+            return jnp.asarray(w, jnp.bfloat16 if qtype == "bf16" else jnp.float16)
+        return quantize(jnp.asarray(w), qtype)
+
+    names = [
+        ("wq", "attention.self.query.weight"), ("bq", "attention.self.query.bias"),
+        ("wk", "attention.self.key.weight"), ("bk", "attention.self.key.bias"),
+        ("wv", "attention.self.value.weight"), ("bv", "attention.self.value.bias"),
+        ("wo", "attention.output.dense.weight"), ("bo", "attention.output.dense.bias"),
+        ("attn_ln_w", "attention.output.LayerNorm.weight"),
+        ("attn_ln_b", "attention.output.LayerNorm.bias"),
+        ("w_mid", "intermediate.dense.weight"), ("b_mid", "intermediate.dense.bias"),
+        ("w_out", "output.dense.weight"), ("b_out", "output.dense.bias"),
+        ("out_ln_w", "output.LayerNorm.weight"), ("out_ln_b", "output.LayerNorm.bias"),
+    ]
+    stacks: dict[str, list] = {k: [] for k, _ in names}
+    for i in range(config.num_hidden_layers):
+        p = f"encoder.layer.{i}."
+        for key, suffix in names:
+            stacks[key].append(g(p + suffix))
+    layers = {}
+    for key, _ in names:
+        arr = np.stack(stacks[key])
+        if key.startswith("w"):
+            layers[key] = maybe_q(arr)
+        else:
+            layers[key] = jnp.asarray(arr, jnp.float32)
+
+    params = {
+        "word_embed": jnp.asarray(g("embeddings.word_embeddings.weight"),
+                                  jnp.float32),
+        "pos_embed": jnp.asarray(g("embeddings.position_embeddings.weight"),
+                                 jnp.float32),
+        "type_embed": jnp.asarray(g("embeddings.token_type_embeddings.weight"),
+                                  jnp.float32),
+        "embed_ln_w": jnp.asarray(g("embeddings.LayerNorm.weight"), jnp.float32),
+        "embed_ln_b": jnp.asarray(g("embeddings.LayerNorm.bias"), jnp.float32),
+        "layers": layers,
+    }
+    try:
+        params["pooler_w"] = maybe_q(g("pooler.dense.weight"))
+        params["pooler_b"] = jnp.asarray(g("pooler.dense.bias"), jnp.float32)
+    except KeyError:
+        pass  # sentence-transformer exports often drop the pooler
+    return params
+
+
+def forward(
+    config: BertConfig,
+    params: Params,
+    input_ids: jax.Array,  # [B, T] int32
+    attention_mask: Optional[jax.Array] = None,  # [B, T] 1 = real token
+    token_type_ids: Optional[jax.Array] = None,
+    compute_dtype=jnp.float32,
+) -> tuple[jax.Array, Optional[jax.Array]]:
+    """Returns (last_hidden [B, T, H], pooled [B, H] | None)."""
+    B, T = input_ids.shape
+    Hh, D = config.num_attention_heads, config.head_dim
+    eps = config.layer_norm_eps
+    if attention_mask is None:
+        attention_mask = jnp.ones((B, T), jnp.int32)
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros((B, T), jnp.int32)
+
+    h = (
+        params["word_embed"][input_ids]
+        + params["pos_embed"][jnp.arange(T)][None]
+        + params["type_embed"][token_type_ids]
+    ).astype(compute_dtype)
+    h = layer_norm(h, params["embed_ln_w"], params["embed_ln_b"], eps)
+
+    # bidirectional mask: attend to every real token
+    mask = attention_mask[:, None, None, :].astype(jnp.bool_)  # [B,1,1,T]
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+
+    def block(h, p):
+        q = linear(h, p["wq"], p["bq"], compute_dtype).reshape(B, T, Hh, D)
+        k = linear(h, p["wk"], p["bk"], compute_dtype).reshape(B, T, Hh, D)
+        v = linear(h, p["wv"], p["bv"], compute_dtype).reshape(B, T, Hh, D)
+        att = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+        att = att / np.sqrt(D) + jnp.where(mask, 0.0, neg)
+        att = jax.nn.softmax(att, axis=-1).astype(compute_dtype)
+        ctx = jnp.einsum("bhts,bshd->bthd", att, v).reshape(B, T, Hh * D)
+        attn_out = linear(ctx, p["wo"], p["bo"], compute_dtype)
+        h = layer_norm(h + attn_out, p["attn_ln_w"], p["attn_ln_b"], eps)
+
+        mid = jax.nn.gelu(
+            linear(h, p["w_mid"], p["b_mid"], compute_dtype), approximate=False
+        )
+        out = linear(mid, p["w_out"], p["b_out"], compute_dtype)
+        return layer_norm(h + out, p["out_ln_w"], p["out_ln_b"], eps), None
+
+    h, _ = jax.lax.scan(block, h, params["layers"])
+
+    pooled = None
+    if "pooler_w" in params:
+        pooled = jnp.tanh(
+            linear(h[:, 0], params["pooler_w"], params["pooler_b"],
+                   compute_dtype)
+        )
+    return h, pooled
+
+
+def mean_pool(last_hidden: jax.Array, attention_mask: jax.Array) -> jax.Array:
+    """Masked mean over tokens — the sentence-transformers default."""
+    m = attention_mask[..., None].astype(last_hidden.dtype)
+    return (last_hidden * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1e-9)
+
+
+def embed_texts(
+    config: BertConfig,
+    params: Params,
+    tokenizer,
+    texts: list[str],
+    max_length: int = 256,
+    normalize: bool = True,
+) -> np.ndarray:
+    """[n, H] sentence embeddings (mean-pooled, optionally L2-normalized)
+    — the LangChain embeddings entry point."""
+    enc = [tokenizer.encode(t)[:max_length] for t in texts]
+    T = max(len(e) for e in enc)
+    ids = np.zeros((len(enc), T), np.int32)
+    mask = np.zeros((len(enc), T), np.int32)
+    for i, e in enumerate(enc):
+        ids[i, : len(e)] = e
+        mask[i, : len(e)] = 1
+    h, _ = forward(config, params, jnp.asarray(ids), jnp.asarray(mask))
+    emb = mean_pool(h, jnp.asarray(mask))
+    if normalize:
+        emb = emb / jnp.maximum(
+            jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
+        )
+    return np.asarray(emb)

@@ -373,10 +373,36 @@ def _hf_qwen2_vl(hf, kw):
     kw["vision_start_token_id"] = hf.get("vision_start_token_id", 151652)
 
 
+def _hf_mpt(hf, kw):
+    """MPT (reference models/mpt.py): alibi positions, fused Wqkv,
+    non-gated gelu MLP, bias-free layernorm, tied head."""
+    kw["hidden_size"] = hf.get("d_model", 4096)
+    kw["num_attention_heads"] = hf.get("n_heads", 32)
+    kw["num_hidden_layers"] = hf.get("n_layers", 32)
+    kw["intermediate_size"] = int(
+        hf.get("expansion_ratio", 4) * kw["hidden_size"]
+    )
+    kw["max_position_embeddings"] = hf.get("max_seq_len", 2048)
+    attn = hf.get("attn_config") or {}
+    kw["alibi"] = bool(attn.get("alibi", True))
+    kw["norm_type"] = "layernorm"
+    kw["hidden_act"] = "gelu"
+    kw["gated_mlp"] = False
+    kw["tie_word_embeddings"] = True
+    if not hf.get("no_bias", True):
+        # the weight translator (_mpt_layer) loads weights only; silently
+        # dropping a biased checkpoint's biases would generate garbage
+        raise NotImplementedError(
+            "mpt with no_bias=False (biased linears/layernorms) is not "
+            "supported; released MPT checkpoints use no_bias=True"
+        )
+
+
 _HF_BUILDERS = {
     "qwen2": _hf_qwen2,
     "qwen2_vl": _hf_qwen2_vl,
     "chatglm": _hf_chatglm,
+    "mpt": _hf_mpt,
     "gemma": _hf_gemma,
     "gemma2": _hf_gemma2,
     "phi3": _hf_phi3,

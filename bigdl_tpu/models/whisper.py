@@ -101,6 +101,39 @@ class WhisperConfig:
         )
 
 
+def transcribe_waveform(
+    config: WhisperConfig,
+    params: Params,
+    wave,  # [T] float32 @ 16 kHz (numpy)
+    prompt_ids: "Optional[list[int]]" = None,
+    max_new_tokens: int = 128,
+) -> list[int]:
+    """Waveform -> token ids over 30-second windows: the ONE transcription
+    pipeline (mel slice, per-chunk generate, EOS/pad filtering) shared by
+    the serving endpoint (/v1/audio/transcriptions) and the WER harness
+    (eval/wer.py), so the metric always scores exactly what serving
+    produces."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu import audio as A
+
+    prompt = prompt_ids or default_prompt_ids(config)
+    ids: list[int] = []
+    for off in range(0, max(len(wave), 1), A.N_SAMPLES):
+        mel = A.log_mel_spectrogram(
+            wave[off:off + A.N_SAMPLES], n_mels=config.num_mel_bins
+        )[:, : 2 * config.max_source_positions]
+        toks = generate(
+            config, params, jnp.asarray(mel[None]),
+            jnp.asarray([prompt], jnp.int32), max_new_tokens=max_new_tokens,
+        )
+        ids.extend(
+            int(t) for t in toks[0]
+            if t not in (config.eos_token_id, config.pad_token_id)
+        )
+    return ids
+
+
 def default_prompt_ids(config: WhisperConfig) -> list[int]:
     """Minimal forced decoder prefix: <|startoftranscript|>. Callers with
     a tokenizer prepend language/task tokens (<|en|><|transcribe|>...)

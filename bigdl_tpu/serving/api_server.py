@@ -235,30 +235,14 @@ class ApiServer:
                 requested = min(max(requested, 1), cap)
                 max_new = min(-(-requested // 32) * 32, cap)
 
-                import jax.numpy as jnp
-
-                prompt = W.default_prompt_ids(wcfg)
-                ids: list[int] = []
-                frames_per_chunk = 2 * wcfg.max_source_positions
                 with outer._whisper_lock:
-                    # 30-second windows over the full clip (the reference
-                    # serving path chunks long audio the same way) —
-                    # truncating would silently drop the tail
-                    for off in range(0, len(wave), A.N_SAMPLES):
-                        chunk = wave[off:off + A.N_SAMPLES]
-                        mel = A.log_mel_spectrogram(
-                            chunk, n_mels=wcfg.num_mel_bins
-                        )[:, :frames_per_chunk]
-                        toks = W.generate(
-                            wcfg, wparams, jnp.asarray(mel[None]),
-                            jnp.asarray([prompt], jnp.int32),
-                            max_new_tokens=max_new,
-                        )
-                        chunk_ids = [
-                            int(t) for t in toks[0]
-                            if t not in (wcfg.eos_token_id, wcfg.pad_token_id)
-                        ]
-                        ids.extend(chunk_ids[:max(0, requested - len(ids))])
+                    # 30-second windows over the full clip (the shared
+                    # pipeline in whisper.transcribe_waveform — also what
+                    # the WER harness scores); response honors the
+                    # requested token cap across chunks
+                    ids = W.transcribe_waveform(
+                        wcfg, wparams, wave, max_new_tokens=max_new
+                    )[:requested]
                 if outer.whisper_tokenizer is not None:
                     text = outer.whisper_tokenizer.decode(
                         ids, skip_special_tokens=True

@@ -1,0 +1,112 @@
+"""BERT encoder equivalence + embedding tests (reference models/bert.py,
+backing the LangChain embeddings path)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.models import bert as B  # noqa: E402
+
+IDS = np.asarray([[101, 7592, 2088, 102, 0, 0], [101, 2023, 2003, 1037, 3231, 102]],
+                 np.int32)
+MASK = np.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]], np.int32)
+
+
+@pytest.fixture(scope="module")
+def hf_pair():
+    from transformers import BertConfig, BertModel
+
+    cfg = BertConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    model = BertModel(cfg).eval().to(torch.float32)
+    ids = IDS % 256
+    return cfg, model, ids
+
+
+def test_bert_equivalence(hf_pair):
+    cfg, model, ids = hf_pair
+    with torch.no_grad():
+        out = model(
+            input_ids=torch.from_numpy(ids).long(),
+            attention_mask=torch.from_numpy(MASK).long(),
+        )
+    config = B.BertConfig.from_hf_config(cfg.to_dict())
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    params = B.params_from_hf(config, sd.__getitem__)
+    h, pooled = B.forward(
+        config, params, jnp.asarray(ids), jnp.asarray(MASK)
+    )
+    np.testing.assert_allclose(
+        np.asarray(h), out.last_hidden_state.numpy(), rtol=2e-3, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled), out.pooler_output.numpy(), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_bert_quantized_close(hf_pair):
+    cfg, model, ids = hf_pair
+    config = B.BertConfig.from_hf_config(cfg.to_dict())
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    dense = B.params_from_hf(config, sd.__getitem__)
+    q = B.params_from_hf(config, sd.__getitem__, qtype="sym_int8")
+    h_d, _ = B.forward(config, dense, jnp.asarray(ids), jnp.asarray(MASK))
+    h_q, _ = B.forward(config, q, jnp.asarray(ids), jnp.asarray(MASK))
+    # int8 encoder stays close to the dense one
+    rel = float(jnp.linalg.norm(h_q - h_d) / jnp.linalg.norm(h_d))
+    assert rel < 0.05, rel
+
+
+def test_mean_pool_masks_padding(hf_pair):
+    cfg, model, ids = hf_pair
+    config = B.BertConfig.from_hf_config(cfg.to_dict())
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    params = B.params_from_hf(config, sd.__getitem__)
+    h, _ = B.forward(config, params, jnp.asarray(ids), jnp.asarray(MASK))
+    pooled = B.mean_pool(h, jnp.asarray(MASK))
+    manual = np.asarray(h)[0, :4].mean(axis=0)  # row 0 has 4 real tokens
+    np.testing.assert_allclose(np.asarray(pooled)[0], manual, rtol=1e-5,
+                               atol=1e-5)
+
+
+class StubTok:
+    def encode(self, s):
+        return [101] + [(ord(c) % 200) + 5 for c in s[:10]] + [102]
+
+
+def test_langchain_embeddings_adapter(hf_pair):
+    from bigdl_tpu.integrations.langchain import BigdlTpuEmbeddings
+
+    cfg, model, _ = hf_pair
+    config = B.BertConfig.from_hf_config(cfg.to_dict())
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    params = B.params_from_hf(config, sd.__getitem__, qtype="sym_int8")
+    emb = BigdlTpuEmbeddings(config, params, StubTok().encode)
+    docs = emb.embed_documents(["hello world", "goodbye now"])
+    q = emb.embed_query("hello world")
+    assert len(docs) == 2 and len(docs[0]) == 64
+    # identical text embeds identically; different text less similar
+    same = float(np.dot(docs[0], q))
+    diff = float(np.dot(docs[1], q))
+    assert abs(same - 1.0) < 1e-5 and diff < same
+
+
+def test_embed_texts(hf_pair):
+    cfg, model, _ = hf_pair
+    config = B.BertConfig.from_hf_config(cfg.to_dict())
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    params = B.params_from_hf(config, sd.__getitem__)
+    embs = B.embed_texts(config, params, StubTok(), ["hello world", "hi"])
+    assert embs.shape == (2, 64)
+    np.testing.assert_allclose(np.linalg.norm(embs, axis=1), 1.0, rtol=1e-5)
+    # deterministic
+    embs2 = B.embed_texts(config, params, StubTok(), ["hello world", "hi"])
+    np.testing.assert_allclose(embs, embs2)

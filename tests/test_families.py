@@ -186,6 +186,18 @@ def test_qwen2_moe_equivalence():
     assert config.shared_expert_intermediate_size == 64
 
 
+def test_mpt_equivalence():
+    cfg, model = hf_tiny(
+        "MptForCausalLM", "MptConfig",
+        d_model=64, n_heads=4, n_layers=2, expansion_ratio=2,
+        max_seq_len=64, vocab_size=128,
+        attn_config={"alibi": True, "attn_impl": "eager"}, no_bias=True,
+    )
+    config = check(cfg, model, tol=3e-3)
+    assert config.alibi and not config.gated_mlp
+    assert config.norm_type == "layernorm" and config.tie_word_embeddings
+
+
 def test_gpt2_equivalence():
     cfg, model = hf_tiny(
         "GPT2LMHeadModel", "GPT2Config",
