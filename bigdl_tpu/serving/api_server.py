@@ -144,6 +144,17 @@ class ApiServer:
             def do_GET(self):
                 if self.path == "/health":
                     return self._json(200, {"status": "ok"})
+                if self.path == "/info":  # TGI-protocol model info
+                    from bigdl_tpu import __version__
+
+                    cfg = outer.engine.config
+                    return self._json(200, {
+                        "model_id": cfg.model_type,
+                        "model_dtype": outer.engine.model.qtype,
+                        "max_total_tokens": outer.engine.max_len,
+                        "max_concurrent_requests": outer.engine.n_slots,
+                        "version": __version__,
+                    })
                 if self.path == "/metrics":
                     body = outer.metrics.render().encode()
                     self.send_response(200)
@@ -191,15 +202,125 @@ class ApiServer:
                     payload = json.loads(raw or b"{}")
                 except Exception as e:
                     return self._json(400, {"error": f"bad json: {e}"})
+                # TGI request schema: "inputs" (parameters optional); the
+                # legacy shape uses "prompt"
+                is_tgi = "parameters" in payload or (
+                    "inputs" in payload and "prompt" not in payload
+                )
                 if self.path == "/generate":
+                    if is_tgi:
+                        return self._tgi_generate(payload, stream=False)
                     return self._generate(payload, stream=False)
                 if self.path == "/generate_stream":
+                    if is_tgi:
+                        return self._tgi_generate(payload, stream=True)
                     return self._generate(payload, stream=True)
                 if self.path == "/v1/completions":
                     return self._completions(payload)
                 if self.path == "/v1/chat/completions":
                     return self._chat(payload)
                 return self._json(404, {"error": "not found"})
+
+            def _tgi_generate(self, payload, stream: bool):
+                """text-generation-inference protocol (the reference's
+                TGI-protocol worker, serving/fastchat/tgi_api_server.py):
+                {"inputs": str, "parameters"?: {...}} ->
+                {"generated_text": ...}. The stream variant follows the
+                TGI StreamResponse shape: every event carries a token
+                object and generated_text rides the LAST token event."""
+                from bigdl_tpu.utils.errors import invalid_input_error
+
+                params = payload.get("parameters") or {}
+                ids = outer._encode(payload.get("inputs", ""))
+                maxnt = int(params.get("max_new_tokens", 64))
+                kw = _sampling_kwargs(params)
+                stops = params.get("stop", []) or []
+                invalid_input_error(
+                    isinstance(stops, list)
+                    and all(isinstance(s, str) for s in stops),
+                    "parameters.stop must be a list of strings",
+                )
+
+                def cut(text):
+                    for s in stops:
+                        idx = text.find(s)
+                        if idx >= 0:
+                            return text[:idx], True
+                    return text, False
+
+                def tokens_until_cut(out_tokens):
+                    """(text, finish_reason_override, n_tokens): decode
+                    incrementally so generated_tokens matches the cut."""
+                    pieces = []
+                    for n, tok in enumerate(out_tokens, start=1):
+                        pieces.append(outer._decode_tok([tok]))
+                        full, hit = cut("".join(pieces))
+                        if hit:
+                            return full, "stop_sequence", n
+                    full, _ = cut("".join(pieces))
+                    return full, None, len(out_tokens)
+
+                if not stream:
+                    req = outer.engine.submit(ids, maxnt, **kw)
+                    outer._wait(req)
+                    if req.error:
+                        return self._json(500, {"error": req.error})
+                    if not req.done:
+                        return self._json(504, {"error": "generation timed out"})
+                    text, stop_reason, n_gen = tokens_until_cut(req.out_tokens)
+                    body = {"generated_text": text}
+                    if params.get("details"):
+                        body["details"] = {
+                            "finish_reason": stop_reason or (
+                                "eos_token" if req.finish_reason == "stop"
+                                else "length"
+                            ),
+                            "generated_tokens": n_gen,
+                        }
+                    return self._json(200, body)
+
+                q: queue.SimpleQueue = queue.SimpleQueue()
+                req = outer.engine.submit(ids, maxnt, stream=q, **kw)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+
+                def emit(tok, text, generated_text):
+                    evt = {
+                        "token": {"id": tok, "text": text, "special": False},
+                        "generated_text": generated_text,
+                    }
+                    self.wfile.write(f"data: {json.dumps(evt)}\n\n".encode())
+                    self.wfile.flush()
+
+                # emit one event BEHIND so generated_text can ride the
+                # last token event (the TGI schema has no token-less
+                # final event)
+                pieces: list[str] = []
+                pending = None  # (tok, piece)
+                stopped = False
+                for tok in outer._stream_iter(q):
+                    piece = outer._decode_tok([tok])
+                    if pending is not None:
+                        emit(*pending, None)
+                    pieces.append(piece)
+                    full, hit = cut("".join(pieces))
+                    if hit:
+                        stopped = True
+                        emit(tok, piece, full)
+                        outer.engine.cancel(req)  # free the slot: the
+                        # client got its final event
+                        break
+                    pending = (tok, piece)
+                if not stopped:
+                    if req.error:
+                        # match the plain stream path: clients must see
+                        # the failure, not a fake successful final event
+                        err = json.dumps({"error": req.error})
+                        self.wfile.write(f"data: {err}\n\n".encode())
+                    elif pending is not None:
+                        emit(*pending, "".join(pieces))
+                return None
 
             def _transcribe(self, raw: bytes):
                 if outer.whisper is None:

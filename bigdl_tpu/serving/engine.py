@@ -159,6 +159,10 @@ class InferenceEngine:
             donate_argnames=("k", "v"),
         ))
         self._waiting: Optional[Request] = None  # paged OOM retry slot
+        # rids whose client went away (stop-string hit, disconnect):
+        # handler threads add, the engine thread frees the slot at the
+        # top of its next step — no cross-thread _finish races
+        self._cancelled: set[int] = set()
 
     def _with_mesh(self, fn):
         if self._mesh is None:
@@ -569,9 +573,22 @@ class InferenceEngine:
             self._bt_host[:] = 0
             self._bt_dirty = True
 
+    def cancel(self, req: Request) -> None:
+        """Thread-safe: stop generating for a request whose consumer is
+        gone (stop-string cut, client disconnect). The slot frees on the
+        engine thread's next step."""
+        self._cancelled.add(req.rid)
+
+    def _reap_cancelled(self) -> None:
+        for i, s in enumerate(self._slots):
+            if s.req is not None and s.req.rid in self._cancelled:
+                self._cancelled.discard(s.req.rid)
+                self._finish(i, "stop")
+
     def step(self) -> bool:
         """Admit queued requests, advance every active slot one token.
         Returns True if any work remains."""
+        self._reap_cancelled()
         self._admit()
         if self.paged:
             self._ensure_decode_pages()

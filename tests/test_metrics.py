@@ -98,6 +98,60 @@ def test_error_counter_on_500(server):
     assert "/nope" not in text
 
 
+def test_tgi_protocol(server):
+    """TGI request schema on /generate (reference tgi_api_server.py):
+    {"inputs", "parameters"} -> {"generated_text"}; /info describes the
+    model; details adds finish_reason/token count."""
+    out = _post(server, "/generate", {
+        "inputs": [3, 1, 4], "parameters": {
+            "max_new_tokens": 5, "details": True, "temperature": 0,
+        },
+    })
+    assert "generated_text" in out
+    assert out["details"]["generated_tokens"] == 5
+    assert out["details"]["finish_reason"] in ("length", "eos_token")
+
+    info = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/info", timeout=60
+    ).read())
+    assert info["model_id"] == "llama" and info["max_concurrent_requests"] == 2
+
+    # "inputs" without "parameters" is still a valid TGI request
+    out = _post(server, "/generate", {"inputs": [3, 1, 4]})
+    assert "generated_text" in out
+
+    # stop must be a list of strings, not iterated char by char
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/generate",
+        data=json.dumps({"inputs": [1, 2], "parameters":
+                         {"stop": "###"}}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=60)
+    assert e.value.code == 400
+
+
+def test_tgi_stream_schema(server):
+    """Every stream event carries a token object; generated_text rides
+    the LAST token event (huggingface_hub client compatibility)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=300)
+    conn.request("POST", "/generate_stream", json.dumps({
+        "inputs": [3, 1, 4],
+        "parameters": {"max_new_tokens": 4, "temperature": 0},
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    events = [json.loads(l[6:]) for l in resp.read().decode().splitlines()
+              if l.startswith("data: ")]
+    assert len(events) == 4
+    for evt in events:
+        assert isinstance(evt["token"], dict) and "id" in evt["token"]
+    assert all(e["generated_text"] is None for e in events[:-1])
+    assert events[-1]["generated_text"] is not None
+
+
 def test_invalid_input_error_helper(caplog):
     import logging
 
