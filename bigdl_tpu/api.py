@@ -221,6 +221,7 @@ class TpuModel:
         temperature: float = 1.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        repetition_penalty: float = 1.0,
         eos_token_id: Optional[int] = None,
         pad_token_id: int = 0,
         seed: int = 0,
@@ -273,6 +274,7 @@ class TpuModel:
             flags.performance_mode()
             and not do_sample
             and compress_kv is None  # lookup path has no SnapKV support
+            and repetition_penalty == 1.0  # lookup has no penalty support
             and self.pp_size <= 1  # lookup jits family.forward directly
             and max(len(p) for p in prompts) >= 256
         ):
@@ -288,6 +290,7 @@ class TpuModel:
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
+            repetition_penalty=repetition_penalty,
             eos_token_id=eos_token_id,
             pad_token_id=pad_token_id,
         )

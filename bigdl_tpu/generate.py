@@ -32,8 +32,36 @@ class GenerationConfig:
     temperature: float = 1.0
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    repetition_penalty: float = 1.0  # HF semantics: >1 discourages repeats
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
+
+
+def apply_repetition_penalty(
+    logits: jax.Array,  # [B, V]
+    seen: jax.Array,  # [B, V] bool: token appeared in prompt or output
+    penalty,  # float or [B] traced
+) -> jax.Array:
+    """HF RepetitionPenaltyLogitsProcessor semantics (the reference fuses
+    this as xe_addons.repetition_penalty_logits_process_inplaced): seen
+    tokens' scores divide by the penalty when positive, multiply when
+    negative."""
+    p = jnp.asarray(penalty, logits.dtype)
+    if p.ndim == 1:
+        p = p[:, None]
+    penalized = jnp.where(logits < 0, logits * p, logits / p)
+    return jnp.where(seen, penalized, logits)
+
+
+def seen_from_prompt(tokens: jax.Array, start: jax.Array, vocab: int) -> jax.Array:
+    """[B, V] bool presence mask over the real (non-pad) prompt tokens."""
+    B, T = tokens.shape
+    real = jnp.arange(T)[None, :] >= start[:, None]
+    idx = jnp.where(real, tokens, vocab)  # pads land in the overflow bin
+    return (
+        jnp.zeros((B, vocab + 1), jnp.bool_)
+        .at[jnp.arange(B)[:, None], idx].set(True)[:, :vocab]
+    )
 
 
 def sample_token(
@@ -190,8 +218,21 @@ def generate_tokens(
             config, params, tokens, cache, mode="prefill",
             last_logits_only=last_logits,
         )
+    use_rep = gen.repetition_penalty != 1.0  # static: compiles away
+    seen = (
+        seen_from_prompt(tokens, start, config.vocab_size)
+        if use_rep else jnp.zeros((B, 1), jnp.bool_)
+    )
+
     key, k0 = jax.random.split(key)
-    first = sample_token(logits[:, -1], k0, gen)
+    first_logits = logits[:, -1]
+    if use_rep:
+        first_logits = apply_repetition_penalty(
+            first_logits, seen, gen.repetition_penalty
+        )
+    first = sample_token(first_logits, k0, gen)
+    if use_rep:
+        seen = seen.at[jnp.arange(B), first].set(True)
 
     out = jnp.full((B, gen.max_new_tokens), gen.pad_token_id, jnp.int32)
     out = out.at[:, 0].set(first)
@@ -201,22 +242,29 @@ def generate_tokens(
     )
 
     def cond(state):
-        i, _, _, done, _, _ = state
+        i, _, _, done, _, _, _ = state
         return (i < gen.max_new_tokens) & ~jnp.all(done)
 
     def step(state):
-        i, cur, cache, done, out, key = state
+        i, cur, cache, done, out, key, seen = state
         logits, cache = model_forward(
             config, params, cur[:, None], cache, mode="decode"
         )
         key, k = jax.random.split(key)
-        nxt = sample_token(logits[:, -1], k, gen)
+        step_logits = logits[:, -1]
+        if use_rep:
+            step_logits = apply_repetition_penalty(
+                step_logits, seen, gen.repetition_penalty
+            )
+        nxt = sample_token(step_logits, k, gen)
         if eos is not None:
             nxt = jnp.where(done, gen.pad_token_id, nxt)
             done = done | (nxt == eos)
+        if use_rep:
+            seen = seen.at[jnp.arange(B), nxt].set(True)
         out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
-        return (i + 1, nxt, cache, done, out, key)
+        return (i + 1, nxt, cache, done, out, key, seen)
 
-    state = (jnp.ones((), jnp.int32), first, cache, done, out, key)
-    _, _, _, _, out, _ = jax.lax.while_loop(cond, step, state)
+    state = (jnp.ones((), jnp.int32), first, cache, done, out, key, seen)
+    _, _, _, _, out, _, _ = jax.lax.while_loop(cond, step, state)
     return out

@@ -68,6 +68,14 @@ def _sampling_kwargs(payload: dict) -> dict:
         # explicit value wins over implied sampling (the t<=0 contradiction
         # was already rejected above)
         kw["do_sample"] = bool(payload["do_sample"])
+    if float(payload.get("repetition_penalty", 1.0)) != 1.0:
+        # the engine's shared decode step has no per-slot seen-token
+        # masks yet; silently ignoring the knob would misreport outputs
+        invalid_input_error(
+            False,
+            "per-request repetition_penalty is not supported by the "
+            "serving engine yet; use TpuModel.generate(repetition_penalty=)",
+        )
     if "eos_token_id" in payload:
         kw["eos_token_id"] = int(payload["eos_token_id"])
     return kw

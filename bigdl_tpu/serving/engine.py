@@ -91,6 +91,13 @@ class InferenceEngine:
         self.n_slots = n_slots
         self.max_len = max_len
         self.gen = gen or GenerationConfig()
+        if self.gen.repetition_penalty != 1.0:
+            # the shared decode step has no per-slot seen-token masks;
+            # accepting the field and ignoring it would misreport outputs
+            raise NotImplementedError(
+                "the serving engine does not support repetition_penalty "
+                "yet; use TpuModel.generate(repetition_penalty=)"
+            )
         # paged KV (kvpaged.py): pages allocated on demand + refcounted
         # prefix cache, so the pool can be smaller than slots*max_len and
         # identical prompt prefixes share storage AND prefill compute
