@@ -247,6 +247,10 @@ class TpuModel:
             quantize_kv = flags.quantize_kv_default()
         if compress_kv is None:
             compress_kv = flags.compress_kv_budget()
+        cache_init = getattr(self.family, "init_cache", None)
+        if cache_init is not None and compress_kv is not None:
+            # recurrent-state families (rwkv) have no KV cache to compress
+            compress_kv = None
         if (
             compress_kv is not None
             and max(len(p) for p in prompts) > compress_kv  # would apply
@@ -272,6 +276,7 @@ class TpuModel:
             compress_kv = None
         if (
             flags.performance_mode()
+            and cache_init is None  # lookup verify needs a rewindable KV cache
             and not do_sample
             and compress_kv is None  # lookup path has no SnapKV support
             and repetition_penalty == 1.0  # lookup has no penalty support
@@ -314,6 +319,7 @@ class TpuModel:
                 compress_budget=budget,
                 compress_window=min(compress_window, max(budget - 1, 1)),
                 last_logits=flags.last_lm_head_default(),
+                cache_init=cache_init,
             )
         return np.asarray(out)
 

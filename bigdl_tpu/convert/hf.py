@@ -38,6 +38,8 @@ from bigdl_tpu.quant.qtypes import resolve_qtype
 _QUANT_TARGETS = {
     "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
     "w_gate_e", "w_up_e", "w_down_e", "w_gate_s", "w_up_s", "w_down_s",
+    # rwkv projections (models/rwkv.py)
+    "att_k", "att_v", "att_r", "att_g", "att_o", "ffn_k", "ffn_r", "ffn_v",
 }
 
 Get = Callable[[str], np.ndarray]
@@ -502,6 +504,59 @@ def _qwen2_moe_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndar
     }
 
 
+def _rwkv_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """RWKV v4/v5 HF layout (transformers modeling_rwkv.py for v4; the
+    rwkv-5-world remote-code schema adds gate + ln_x; reference
+    models/rwkv4.py / rwkv5.py). time_mix_* ship [1,1,C] — squeezed to
+    [C]; v5 time_decay/time_first reshape to [H, D]."""
+    p = f"rwkv.blocks.{i}."
+    v5 = config.rwkv_head_size is not None
+
+    def vec(name):
+        return np.asarray(get(name)).reshape(-1)
+
+    out = {
+        "ln1_w": get(p + "ln1.weight"), "ln1_b": get(p + "ln1.bias"),
+        "ln2_w": get(p + "ln2.weight"), "ln2_b": get(p + "ln2.bias"),
+        "att_mix_k": vec(p + "attention.time_mix_key"),
+        "att_mix_v": vec(p + "attention.time_mix_value"),
+        "att_mix_r": vec(p + "attention.time_mix_receptance"),
+        "att_k": get(p + "attention.key.weight"),
+        "att_v": get(p + "attention.value.weight"),
+        "att_r": get(p + "attention.receptance.weight"),
+        "att_o": get(p + "attention.output.weight"),
+        "ffn_mix_k": vec(p + "feed_forward.time_mix_key"),
+        "ffn_mix_r": vec(p + "feed_forward.time_mix_receptance"),
+        "ffn_k": get(p + "feed_forward.key.weight"),
+        "ffn_r": get(p + "feed_forward.receptance.weight"),
+        "ffn_v": get(p + "feed_forward.value.weight"),
+    }
+    if v5:
+        H = config.num_attention_heads
+        D = config.rwkv_head_size
+        out["att_decay"] = vec(p + "attention.time_decay").reshape(H, D)
+        out["att_first"] = vec(p + "attention.time_first").reshape(H, D)
+        out["att_mix_g"] = vec(p + "attention.time_mix_gate")
+        out["att_g"] = get(p + "attention.gate.weight")
+        out["ln_x_w"] = get(p + "attention.ln_x.weight")
+        out["ln_x_b"] = get(p + "attention.ln_x.bias")
+    else:
+        out["att_decay"] = vec(p + "attention.time_decay")
+        out["att_first"] = vec(p + "attention.time_first")
+    return out
+
+
+def _rwkv_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    return {
+        "embed": get("rwkv.embeddings.weight"),
+        "ln0_w": get("rwkv.blocks.0.pre_ln.weight"),
+        "ln0_b": get("rwkv.blocks.0.pre_ln.bias"),
+        "final_norm": get("rwkv.ln_out.weight"),
+        "final_norm_b": get("rwkv.ln_out.bias"),
+        "lm_head": get("head.weight"),
+    }
+
+
 _FAMILY_LAYER = {
     "gemma2": _gemma2_layer,
     "phi3": _phi3_layer,
@@ -517,6 +572,8 @@ _FAMILY_LAYER = {
     "gpt_neox": _gptneox_layer,
     "mixtral": _mixtral_layer,
     "qwen2_moe": _qwen2_moe_layer,
+    "rwkv": _rwkv_layer,
+    "rwkv5": _rwkv_layer,
 }
 
 _FAMILY_TOP = {
@@ -528,6 +585,8 @@ _FAMILY_TOP = {
     "gpt2": _gpt2_top,
     "bloom": _bloom_top,
     "gpt_neox": _gptneox_top,
+    "rwkv": _rwkv_top,
+    "rwkv5": _rwkv_top,
 }
 
 

@@ -162,7 +162,7 @@ def pad_prompts(
     static_argnames=(
         "config", "gen", "model_forward", "cache_len", "quantize_kv",
         "compress_budget", "compress_window", "compress_kernel",
-        "last_logits",
+        "last_logits", "cache_init",
     ),
     donate_argnames=(),
 )
@@ -182,6 +182,10 @@ def generate_tokens(
     # lm head on the last prefill position only (BIGDL_TPU_LAST_LM_HEAD;
     # reference IPEX_LLM_LAST_LM_HEAD) — saves the [B,T,V] prefill logits
     last_logits: bool = True,
+    # family cache-init hook: fn(config, B, cache_len, quantize_kv) for
+    # architectures whose state is not a KV cache (rwkv's RwkvState);
+    # None = standard kvcache.init_cache
+    cache_init=None,
 ) -> jax.Array:
     """One compiled program: prefill + full decode loop.
 
@@ -196,10 +200,14 @@ def generate_tokens(
 
     B, T = tokens.shape
     assert cache_len >= T + gen.max_new_tokens
-    cache = kvcache.init_cache(
-        config.num_hidden_layers, B, cache_len, config.num_key_value_heads,
-        config.head_dim_, quantize_kv=quantize_kv,
-    )
+    if cache_init is not None:
+        cache = cache_init(config, B, cache_len, quantize_kv)
+        assert compress_budget == 0, "SnapKV needs a KV cache"
+    else:
+        cache = kvcache.init_cache(
+            config.num_hidden_layers, B, cache_len, config.num_key_value_heads,
+            config.head_dim_, quantize_kv=quantize_kv,
+        )
     cache = dataclasses.replace(cache, start=start)
 
     if compress_budget:

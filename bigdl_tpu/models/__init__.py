@@ -45,6 +45,14 @@ from bigdl_tpu.models import qwen2_vl  # noqa: E402  (delegates text to llama)
 
 _FAMILIES["qwen2_vl"] = qwen2_vl
 
+from bigdl_tpu.models import rwkv  # noqa: E402  (attention-free recurrence)
+
+# rwkv replaces the KV cache with a recurrent state: it exposes
+# `init_cache` returning an RwkvState, which generate.generate_tokens
+# consumes through the family cache_init hook
+_FAMILIES["rwkv"] = rwkv
+_FAMILIES["rwkv5"] = rwkv
+
 # whisper (models/whisper.py) is an encoder-decoder family with its own
 # WhisperConfig and (params, mel, prompt) call shape — deliberately NOT in
 # _FAMILIES, whose consumers (optimize_model, TpuModel.generate) assume

@@ -74,6 +74,11 @@ class ModelConfig:
     # or force "dense" / "ragged" (models/llama.py _moe_mlp)
     moe_dispatch: Optional[str] = None
     moe_capacity_factor: float = 1.25  # ragged: slots per expert vs even load
+    # RWKV (v4/v5): attention-free recurrence (models/rwkv.py). head_size
+    # set = v5 multi-head matrix state; None = v4 scalar WKV
+    attention_hidden_size: Optional[int] = None
+    rwkv_head_size: Optional[int] = None
+    rwkv_group_norm_eps: Optional[float] = None  # v5 ln_x GroupNorm eps
     # multimodal (qwen2_vl): M-RoPE channel sections for (t, h, w) position
     # components; standard rope when the three components are equal
     mrope_section: Optional[tuple] = None
@@ -398,6 +403,38 @@ def _hf_mpt(hf, kw):
         )
 
 
+def _hf_rwkv(hf, kw):
+    """RWKV v4 (HF `rwkv` config schema: modeling_rwkv.py in
+    transformers; reference models/rwkv4.py). layer_norm_epsilon feeds
+    every LayerNorm; rescale_every is an fp16-overflow trick HF applies
+    only in half precision — exact under LN invariance, skipped here
+    (we compute the recurrence in f32)."""
+    kw["attention_hidden_size"] = hf.get(
+        "attention_hidden_size", hf.get("hidden_size", 4096)
+    )
+    kw["intermediate_size"] = (
+        hf.get("intermediate_size") or 4 * hf.get("hidden_size", 4096)
+    )
+    kw["rms_norm_eps"] = hf.get("layer_norm_epsilon", 1e-5)
+    kw["norm_type"] = "layernorm"
+    kw["max_position_embeddings"] = hf.get("context_length", 1024)
+    kw.setdefault("num_attention_heads", 1)
+    kw["num_key_value_heads"] = kw["num_attention_heads"]
+    kw["tie_word_embeddings"] = bool(hf.get("tie_word_embeddings", False))
+
+
+def _hf_rwkv5(hf, kw):
+    """RWKV v5 "Eagle" (trust_remote_code schema, e.g. rwkv-5-world;
+    reference models/rwkv5.py): multi-head matrix state with head_size
+    (64), gate branch, GroupNorm ln_x whose eps scales with
+    head_size_divisor."""
+    _hf_rwkv(hf, kw)
+    kw["rwkv_head_size"] = hf.get("head_size", 64)
+    kw["rwkv_group_norm_eps"] = 1e-5 * float(hf.get("head_size_divisor", 8)) ** 2
+    kw["num_attention_heads"] = kw["attention_hidden_size"] // kw["rwkv_head_size"]
+    kw["num_key_value_heads"] = kw["num_attention_heads"]
+
+
 _HF_BUILDERS = {
     "qwen2": _hf_qwen2,
     "qwen2_vl": _hf_qwen2_vl,
@@ -417,6 +454,8 @@ _HF_BUILDERS = {
     "gpt_neox": _hf_gptneox,
     "mixtral": _hf_mixtral,
     "qwen2_moe": _hf_qwen2_moe,
+    "rwkv": _hf_rwkv,
+    "rwkv5": _hf_rwkv5,
 }
 
 

@@ -1,0 +1,376 @@
+"""RWKV v4 / v5 families — attention-free recurrent language models.
+
+TPU-native re-design of the reference's patched RWKV forwards
+(/root/reference/python/llm/src/ipex_llm/transformers/models/rwkv4.py,
+rwkv5.py, backed by the native `xe_linear.rwkv_linear_attention_v4/v5`
+and `rwkv_time_shift` SYCL kernels, SURVEY.md §2.1): instead of an eager
+per-op kernel sequence, the whole block is one jitted program in which
+the FLOP-heavy projections run as batched [B,T] matmuls on the MXU and
+only the strictly-sequential WKV recurrence runs in a `lax.scan` over
+time — elementwise [B,C] (v4) / [B,H,D,D] (v5) work per step, in
+float32 for the exp-based v4 numerics.
+
+The recurrent state replaces the KV cache: `RwkvState` carries the
+per-layer time-shift vectors and WKV accumulators and satisfies the same
+structural contract as `kvcache.KVCache` (`start` field, `pos` counter),
+so `generate.generate_tokens` drives RWKV through the family `init_cache`
+hook with no RWKV-specific branches. State size is O(L*C) — independent
+of sequence length, RWKV's raison d'être for long contexts.
+
+Left-padding: positions with slot < start[b] zero their ln-ed x (so the
+first real token's time-shift reads zeros = the initial state, matching
+HF) and mask their state updates in the scan.
+
+Layer params are stacked along a leading L axis and iterated with
+`lax.scan`, like every other family (models/llama.py docstring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import linear
+from bigdl_tpu.ops.norms import layer_norm
+from bigdl_tpu.quant import QTensor, quantize
+from bigdl_tpu.quant.qtypes import resolve_qtype
+
+Params = dict[str, Any]
+
+
+def _is_v5(config: ModelConfig) -> bool:
+    return config.rwkv_head_size is not None
+
+
+def _dims(config: ModelConfig):
+    C = config.hidden_size
+    A = config.attention_hidden_size or C
+    if _is_v5(config):
+        D = config.rwkv_head_size
+        H = A // D
+    else:
+        D, H = A, 1
+    return C, A, H, D
+
+
+# ---------------------------------------------------------------------------
+# state ("cache")
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class RwkvState:
+    """Recurrent state, pytree-registered (donate/jit/shard-safe).
+
+    v4 wkv: [L, B, 3, C] float32 — (num, den, max) accumulators of the
+    numerically-stable WKV form. v5 wkv: [L, B, H, D, D] float32 — the
+    per-head outer-product state matrix.
+    """
+
+    shift_att: jax.Array  # [L, B, C] f32: x_{t-1} entering time-mix
+    shift_ffn: jax.Array  # [L, B, C] f32: x_{t-1} entering channel-mix
+    wkv: jax.Array
+    pos: jax.Array  # scalar int32: tokens consumed so far
+    start: jax.Array  # [B] int32: left-pad offsets
+
+
+def init_cache(
+    config: ModelConfig,
+    batch: int,
+    cache_len: int = 0,  # unused: state size is sequence-independent
+    quantize_kv: bool = False,  # unused: nothing grows with context
+    dtype=jnp.float32,
+) -> RwkvState:
+    L = config.num_hidden_layers
+    C, A, H, D = _dims(config)
+    if _is_v5(config):
+        wkv = jnp.zeros((L, batch, H, D, D), dtype)
+    else:
+        # (num, den, max): max starts hugely negative so the first real
+        # token overwrites it (HF inits max_state to -1e38)
+        wkv = jnp.zeros((L, batch, 3, C), dtype)
+        wkv = wkv.at[:, :, 2].set(-1e30)
+    return RwkvState(
+        shift_att=jnp.zeros((L, batch, C), dtype),
+        shift_ffn=jnp.zeros((L, batch, C), dtype),
+        wkv=wkv,
+        pos=jnp.zeros((), jnp.int32),
+        start=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# init / quantize
+# ---------------------------------------------------------------------------
+
+def init_params(
+    config: ModelConfig,
+    key: jax.Array,
+    dtype=jnp.bfloat16,
+    scale: float = 0.02,
+) -> Params:
+    """Random init (tests/benchmarks run without checkpoints)."""
+    C, A, H, D = _dims(config)
+    L, V, I = config.num_hidden_layers, config.vocab_size, config.intermediate_size
+    keys = iter(jax.random.split(key, 24))
+
+    def w(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
+
+    layers = {
+        "ln1_w": jnp.ones((L, C), dtype), "ln1_b": jnp.zeros((L, C), dtype),
+        "ln2_w": jnp.ones((L, C), dtype), "ln2_b": jnp.zeros((L, C), dtype),
+        "att_mix_k": jnp.full((L, C), 0.5, dtype),
+        "att_mix_v": jnp.full((L, C), 0.5, dtype),
+        "att_mix_r": jnp.full((L, C), 0.5, dtype),
+        "att_decay": w((L, H, D) if _is_v5(config) else (L, A)),
+        "att_first": w((L, H, D) if _is_v5(config) else (L, A)),
+        "att_k": w((L, A, C)), "att_v": w((L, A, C)), "att_r": w((L, A, C)),
+        "att_o": w((L, C, A)),
+        "ffn_mix_k": jnp.full((L, C), 0.5, dtype),
+        "ffn_mix_r": jnp.full((L, C), 0.5, dtype),
+        "ffn_k": w((L, I, C)), "ffn_r": w((L, C, C)), "ffn_v": w((L, C, I)),
+    }
+    if _is_v5(config):
+        layers["att_mix_g"] = jnp.full((L, C), 0.5, dtype)
+        layers["att_g"] = w((L, A, C))
+        layers["ln_x_w"] = jnp.ones((L, A), dtype)
+        layers["ln_x_b"] = jnp.zeros((L, A), dtype)
+    return {
+        "embed": w((V, C)),
+        "ln0_w": jnp.ones((C,), dtype), "ln0_b": jnp.zeros((C,), dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((C,), dtype),
+        "final_norm_b": jnp.zeros((C,), dtype),
+        "lm_head": w((V, C)),
+    }
+
+
+_QUANT_TARGETS = ("att_k", "att_v", "att_r", "att_g", "att_o",
+                  "ffn_k", "ffn_r", "ffn_v")
+
+
+def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = None) -> Params:
+    """Quantize the projection weights; time-mix/decay vectors and norms
+    stay dense (they are tiny and feed the f32 recurrence)."""
+    from bigdl_tpu.quant.qtypes import split_mixed_qtype
+
+    qtype, head_default = split_mixed_qtype(qtype)
+    lm_head_qtype = lm_head_qtype or head_default
+    spec = resolve_qtype(qtype)
+    if spec.is_dense:
+        return params
+    out = dict(params)
+    out["layers"] = dict(params["layers"])
+    for name in _QUANT_TARGETS:
+        w = params["layers"].get(name)
+        if w is None or isinstance(w, QTensor):
+            continue
+        out["layers"][name] = quantize(w, spec.name)
+    if "lm_head" in params and not isinstance(params["lm_head"], QTensor):
+        lm_spec = resolve_qtype(lm_head_qtype) if lm_head_qtype else spec
+        if not lm_spec.is_dense:
+            out["lm_head"] = quantize(params["lm_head"], lm_spec.name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _shift(x: jax.Array, prev: jax.Array) -> jax.Array:
+    """x_{t-1} along time: [B,T,C] with prev [B,C] filling t=0 (the
+    reference's xe_linear.rwkv_time_shift)."""
+    return jnp.concatenate([prev[:, None].astype(x.dtype), x[:, :-1]], axis=1)
+
+
+def _wkv4(k, v, real, st, w, u):
+    """v4 scalar WKV recurrence, numerically-stable log-space form
+    (matches HF rwkv_linear_attention_cpu; the reference fuses it as
+    xe_linear.rwkv_linear_attention_v4).
+
+    k, v: [T, B, A] f32 time-major; real: [T, B, 1] f32 mask;
+    st: [B, 3, A] (num, den, max); w = -exp(time_decay), u = time_first.
+    Returns (out [T, B, A], new st).
+    """
+
+    def step(carry, inp):
+        num, den, mx = carry
+        kt, vt, m = inp
+        ww = u + kt
+        q = jnp.maximum(mx, ww)
+        e1 = jnp.exp(mx - q)
+        e2 = jnp.exp(ww - q)
+        out = (e1 * num + e2 * vt) / (e1 * den + e2)
+        ww = mx + w
+        q2 = jnp.maximum(ww, kt)
+        e1 = jnp.exp(ww - q2)
+        e2 = jnp.exp(kt - q2)
+        num = jnp.where(m > 0, e1 * num + e2 * vt, num)
+        den = jnp.where(m > 0, e1 * den + e2, den)
+        mx = jnp.where(m > 0, q2, mx)
+        return (num, den, mx), out
+
+    carry = (st[:, 0], st[:, 1], st[:, 2])
+    (num, den, mx), out = jax.lax.scan(step, carry, (k, v, real))
+    return out, jnp.stack([num, den, mx], axis=1)
+
+
+def _wkv5(r, k, v, real, S, w, u):
+    """v5 multi-head matrix-state linear attention (Eagle; the reference
+    fuses it as xe_linear.rwkv_linear_attention_v5).
+
+    r, k, v: [T, B, H, D] f32 time-major; real: [T, B, 1, 1] f32;
+    S: [B, H, D, D]; w = exp(-exp(decay)) [H, D], u = time_first [H, D]
+    (both indexed by the k-dim of the state: out_t = r_t·(u⊙k_t v_tᵀ + S),
+    S ← k_t v_tᵀ + w⊙S).
+    Returns (out [T, B, H, D], new S).
+    """
+    wk = w[None, :, :, None]  # decay the k rows of the state
+    uk = u[None, :, :, None]
+
+    def step(S, inp):
+        rt, kt, vt, m = inp
+        at = kt[..., :, None] * vt[..., None, :]  # [B, H, D, D]
+        out = jnp.einsum("bhk,bhkv->bhv", rt, uk * at + S)
+        S = jnp.where(m[..., None] > 0, at + wk * S, S)
+        return S, out
+
+    S, out = jax.lax.scan(step, S, (r, k, v, real))
+    return out, S
+
+
+def forward(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32
+    cache: Optional[RwkvState],
+    mode: str = "prefill",  # static: labels the jit specialization only
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = False,
+) -> tuple[jax.Array, Optional[RwkvState]]:
+    """Returns (logits [B, T, V] float32, advanced state).
+
+    cache=None runs a stateless scoring pass (fresh zero state, no state
+    out) — the training/perplexity path.
+    """
+    assert mode in ("prefill", "decode")
+    B, T = tokens.shape
+    C, A, H, D = _dims(config)
+    eps = config.rms_norm_eps
+    v5 = _is_v5(config)
+
+    state = cache if cache is not None else init_cache(config, B)
+    slots = state.pos + jnp.arange(T)  # [T] global positions
+    real = (slots[None, :] >= state.start[:, None]).astype(jnp.float32)  # [B,T]
+    maskf = real[..., None]  # [B, T, 1]
+    real_tm = jnp.transpose(real, (1, 0))[..., None]  # [T, B, 1]
+
+    from bigdl_tpu.embedding import embed_lookup
+
+    h = embed_lookup(params["embed"], tokens, compute_dtype)
+    h = layer_norm(h, params["ln0_w"], params["ln0_b"], eps)
+
+    def body(hidden, xs):
+        p, st = xs
+
+        # ---- time mix ----
+        x = layer_norm(hidden, p["ln1_w"], p["ln1_b"], eps)
+        x = x * maskf.astype(x.dtype)  # zeroed pads = HF zero initial shift
+        xprev = _shift(x, st["shift_att"])
+
+        def mixed(name):
+            m = p[name].astype(x.dtype)
+            return x * m + xprev * (1 - m)
+
+        kx = linear(mixed("att_mix_k"), p["att_k"], None, compute_dtype)
+        vx = linear(mixed("att_mix_v"), p["att_v"], None, compute_dtype)
+        rx = linear(mixed("att_mix_r"), p["att_r"], None, compute_dtype)
+
+        k_tm = jnp.transpose(kx.astype(jnp.float32), (1, 0, 2))
+        v_tm = jnp.transpose(vx.astype(jnp.float32), (1, 0, 2))
+
+        if v5:
+            w = jnp.exp(-jnp.exp(p["att_decay"].astype(jnp.float32)))
+            u = p["att_first"].astype(jnp.float32)
+            gx = linear(mixed("att_mix_g"), p["att_g"], None, compute_dtype)
+            r_tm = jnp.transpose(rx.astype(jnp.float32), (1, 0, 2))
+            out_tm, S = _wkv5(
+                r_tm.reshape(T, B, H, D),
+                k_tm.reshape(T, B, H, D),
+                v_tm.reshape(T, B, H, D),
+                real_tm[..., None],
+                st["wkv"], w, u,
+            )
+            out = jnp.transpose(out_tm, (1, 0, 2, 3)).reshape(B, T, A)
+            # ln_x: GroupNorm over heads, per (b, t)
+            g = out.reshape(B, T, H, D)
+            mu = jnp.mean(g, axis=-1, keepdims=True)
+            var = jnp.var(g, axis=-1, keepdims=True)
+            gn_eps = config.rwkv_group_norm_eps or 1e-5
+            g = (g - mu) * jax.lax.rsqrt(var + gn_eps)
+            out = (
+                g.reshape(B, T, A) * p["ln_x_w"].astype(jnp.float32)
+                + p["ln_x_b"].astype(jnp.float32)
+            )
+            out = out.astype(compute_dtype) * jax.nn.silu(gx)
+            new_wkv = S
+        else:
+            w = -jnp.exp(p["att_decay"].astype(jnp.float32))
+            u = p["att_first"].astype(jnp.float32)
+            wkv_tm, new_wkv = _wkv4(k_tm, v_tm, real_tm, st["wkv"], w, u)
+            wkv = jnp.transpose(wkv_tm, (1, 0, 2))
+            out = jax.nn.sigmoid(rx) * wkv.astype(compute_dtype)
+
+        att_out = linear(out, p["att_o"], None, compute_dtype)
+        hidden = hidden + att_out * maskf.astype(hidden.dtype)
+        new_shift_att = x[:, -1].astype(jnp.float32)
+
+        # ---- channel mix ----
+        x = layer_norm(hidden, p["ln2_w"], p["ln2_b"], eps)
+        x = x * maskf.astype(x.dtype)
+        xprev = _shift(x, st["shift_ffn"])
+
+        def mixed2(name):
+            m = p[name].astype(x.dtype)
+            return x * m + xprev * (1 - m)
+
+        kf = linear(mixed2("ffn_mix_k"), p["ffn_k"], None, compute_dtype)
+        rf = linear(mixed2("ffn_mix_r"), p["ffn_r"], None, compute_dtype)
+        kf = jnp.square(jax.nn.relu(kf))
+        ffn_out = jax.nn.sigmoid(rf) * linear(kf, p["ffn_v"], None, compute_dtype)
+        hidden = hidden + ffn_out * maskf.astype(hidden.dtype)
+        new_shift_ffn = x[:, -1].astype(jnp.float32)
+
+        return hidden, {
+            "shift_att": new_shift_att,
+            "shift_ffn": new_shift_ffn,
+            "wkv": new_wkv,
+        }
+
+    st_tree = {
+        "shift_att": state.shift_att,
+        "shift_ffn": state.shift_ffn,
+        "wkv": state.wkv,
+    }
+    h, new_st = jax.lax.scan(body, h, (params["layers"], st_tree))
+
+    if last_logits_only:
+        h = h[:, -1:]
+    h = layer_norm(h, params["final_norm"], params["final_norm_b"], eps)
+    logits = linear(h, params["lm_head"], None, compute_dtype).astype(jnp.float32)
+
+    if cache is None:
+        return logits, None
+    new_state = RwkvState(
+        shift_att=new_st["shift_att"],
+        shift_ffn=new_st["shift_ffn"],
+        wkv=new_st["wkv"],
+        pos=state.pos + T,
+        start=state.start,
+    )
+    return logits, new_state

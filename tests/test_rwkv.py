@@ -1,0 +1,202 @@
+"""RWKV v4/v5 family tests.
+
+v4 logits check against transformers `RwkvForCausalLM` (fp32 CPU) — the
+reference's layer-equivalence oracle pattern
+(test_transformers_api_final_logits.py). v5 is not in transformers, so
+its recurrence is checked against an independent O(T²) closed form
+(out_t = r_t·(u⊙k_tv_tᵀ + Σ_{s<t} w^{t-1-s}⊙k_sv_sᵀ)), plus whole-model
+prefill↔decode state-carry consistency for both versions.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.convert import params_from_state_dict
+from bigdl_tpu.generate import GenerationConfig, generate_tokens, pad_prompts
+from bigdl_tpu.models import get_family, rwkv
+from bigdl_tpu.models.config import ModelConfig
+
+TOKENS = np.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
+
+
+def tiny_hf_rwkv4():
+    from transformers import RwkvConfig, RwkvForCausalLM
+
+    cfg = RwkvConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        attention_hidden_size=32, intermediate_size=64, context_length=64,
+    )
+    torch.manual_seed(0)
+    model = RwkvForCausalLM(cfg).eval().to(torch.float32)
+    return cfg, model
+
+
+def ours_from_hf(cfg, model):
+    config = ModelConfig.from_hf_config(cfg.to_dict())
+    sd = model.state_dict()
+    get = lambda name: sd[name].detach().to(torch.float32).numpy()
+    params = params_from_state_dict(config, get, qtype="bf16", dtype=jnp.float32)
+    return config, params
+
+
+def test_rwkv4_hf_equivalence():
+    cfg, model = tiny_hf_rwkv4()
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(TOKENS).long()).logits.numpy()
+    config, params = ours_from_hf(cfg, model)
+    assert config.model_type == "rwkv" and not rwkv._is_v5(config)
+    cache = rwkv.init_cache(config, 1)
+    logits, _ = rwkv.forward(
+        config, params, jnp.asarray(TOKENS), cache, mode="prefill",
+        compute_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-3, atol=2e-3)
+
+
+def test_rwkv4_state_carry_matches_full_prefill():
+    """prefill[:,:6] + two decode steps == full prefill (state is exact)."""
+    cfg, model = tiny_hf_rwkv4()
+    config, params = ours_from_hf(cfg, model)
+    full, _ = rwkv.forward(
+        config, params, jnp.asarray(TOKENS), rwkv.init_cache(config, 1),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    lg, st = rwkv.forward(
+        config, params, jnp.asarray(TOKENS[:, :6]), rwkv.init_cache(config, 1),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    for t in (6, 7):
+        lg, st = rwkv.forward(
+            config, params, jnp.asarray(TOKENS[:, t:t + 1]), st,
+            mode="decode", compute_dtype=jnp.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full[:, t]), rtol=1e-4, atol=1e-4
+        )
+    assert int(st.pos) == 8
+
+
+def test_rwkv4_left_padding_invariance():
+    """A left-padded row must continue identically to an unpadded one."""
+    cfg, model = tiny_hf_rwkv4()
+    config, params = ours_from_hf(cfg, model)
+    prompt = [3, 1, 4, 1, 5]
+    gen = GenerationConfig(max_new_tokens=6)
+
+    def run(prompts, bucket):
+        tokens, start = pad_prompts(prompts, pad_id=0, bucket=bucket)
+        return np.asarray(generate_tokens(
+            config, params, jnp.asarray(tokens), jnp.asarray(start),
+            jax.random.PRNGKey(0), gen, rwkv.forward, cache_len=32,
+            cache_init=rwkv.init_cache,
+        ))
+
+    a = run([prompt], 8)
+    b = run([prompt], 16)  # more left pads
+    np.testing.assert_array_equal(a[0], b[0])
+    # ragged batch: each row matches its solo run
+    c = run([prompt, [9, 2, 6]], 8)
+    np.testing.assert_array_equal(c[0], a[0])
+    d = run([[9, 2, 6]], 8)
+    np.testing.assert_array_equal(c[1], d[0])
+
+
+def test_rwkv4_registered_family():
+    fam = get_family("rwkv")
+    assert fam is rwkv and hasattr(fam, "init_cache")
+    assert get_family("rwkv5") is rwkv
+
+
+V5_CONFIG = ModelConfig(
+    model_type="rwkv5", vocab_size=64, hidden_size=32,
+    attention_hidden_size=32, rwkv_head_size=8, rwkv_group_norm_eps=64e-5,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+    intermediate_size=64, norm_type="layernorm",
+)
+
+
+def test_wkv5_recurrence_matches_closed_form():
+    rng = np.random.default_rng(0)
+    T, B, H, D = 5, 2, 3, 4
+    r, k, v = (rng.normal(size=(T, B, H, D)).astype(np.float32) for _ in range(3))
+    w = rng.uniform(0.2, 0.9, (H, D)).astype(np.float32)
+    u = rng.normal(size=(H, D)).astype(np.float32)
+    real = np.ones((T, B, 1, 1), np.float32)
+
+    out, S = rwkv._wkv5(
+        *(jnp.asarray(x) for x in (r, k, v, real)),
+        jnp.zeros((B, H, D, D), jnp.float32), jnp.asarray(w), jnp.asarray(u),
+    )
+    # closed form, O(T^2): S_t = sum_{s<t} w^{t-1-s} (k_s ⊗ v_s)
+    for t in range(T):
+        for b in range(B):
+            for h in range(H):
+                S_t = np.zeros((D, D), np.float32)
+                for s in range(t):
+                    decay = (w[h] ** (t - 1 - s))[:, None]
+                    S_t += decay * np.outer(k[s, b, h], v[s, b, h])
+                at = np.outer(k[t, b, h], v[t, b, h])
+                expect = r[t, b, h] @ (u[h][:, None] * at + S_t)
+                np.testing.assert_allclose(
+                    np.asarray(out[t, b, h]), expect, rtol=1e-4, atol=1e-4
+                )
+
+
+def test_rwkv5_state_carry_and_generate():
+    config = V5_CONFIG
+    params = rwkv.init_params(config, jax.random.PRNGKey(1), dtype=jnp.float32)
+    toks = np.asarray([[5, 9, 2, 6, 5, 3]], np.int32)
+    full, _ = rwkv.forward(
+        config, params, jnp.asarray(toks), rwkv.init_cache(config, 1),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    lg, st = rwkv.forward(
+        config, params, jnp.asarray(toks[:, :4]), rwkv.init_cache(config, 1),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    for t in (4, 5):
+        lg, st = rwkv.forward(
+            config, params, jnp.asarray(toks[:, t:t + 1]), st,
+            mode="decode", compute_dtype=jnp.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full[:, t]), rtol=1e-4, atol=1e-4
+        )
+    # generate path via the family cache_init hook
+    gen = GenerationConfig(max_new_tokens=4)
+    tokens, start = pad_prompts([[5, 9, 2]], pad_id=0)
+    out = generate_tokens(
+        config, params, jnp.asarray(tokens), jnp.asarray(start),
+        jax.random.PRNGKey(0), gen, rwkv.forward, cache_len=32,
+        cache_init=rwkv.init_cache,
+    )
+    assert out.shape == (1, 4)
+
+
+def test_rwkv_quantize_roundtrip_generates():
+    """sym_int4-quantized rwkv4 still generates (projection QTensors flow
+    through linear())."""
+    config = ModelConfig(
+        model_type="rwkv", vocab_size=64, hidden_size=64,
+        num_hidden_layers=2, num_attention_heads=1, num_key_value_heads=1,
+        intermediate_size=128, norm_type="layernorm",
+    )
+    params = rwkv.init_params(config, jax.random.PRNGKey(2))
+    qparams = rwkv.quantize_params(params, "sym_int4")
+    from bigdl_tpu.quant import QTensor
+
+    assert isinstance(qparams["layers"]["att_k"], QTensor)
+    gen = GenerationConfig(max_new_tokens=4)
+    tokens, start = pad_prompts([[1, 2, 3]], pad_id=0)
+    out = generate_tokens(
+        config, qparams, jnp.asarray(tokens), jnp.asarray(start),
+        jax.random.PRNGKey(0), gen, rwkv.forward, cache_len=32,
+        cache_init=rwkv.init_cache,
+    )
+    assert out.shape == (1, 4)
