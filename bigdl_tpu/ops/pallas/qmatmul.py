@@ -165,6 +165,16 @@ def qmatmul_int4(
         x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
 
     block_o = min(block_o, O)
+    # Mosaic scoped-VMEM budget: the kernel materializes lo/hi f32 and
+    # wl/wh bf16 expansions of the weight tile — ~12 bytes per packed
+    # element on the stack. At block_o=256, K=14336 (llama3-8b down_proj)
+    # that overflows the 16 MiB scoped limit on real v5e ("Ran out of
+    # memory in memory space vmem", BENCH r03) — a failure interpret-mode
+    # CPU tests cannot see. Shrink the O tile until the model fits in
+    # ~10 MiB, leaving headroom for x views and the scale one-hot.
+    VMEM_BUDGET = 10 * 1024 * 1024
+    while block_o > 8 and (block_o * kh * 12 > VMEM_BUDGET or O % block_o):
+        block_o //= 2
     assert O % block_o == 0, f"O={O} not divisible by block_o={block_o}"
 
     if scales.dtype == jnp.float16:
