@@ -121,6 +121,15 @@ def _host_params(config, qtype: str = "sym_int4"):
 
 
 def child_decode(preset: str) -> dict:
+    """Decode-FIRST: ms/token is the headline, and it does not need a
+    prefill program — the decode step's cost depends only on the cache
+    pos, which is seeded directly (the cache starts zeroed; attention
+    reads the same number of slots either way). Prefill/first-token is a
+    second phase attempted only if enough of the child's budget remains
+    (BENCH_CHILD_BUDGET env, set by the parent) — on a day the remote
+    compile service is slow (r03: ~300 s per 7B program), the headline
+    still banks."""
+    child_budget = float(os.environ.get("BENCH_CHILD_BUDGET", "1e9"))
     jax, device = _child_setup()
     import jax.numpy as jnp
     import numpy as np
@@ -135,18 +144,13 @@ def child_decode(preset: str) -> dict:
 
     params = _params_on_device(jax, device, config, preset)
 
-    cache0 = jax.block_until_ready(
-        jax.jit(lambda: kvcache.init_cache(
-            config.num_hidden_layers, B, cache_len,
-            config.num_key_value_heads, config.head_dim_,
-        ))()
-    )
+    cache_init_j = jax.jit(lambda: kvcache.init_cache(
+        config.num_hidden_layers, B, cache_len,
+        config.num_key_value_heads, config.head_dim_,
+    ))
+    cache0 = jax.block_until_ready(cache_init_j())
     log(f"{preset}: cache ready")
 
-    prefill_j = jax.jit(  # cache NOT donated: cache0 reused for timing
-        lambda p, t, c: llama.forward(
-            config, p, t, c, mode="prefill", last_logits_only=True)
-    )
     decode_j = jax.jit(
         lambda p, t, c: llama.forward(config, p, t, c, mode="decode"),
         donate_argnames=("c",),
@@ -163,12 +167,74 @@ def child_decode(preset: str) -> dict:
     # fetch/RPC overhead cancels exactly.
     fetch = lambda x: np.asarray(jax.device_get(x))
 
-    logits, cache = prefill_j(params, tokens, cache0)
-    fetch(logits)
-    log(f"{preset}: prefill compiled")
+    # seed a SEPARATE cache at the protocol's context depth without a
+    # prefill program: the decode step's cost depends on pos (attention
+    # span), not the (zero) cache contents. A fresh init — not a view of
+    # cache0 — because decode_j donates its cache argument and would
+    # invalidate cache0's buffers, which the optional prefill phase needs.
+    import dataclasses as _dc
+
+    cache = _dc.replace(cache_init_j(), pos=jnp.asarray(PREFILL, jnp.int32))
     logits, cache = decode_j(params, one, cache)
     fetch(logits)
-    log(f"{preset}: decode compiled")
+    log(f"{preset}: decode compiled (+{time.time() - T0:.0f}s)")
+
+    def decode_run(k):
+        nonlocal cache
+        t0 = time.perf_counter()
+        lg = logits
+        for _ in range(k):
+            lg, cache = decode_j(params, one, cache)
+        fetch(lg)
+        return (time.perf_counter() - t0) * 1000
+
+    k1, k2 = 4, 4 + DECODE
+    decode_run(k1)  # warm the dispatch path
+    t1 = decode_run(k1)
+    t2 = decode_run(k2)
+    ms_per_tok = max((t2 - t1) / (k2 - k1), 1e-3)
+    tps = 1000.0 / ms_per_tok
+    log(f"{preset}: decode {ms_per_tok:.2f} ms/token")
+
+    ctx = PREFILL + DECODE // 2
+    mfu = F.mfu(F.decode_flops_per_token(config, ctx), tps, device)
+    mbu = F.mbu(F.decode_bytes_per_token(config, ctx), tps, device)
+    result = {
+        "metric": f"{preset}_sym_int4_decode_latency",
+        "value": round(ms_per_tok, 3),
+        "unit": "ms/token",
+        "vs_baseline": round(TARGET_MS / ms_per_tok, 3),
+        "first_token_ms": None,  # filled by the optional prefill phase
+        "tokens_per_s": round(tps, 1),
+        "decode_mfu": round(mfu, 4) if mfu is not None else None,
+        "decode_mbu": round(mbu, 4) if mbu is not None else None,
+        "protocol": f"in{PREFILL}-out{DECODE} batch=1 greedy",
+        "device": getattr(device, "device_kind", str(device.platform)),
+        "pallas": os.environ.get("BIGDL_TPU_PALLAS", "auto"),
+    }
+
+    # BANK the headline NOW: if the optional prefill phase below crashes
+    # or outlives the parent's wall-clock kill, this line is already on
+    # stdout and the parent salvages it (run_child parses the captured
+    # stdout of killed/failed children). The parent takes the LAST line,
+    # so the enriched result printed by __main__ wins when phase 2 lands.
+    print(json.dumps(result), flush=True)
+
+    # optional phase 2: first-token latency (needs the prefill program —
+    # a second large compile; r03 measured ~300 s per 7B compile on a bad
+    # day, so require headroom for the documented worst case)
+    if child_budget - (time.time() - T0) < 330:
+        log(f"{preset}: skipping prefill phase "
+            f"({child_budget - (time.time() - T0):.0f}s left in budget)")
+        return result
+
+    prefill_j = jax.jit(  # cache NOT donated: cache0 reused for timing
+        lambda p, t, c: llama.forward(
+            config, p, t, c, mode="prefill", last_logits_only=True)
+    )
+    lg, _ = prefill_j(params, tokens, cache0)
+    fetch(lg)
+    log(f"{preset}: prefill compiled (+{time.time() - T0:.0f}s)")
 
     def run_prefill_and_fetch():
         t0 = time.perf_counter()
@@ -186,41 +252,9 @@ def child_decode(preset: str) -> dict:
     fetch(tiny(lg))
     t_fetch = (time.perf_counter() - t0) * 1000
     first_ms = max(run_prefill_and_fetch() - t_fetch, 0.05)
-
-    def decode_run(k):
-        nonlocal cache
-        t0 = time.perf_counter()
-        lg = logits
-        for _ in range(k):
-            lg, cache = decode_j(params, one, cache)
-        fetch(lg)
-        return (time.perf_counter() - t0) * 1000
-
-    k1, k2 = 4, 4 + DECODE
-    decode_run(k1)  # warm the dispatch path
-    t1 = decode_run(k1)
-    t2 = decode_run(k2)
-    ms_per_tok = max((t2 - t1) / (k2 - k1), 1e-3)
-    tps = 1000.0 / ms_per_tok
-    log(f"{preset}: first {first_ms:.1f} ms, decode {ms_per_tok:.2f} ms/token "
-        f"(t_fetch {t_fetch:.0f} ms cancelled)")
-
-    ctx = PREFILL + DECODE // 2
-    mfu = F.mfu(F.decode_flops_per_token(config, ctx), tps, device)
-    mbu = F.mbu(F.decode_bytes_per_token(config, ctx), tps, device)
-    return {
-        "metric": f"{preset}_sym_int4_decode_latency",
-        "value": round(ms_per_tok, 3),
-        "unit": "ms/token",
-        "vs_baseline": round(TARGET_MS / ms_per_tok, 3),
-        "first_token_ms": round(first_ms, 1),
-        "tokens_per_s": round(tps, 1),
-        "decode_mfu": round(mfu, 4) if mfu is not None else None,
-        "decode_mbu": round(mbu, 4) if mbu is not None else None,
-        "protocol": f"in{PREFILL}-out{DECODE} batch=1 greedy",
-        "device": getattr(device, "device_kind", str(device.platform)),
-        "pallas": os.environ.get("BIGDL_TPU_PALLAS", "auto"),
-    }
+    log(f"{preset}: first {first_ms:.1f} ms (t_fetch {t_fetch:.0f} ms cancelled)")
+    result["first_token_ms"] = round(first_ms, 1)
+    return result
 
 
 # --------------------------------------------------------------------------
@@ -307,25 +341,41 @@ def run_child(mode: str, preset: str, budget: float, extra_env=None):
     if extra_env:
         env.update(extra_env)
     cmd = [sys.executable, os.path.abspath(__file__), f"--{mode}", preset]
+    env["BENCH_CHILD_BUDGET"] = str(budget)
     log(f"spawn {mode}:{preset} budget={budget:.0f}s "
         f"pallas={env.get('BIGDL_TPU_PALLAS', 'auto')}")
+    def parse(stdout) -> dict | None:
+        try:
+            return json.loads(stdout.decode().strip().splitlines()[-1])
+        except Exception:
+            return None
+
     try:
         proc = subprocess.run(
             cmd, env=env, stdout=subprocess.PIPE, timeout=budget,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         log(f"{mode}:{preset} KILLED at {budget:.0f}s wall-clock")
-        return None
+        # the child banks its phase-1 headline to stdout before the
+        # optional prefill phase — salvage it from the captured pipe
+        res = parse(e.stdout) if e.stdout else None
+        if res:
+            log(f"{mode}:{preset} salvaged banked result from killed child")
+        return res
     if proc.returncode != 0:
+        res = parse(proc.stdout)
+        if res:
+            log(f"{mode}:{preset} rc={proc.returncode} but phase-1 result "
+                "was banked — salvaged")
+            return res
         log(f"{mode}:{preset} failed rc={proc.returncode}")
         return "error"  # distinguishes fast failure (retryable) from hang
-    try:
-        line = proc.stdout.decode().strip().splitlines()[-1]
-        return json.loads(line)
-    except Exception as e:
-        log(f"{mode}:{preset} unparseable stdout: {e!r}")
+    res = parse(proc.stdout)
+    if res is None:
+        log(f"{mode}:{preset} unparseable stdout")
         return "error"
+    return res
 
 
 def main() -> None:
@@ -341,11 +391,14 @@ def main() -> None:
     signal.signal(signal.SIGALRM, on_deadline)
     signal.alarm(int(TOTAL_BUDGET_S + 10))
 
-    # smallest-first; min_s = give up if less wall-clock than this remains
+    # smallest-first; min_s = give up if less wall-clock than this remains.
+    # llama2-7b is the headline (BASELINE <20 ms/token) and gets the bulk
+    # of the budget: on a slow-compile day (r03: ~300 s per 7B program
+    # through the tunnel) transfer ~100 s + decode compile must fit.
     candidates = [
         ("tiny_llama", "tiny-llama", 150, 60),
-        ("llama2_7b", "llama2-7b", 330, 150),
-        ("llama3_8b", "llama3-8b", 330, 180),
+        ("llama2_7b", "llama2-7b", 560, 150),
+        ("llama3_8b", "llama3-8b", 330, 200),
     ]
     for name, preset, budget, min_s in candidates:
         if remaining() < min_s:
