@@ -31,14 +31,21 @@ def _rows(shape) -> int:
     return n
 
 
+# nibble formats the fused GEMV kernel decodes in-kernel: sym_int4
+# arithmetically, nf4/fp4 via their static codebooks (asym_int4 has
+# per-block mins — an extra rank-1 term the kernel doesn't carry yet)
+_QGEMV_QTYPES = ("sym_int4", "nf4", "fp4")
+
+
 def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
     from bigdl_tpu.ops.pallas import use_pallas
 
-    if w.qtype != "sym_int4" or w.data.ndim != 2:
+    if w.qtype not in _QGEMV_QTYPES or w.data.ndim != 2:
         return False
     out, kh = w.data.shape
-    # K % 64: each half-split nibble plane must cover whole quant blocks
-    if out % 128 != 0 or (kh * 2) % 64 != 0:
+    block = w.spec.block_size
+    # each half-split nibble plane must cover whole quant blocks
+    if out % 128 != 0 or (kh * 2) % (2 * block) != 0:
         return False
     return _rows(x.shape) <= _GEMV_MAX_ROWS and use_pallas()
 
@@ -57,13 +64,20 @@ def linear(
     """
     if isinstance(w, QTensor):
         if _use_qgemv(x, w):
-            from bigdl_tpu.ops.pallas import qmatmul_int4
+            from bigdl_tpu.ops.pallas import qmatmul_codebook, qmatmul_int4
 
             block_o = 256 if w.data.shape[0] % 256 == 0 else 128
-            y = qmatmul_int4(
-                x.astype(compute_dtype), w.data, w.scales,
-                out_dtype=compute_dtype, block_o=block_o,
-            )
+            if w.qtype == "sym_int4":
+                y = qmatmul_int4(
+                    x.astype(compute_dtype), w.data, w.scales,
+                    out_dtype=compute_dtype, block_o=block_o,
+                )
+            else:  # nf4 / fp4: static-codebook decode in-kernel
+                y = qmatmul_codebook(
+                    x.astype(compute_dtype), w.data, w.scales,
+                    codebook=w.spec.codebook, block=w.spec.block_size,
+                    out_dtype=compute_dtype, block_o=block_o,
+                )
             if bias is not None:
                 y = y + bias.astype(compute_dtype)
             return y

@@ -150,3 +150,46 @@ def test_flash_prefill_in_model(rng, monkeypatch):
     flash_logits = run("interpret")
     ref_logits = run("0")
     np.testing.assert_allclose(flash_logits, ref_logits, atol=5e-2)
+
+
+@pytest.mark.parametrize("qtype", ["nf4", "fp4"])
+def test_qmatmul_codebook_matches_dequant(rng, qtype):
+    from bigdl_tpu.ops.pallas.qmatmul import qmatmul_codebook
+    from bigdl_tpu.quant.qtypes import resolve_qtype
+
+    K, O = 256, 256  # nf4/fp4 block 64 needs K % 128 == 0
+    x = jnp.asarray(rng.normal(size=(2, K)), jnp.float32).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(O, K)) * 0.1, jnp.float32)
+    qt = quantize(w, qtype)
+    spec = resolve_qtype(qtype)
+
+    y = qmatmul_codebook(
+        x, qt.data, qt.scales, codebook=spec.codebook,
+        block=spec.block_size, block_o=128, interpret=True,
+    )
+    ref = jnp.einsum(
+        "mk,ok->mo", x.astype(jnp.bfloat16), qt.dequantize(jnp.bfloat16),
+        preferred_element_type=jnp.bfloat16,
+    )
+    np.testing.assert_allclose(
+        np.asarray(y, jnp.float32), np.asarray(ref, jnp.float32),
+        atol=0.15, rtol=0.05,
+    )
+
+
+def test_linear_dispatch_nf4_uses_codebook_kernel(rng, monkeypatch):
+    """linear() routes decode-shaped nf4 matmuls to the codebook kernel."""
+    monkeypatch.setenv("BIGDL_TPU_PALLAS", "interpret")
+    from bigdl_tpu.ops.linear import linear, _use_qgemv
+
+    K, O = 128, 128
+    x = jnp.asarray(rng.normal(size=(1, 1, K)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(O, K)) * 0.1, jnp.float32)
+    qt = quantize(w, "nf4")
+    assert _use_qgemv(x, qt)
+    y = linear(x, qt, None, jnp.float32)
+    ref = jnp.einsum("btk,ok->bto", x, qt.dequantize(jnp.float32))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=0.05)
+    # asym_int4 (per-block mins) must NOT take the kernel path
+    qa = quantize(w, "asym_int4")
+    assert not _use_qgemv(x, qa)
