@@ -504,6 +504,110 @@ def _qwen2_moe_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndar
     }
 
 
+def _prefixed(get: Get, prefix: str) -> Get:
+    def g(name):
+        return get(prefix + name)
+    return g
+
+
+def _minicpmv_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """MiniCPM-V stores its language model under the `llm.` prefix
+    (OpenBMB MiniCPMV: self.llm = Qwen2/Llama ForCausalLM); layer layout
+    is plain llama/qwen2. Vision tower (`vpm.`) and resampler weights
+    load separately via models/minicpmv.py."""
+    return _llama_layer(config, i, _prefixed(get, "llm."))
+
+
+def _minicpmv_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    return _llama_top(config, _prefixed(get, "llm."))
+
+
+def _yuan_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Yuan-2 (yuan_hf_model.py layout): llama names + the LFA filter's
+    two Conv2d(k=(2,1)) stages, each split into its two time taps
+    ([O, C, 2, 1] -> Wa = [..., 0, 0], Wb = [..., 1, 0]) so the filter
+    runs as shift+matmul (models/yuan.py lfa_filter)."""
+    p = f"model.layers.{i}."
+    c1 = get(p + "self_attn.lf_gate.conv1.weight")  # [C/2, C, 2, 1]
+    c2 = get(p + "self_attn.lf_gate.conv2.weight")  # [C, C/2, 2, 1]
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "w_gate": get(p + "mlp.gate_proj.weight"),
+        "w_up": get(p + "mlp.up_proj.weight"),
+        "w_down": get(p + "mlp.down_proj.weight"),
+        "lf_w1a": c1[:, :, 0, 0], "lf_w1b": c1[:, :, 1, 0],
+        "lf_b1": get(p + "self_attn.lf_gate.conv1.bias"),
+        "lf_w2a": c2[:, :, 0, 0], "lf_w2b": c2[:, :, 1, 0],
+        "lf_b2": get(p + "self_attn.lf_gate.conv2.bias"),
+        "lf_norm": get(p + "self_attn.lf_gate.output_layernorm.weight"),
+    }
+
+
+def _falcon_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Falcon fused query_key_value is grouped per kv-head
+    ([q0..q_{g-1}, k, v] x num_kv, HF FalconAttention._split_heads):
+    ungroup to separate q/k/v. falcon-7b (parallel_attn, single
+    input_layernorm) duplicates that norm into attn_norm/mlp_norm —
+    exactly equivalent since both branches read the same normed input."""
+    p = f"transformer.h.{i}."
+    Hq, Hkv, D = (config.num_attention_heads, config.num_key_value_heads,
+                  config.head_dim_)
+    qkv = get(p + "self_attention.query_key_value.weight")
+    g = Hq // Hkv
+    grouped = qkv.reshape(Hkv, g + 2, D, -1)
+    wq = grouped[:, :g].reshape(Hq * D, -1)
+    wk = grouped[:, g].reshape(Hkv * D, -1)
+    wv = grouped[:, g + 1].reshape(Hkv * D, -1)
+    out = {
+        "wq": wq, "wk": wk, "wv": wv,
+        "wo": get(p + "self_attention.dense.weight"),
+        "w_up": get(p + "mlp.dense_h_to_4h.weight"),
+        "w_down": get(p + "mlp.dense_4h_to_h.weight"),
+    }
+    if config.attention_bias:
+        bqkv = get(p + "self_attention.query_key_value.bias")
+        bg = bqkv.reshape(Hkv, g + 2, D)
+        out["bq"] = bg[:, :g].reshape(Hq * D)
+        out["bk"] = bg[:, g].reshape(Hkv * D)
+        out["bv"] = bg[:, g + 1].reshape(Hkv * D)
+    if config.attention_out_bias:
+        out["bo"] = get(p + "self_attention.dense.bias")
+    if config.mlp_bias:
+        out["b_up"] = get(p + "mlp.dense_h_to_4h.bias")
+        out["b_down"] = get(p + "mlp.dense_4h_to_h.bias")
+    try:  # new_decoder_architecture: separate ln_attn / ln_mlp
+        out["attn_norm"] = get(p + "ln_attn.weight")
+        out["attn_norm_b"] = get(p + "ln_attn.bias")
+        out["mlp_norm"] = get(p + "ln_mlp.weight")
+        out["mlp_norm_b"] = get(p + "ln_mlp.bias")
+    except KeyError:
+        out["attn_norm"] = get(p + "input_layernorm.weight")
+        out["attn_norm_b"] = get(p + "input_layernorm.bias")
+        if config.parallel_residual:  # falcon-7b: one shared norm
+            out["mlp_norm"] = out["attn_norm"]
+            out["mlp_norm_b"] = out["attn_norm_b"]
+        else:  # falcon-rw sequential layout
+            out["mlp_norm"] = get(p + "post_attention_layernorm.weight")
+            out["mlp_norm_b"] = get(p + "post_attention_layernorm.bias")
+    return out
+
+
+def _falcon_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    out = {
+        "embed": get("transformer.word_embeddings.weight"),
+        "final_norm": get("transformer.ln_f.weight"),
+        "final_norm_b": get("transformer.ln_f.bias"),
+    }
+    if not config.tie_word_embeddings:
+        out["lm_head"] = get("lm_head.weight")
+    return out
+
+
 def _rwkv_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     """RWKV v4/v5 HF layout (transformers modeling_rwkv.py for v4; the
     rwkv-5-world remote-code schema adds gate + ln_x; reference
@@ -574,6 +678,9 @@ _FAMILY_LAYER = {
     "qwen2_moe": _qwen2_moe_layer,
     "rwkv": _rwkv_layer,
     "rwkv5": _rwkv_layer,
+    "falcon": _falcon_layer,
+    "yuan": _yuan_layer,
+    "minicpmv": _minicpmv_layer,
 }
 
 _FAMILY_TOP = {
@@ -587,6 +694,8 @@ _FAMILY_TOP = {
     "gpt_neox": _gptneox_top,
     "rwkv": _rwkv_top,
     "rwkv5": _rwkv_top,
+    "falcon": _falcon_top,
+    "minicpmv": _minicpmv_top,
 }
 
 
@@ -742,7 +851,8 @@ def load_hf_checkpoint(
 
 # families whose layer builders slice/merge raw arrays (fused checkpoints) —
 # they must receive fp32, never packed QTensors
-_SPLIT_FAMILIES = {"phi3", "baichuan", "internlm2", "glm", "chatglm"}
+_SPLIT_FAMILIES = {"phi3", "baichuan", "internlm2", "glm", "chatglm",
+                   "falcon"}  # falcon ungroups fused query_key_value
 
 
 def _wrap_quantized(get_tensor, quant_config: dict, model_type: str, qtype: str):

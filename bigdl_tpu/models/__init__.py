@@ -39,11 +39,24 @@ _FAMILIES = {
     "mixtral": llama,
     "qwen2_moe": llama,
     "yi": llama,
+    # parallel attn/mlp + grouped fused qkv, translated in
+    # config._hf_falcon and convert/hf._falcon_layer
+    "falcon": llama,
 }
 
 from bigdl_tpu.models import qwen2_vl  # noqa: E402  (delegates text to llama)
 
 _FAMILIES["qwen2_vl"] = qwen2_vl
+
+from bigdl_tpu.models import minicpmv  # noqa: E402  (delegates text to llama)
+
+_FAMILIES["minicpmv"] = minicpmv
+
+from bigdl_tpu.models import yuan  # noqa: E402  (LFA conv-filtered attention)
+
+# yuan's cache composes the KV cache with the conv-filter state, so it
+# has its own module + init_cache hook (models/yuan.py)
+_FAMILIES["yuan"] = yuan
 
 from bigdl_tpu.models import rwkv  # noqa: E402  (attention-free recurrence)
 

@@ -61,6 +61,10 @@ class ModelConfig:
     partial_rotary_factor: float = 1.0  # stablelm 0.25, glm 0.5
     rope_interleaved: bool = False  # GPT-NeoX/GLM pair-interleaved rope
     alibi: bool = False  # baichuan-13b/bloom attention-bias positions
+    # multiplier on the alibi bias: falcon-rw folds the 1/sqrt(head_dim)
+    # score scale into the bias too ((scores + alibi) * inv_norm_factor,
+    # HF modeling_falcon eager path); bloom/baichuan/mpt add it unscaled
+    alibi_scale: Optional[float] = None
     learned_positions: bool = False  # gpt2 wpe table (rope disabled)
     parallel_residual: bool = False  # gptneox: h += attn(x) + mlp(x)
     embed_layernorm: bool = False  # bloom word_embeddings_layernorm
@@ -403,6 +407,62 @@ def _hf_mpt(hf, kw):
         )
 
 
+def _hf_minicpmv(hf, kw):
+    """MiniCPM-V (reference models/minicpmv.py): the LLM half is
+    llama3-shaped (2_5) or qwen2-shaped (2_6, version >= 2.6 in
+    config.json); vision/resampler configs are consumed separately by
+    models/minicpmv.py. The image placeholder id comes from the
+    tokenizer's <unk>/<image> id — overridable at generate time."""
+    if float(hf.get("version", 2.6)) >= 2.6:
+        kw.setdefault("attention_bias", True)  # qwen2 qkv bias
+    kw.setdefault("image_token_id", hf.get("image_token_id", 0))
+
+
+def _hf_yuan(hf, kw):
+    """Yuan-2 (reference models/yuan.py; original schema in
+    gguf/models/model_implement/yuan2/configuration_yuan.py): llama
+    fields + LFA conv filter handled by models/yuan.py."""
+    kw.setdefault(
+        "max_position_embeddings",
+        hf.get("model_max_length", hf.get("max_position_embeddings", 8192)),
+    )
+
+
+def _hf_falcon(hf, kw):
+    """Falcon (reference gguf/models/falcon.py; HF modeling_falcon.py).
+    Three variants: falcon-rw (alibi, sequential residual), falcon-7b
+    (multi-query + parallel attn/mlp sharing ONE input layernorm — the
+    translator duplicates it into attn_norm/mlp_norm), falcon-40b/180b
+    (new_decoder_architecture: GQA + separate ln_attn/ln_mlp)."""
+    kw["num_attention_heads"] = hf.get("num_attention_heads", hf.get("n_head", 71))
+    kw["num_hidden_layers"] = hf.get("num_hidden_layers", hf.get("n_layer", 32))
+    if hf.get("new_decoder_architecture"):
+        kw["num_key_value_heads"] = hf.get("num_kv_heads", 8)
+    elif hf.get("multi_query", True):
+        kw["num_key_value_heads"] = 1
+    else:
+        kw["num_key_value_heads"] = kw["num_attention_heads"]
+    kw["intermediate_size"] = hf.get("ffn_hidden_size") or 4 * hf.get(
+        "hidden_size", 4544
+    )
+    kw["rms_norm_eps"] = hf.get("layer_norm_epsilon", 1e-5)
+    kw["norm_type"] = "layernorm"
+    kw["norm_bias"] = True
+    kw["gated_mlp"] = False
+    kw["hidden_act"] = "gelu"
+    kw["mlp_bias"] = bool(hf.get("bias", False))
+    kw["attention_bias"] = bool(hf.get("bias", False))
+    kw["attention_out_bias"] = bool(hf.get("bias", False))
+    kw["parallel_residual"] = bool(
+        hf.get("parallel_attn", True) or hf.get("new_decoder_architecture")
+    )
+    if hf.get("alibi"):
+        kw["alibi"] = True
+        head_dim = hf.get("hidden_size", 4544) // kw["num_attention_heads"]
+        kw["alibi_scale"] = head_dim ** -0.5
+    kw.setdefault("tie_word_embeddings", hf.get("tie_word_embeddings", True))
+
+
 def _hf_rwkv(hf, kw):
     """RWKV v4 (HF `rwkv` config schema: modeling_rwkv.py in
     transformers; reference models/rwkv4.py). layer_norm_epsilon feeds
@@ -456,6 +516,9 @@ _HF_BUILDERS = {
     "qwen2_moe": _hf_qwen2_moe,
     "rwkv": _hf_rwkv,
     "rwkv5": _hf_rwkv5,
+    "falcon": _hf_falcon,
+    "yuan": _hf_yuan,
+    "minicpmv": _hf_minicpmv,
 }
 
 

@@ -280,7 +280,9 @@ def merge_fused_params(params: Params, config: ModelConfig) -> Params:
 def _act(name: str, x: jax.Array) -> jax.Array:
     if name == "silu":
         return jax.nn.silu(x)
-    if name in ("gelu", "gelu_new", "gelu_pytorch_tanh", "gelu_tanh"):
+    if name == "gelu":  # HF get_activation("gelu") = exact erf gelu
+        return jax.nn.gelu(x, approximate=False)
+    if name in ("gelu_new", "gelu_pytorch_tanh", "gelu_tanh"):
         return jax.nn.gelu(x, approximate=True)
     if name == "relu":
         return jax.nn.relu(x)
@@ -606,6 +608,8 @@ def forward(
             # additive float bias: slope_h * (k_pos - q_pos), 0 on diagonal
             # (start offsets cancel in the difference)
             slopes = alibi_slopes(Hq).reshape(Hkv, Hq // Hkv)
+            if config.alibi_scale:  # falcon-rw: bias shares the score scale
+                slopes = slopes * config.alibi_scale
             dist = (k_slot - q_slot).astype(jnp.float32)  # [B, T, S]
             alibi_bias = (
                 slopes[None, :, :, None, None] * dist[:, None, None]

@@ -1,0 +1,271 @@
+"""MiniCPM-V: SigLIP vision tower + perceiver resampler over the
+minicpm/qwen2 decoder.
+
+TPU-native counterpart of the reference's minicpm-v support
+(/root/reference/python/llm/src/ipex_llm/transformers/models/minicpmv.py
+patches SiglipAttention/Idefics2VisionAttention and wraps chat/generate;
+dispatch at convert.py:1251-2027). Architecture per the OpenBMB
+implementation:
+
+- vpm: SigLIP vision transformer — Conv2d patch embed (expressed as one
+  linear over the flattened [C * p * p] patch vector), learned position
+  embeddings, pre-LN blocks (LN -> MHA -> LN -> tanh-gelu MLP), final
+  post_layernorm;
+- resampler: one cross-attention block with `query_num` learned queries
+  attending to kv-projected vision features + 2-D sincos position
+  embeddings on the keys, then LN + out-projection into the LLM hidden;
+- llm: MiniCPM-V-2_5 is llama3-shaped, 2_6 is qwen2-shaped — both served
+  by the existing llama family (weights under the `llm.` prefix,
+  translated in convert/hf._minicpmv_layer).
+
+The language model quantizes; the vision tower and resampler stay dense
+bf16/f32 (the reference likewise only low-bits the LLM for multimodal
+families, convert.py minicpmv branch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import layer_norm
+
+# the text side delegates wholesale to the llama family
+init_params = llama.init_params
+quantize_params = llama.quantize_params
+forward = llama.forward
+merge_fused_params = llama.merge_fused_params
+unmerge_fused_params = llama.unmerge_fused_params
+
+
+@dataclasses.dataclass(frozen=True)
+class SiglipConfig:
+    hidden_size: int = 1152
+    intermediate_size: int = 4304
+    num_hidden_layers: int = 27
+    num_attention_heads: int = 16
+    image_size: int = 980
+    patch_size: int = 14
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-6
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "SiglipConfig":
+        keys = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in hf.items() if k in keys})
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.num_channels * self.patch_size ** 2
+
+
+@dataclasses.dataclass(frozen=True)
+class ResamplerConfig:
+    num_queries: int = 64
+    embed_dim: int = 3584  # LLM hidden size
+    num_heads: int = 28
+    kv_dim: int = 1152  # vision hidden size
+
+
+def vision_params_from_state_dict(vcfg: SiglipConfig, get, prefix="vpm.") -> dict:
+    """HF SigLIP checkpoint names -> stacked param tree (blocks stacked
+    along a leading depth axis for lax.scan)."""
+
+    def g(name):
+        return np.asarray(get(prefix + name), np.float32)
+
+    E = vcfg.hidden_size
+    blocks: dict[str, list] = {}
+    names = [
+        ("ln1_w", "layer_norm1.weight"), ("ln1_b", "layer_norm1.bias"),
+        ("ln2_w", "layer_norm2.weight"), ("ln2_b", "layer_norm2.bias"),
+        ("wq", "self_attn.q_proj.weight"), ("bq", "self_attn.q_proj.bias"),
+        ("wk", "self_attn.k_proj.weight"), ("bk", "self_attn.k_proj.bias"),
+        ("wv", "self_attn.v_proj.weight"), ("bv", "self_attn.v_proj.bias"),
+        ("wo", "self_attn.out_proj.weight"), ("bo", "self_attn.out_proj.bias"),
+        ("fc1_w", "mlp.fc1.weight"), ("fc1_b", "mlp.fc1.bias"),
+        ("fc2_w", "mlp.fc2.weight"), ("fc2_b", "mlp.fc2.bias"),
+    ]
+    for i in range(vcfg.num_hidden_layers):
+        for key, suffix in names:
+            blocks.setdefault(key, []).append(
+                g(f"encoder.layers.{i}.{suffix}")
+            )
+    params = {
+        # Conv2d [E, C, p, p], stride == kernel -> one linear per patch
+        "patch_proj": g("embeddings.patch_embedding.weight").reshape(E, -1),
+        "patch_bias": g("embeddings.patch_embedding.bias"),
+        "pos_embed": g("embeddings.position_embedding.weight"),
+        "blocks": {k: jnp.asarray(np.stack(v)) for k, v in blocks.items()},
+        "post_ln_w": g("post_layernorm.weight"),
+        "post_ln_b": g("post_layernorm.bias"),
+    }
+    return jax.tree.map(jnp.asarray, params)
+
+
+def resampler_params_from_state_dict(get, prefix="resampler.") -> dict:
+    def g(name):
+        return jnp.asarray(np.asarray(get(prefix + name), np.float32))
+
+    return {
+        "query": g("query"),
+        "kv_proj": g("kv_proj.weight"),
+        "in_proj_w": g("attn.in_proj_weight"),
+        "in_proj_b": g("attn.in_proj_bias"),
+        "out_proj_w": g("attn.out_proj.weight"),
+        "out_proj_b": g("attn.out_proj.bias"),
+        "ln_q_w": g("ln_q.weight"), "ln_q_b": g("ln_q.bias"),
+        "ln_kv_w": g("ln_kv.weight"), "ln_kv_b": g("ln_kv.bias"),
+        "ln_post_w": g("ln_post.weight"), "ln_post_b": g("ln_post.bias"),
+        "proj": g("proj"),
+    }
+
+
+def siglip_forward(
+    vcfg: SiglipConfig,
+    vparams: dict,
+    patches: jax.Array,  # [B, N, patch_dim] flattened pixel patches
+    position_ids: Optional[jax.Array] = None,  # [B, N]; default arange
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """[B, N, patch_dim] -> [B, N, E] vision features (post_layernorm
+    applied). position_ids indexes the learned position table — MiniCPM-V
+    passes per-slice grids for adaptive resolution."""
+    B, N, _ = patches.shape
+    E, Hh, D = vcfg.hidden_size, vcfg.num_attention_heads, vcfg.head_dim
+    eps = vcfg.layer_norm_eps
+
+    h = (
+        jnp.einsum("bnd,ed->bne", patches.astype(jnp.float32),
+                   vparams["patch_proj"])
+        + vparams["patch_bias"]
+    )
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(jnp.arange(N)[None], (B, N))
+    h = h + vparams["pos_embed"][position_ids]
+
+    scale = D ** -0.5
+
+    def block(h, p):
+        x = layer_norm(h, p["ln1_w"], p["ln1_b"], eps)
+        q = (jnp.einsum("bne,fe->bnf", x, p["wq"]) + p["bq"]).reshape(B, N, Hh, D)
+        k = (jnp.einsum("bne,fe->bnf", x, p["wk"]) + p["bk"]).reshape(B, N, Hh, D)
+        v = (jnp.einsum("bne,fe->bnf", x, p["wv"]) + p["bv"]).reshape(B, N, Hh, D)
+        att = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
+        att = jax.nn.softmax(att, axis=-1)
+        ctx = jnp.einsum("bhnm,bmhd->bnhd", att, v).reshape(B, N, E)
+        h = h + jnp.einsum("bne,fe->bnf", ctx, p["wo"]) + p["bo"]
+
+        x = layer_norm(h, p["ln2_w"], p["ln2_b"], eps)
+        x = jnp.einsum("bne,fe->bnf", x, p["fc1_w"]) + p["fc1_b"]
+        x = jax.nn.gelu(x, approximate=True)  # gelu_pytorch_tanh
+        h = h + jnp.einsum("bnf,ef->bne", x, p["fc2_w"]) + p["fc2_b"]
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, vparams["blocks"])
+    h = layer_norm(h, vparams["post_ln_w"], vparams["post_ln_b"], eps)
+    return h.astype(out_dtype)
+
+
+def sincos_pos_embed_2d(embed_dim: int, h: int, w: int) -> np.ndarray:
+    """[h*w, embed_dim] 2-D sincos table (OpenBMB get_2d_sincos_pos_embed):
+    half the channels encode the h coordinate, half the w, each as
+    interleaved sin/cos over 10000^(-2i/d_half)."""
+    d_half = embed_dim // 2
+
+    def one_dim(pos):
+        omega = 1.0 / 10000 ** (np.arange(d_half // 2, dtype=np.float64)
+                                / (d_half / 2.0))
+        out = np.einsum("m,d->md", pos.reshape(-1).astype(np.float64), omega)
+        return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+    gh = np.broadcast_to(np.arange(h)[:, None], (h, w))
+    gw = np.broadcast_to(np.arange(w)[None, :], (h, w))
+    emb = np.concatenate([one_dim(gh), one_dim(gw)], axis=1)
+    return emb.astype(np.float32)  # [h*w, embed_dim]
+
+
+def resampler_forward(
+    rcfg: ResamplerConfig,
+    rparams: dict,
+    feats: jax.Array,  # [B, N, kv_dim] vision features
+    tgt_size: tuple[int, int],  # (h, w) patch grid, h*w == N
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """[B, N, kv_dim] -> [B, num_queries, embed_dim]: `query_num` learned
+    queries cross-attend to the features, keys carry a 2-D sincos
+    position embedding (OpenBMB Resampler.forward); then LN + proj."""
+    B, N, _ = feats.shape
+    E, Hh, Q = rcfg.embed_dim, rcfg.num_heads, rcfg.num_queries
+    D = E // Hh
+
+    x = jnp.einsum("bnk,ek->bne", feats.astype(jnp.float32), rparams["kv_proj"])
+    x = layer_norm(x, rparams["ln_kv_w"], rparams["ln_kv_b"], 1e-5)
+    q = layer_norm(rparams["query"], rparams["ln_q_w"], rparams["ln_q_b"], 1e-5)
+
+    pos = jnp.asarray(sincos_pos_embed_2d(E, *tgt_size))  # [N, E]
+    k_in = x + pos[None]
+    v_in = x
+
+    # torch.nn.MultiheadAttention packed in_proj: rows [q; k; v]
+    wq, wk, wv = (rparams["in_proj_w"][i * E:(i + 1) * E] for i in range(3))
+    bq, bk, bv = (rparams["in_proj_b"][i * E:(i + 1) * E] for i in range(3))
+    qh = (jnp.einsum("qe,fe->qf", q, wq) + bq).reshape(Q, Hh, D)
+    kh = (jnp.einsum("bne,fe->bnf", k_in, wk) + bk).reshape(B, N, Hh, D)
+    vh = (jnp.einsum("bne,fe->bnf", v_in, wv) + bv).reshape(B, N, Hh, D)
+
+    att = jnp.einsum("qhd,bnhd->bhqn", qh, kh) * (D ** -0.5)
+    att = jax.nn.softmax(att, axis=-1)
+    ctx = jnp.einsum("bhqn,bnhd->bqhd", att, vh).reshape(B, Q, E)
+    out = jnp.einsum("bqe,fe->bqf", ctx, rparams["out_proj_w"]) + rparams["out_proj_b"]
+
+    out = layer_norm(out, rparams["ln_post_w"], rparams["ln_post_b"], 1e-5)
+    out = jnp.einsum("bqe,ef->bqf", out, rparams["proj"])
+    return out.astype(out_dtype)
+
+
+def multimodal_prefill(
+    config: ModelConfig,
+    vcfg: SiglipConfig,
+    rcfg: ResamplerConfig,
+    params: dict,
+    vparams: dict,
+    rparams: dict,
+    input_ids: np.ndarray,  # [B, T] with image_token_id placeholders
+    patches: jax.Array,  # [B, N, patch_dim]
+    tgt_size: tuple[int, int],
+    cache,
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = True,
+):
+    """Vision tower -> resampler -> scatter the query embeddings over the
+    placeholder tokens -> standard 1-D-rope prefill (minicpm-v's LLM uses
+    plain rope — no M-RoPE)."""
+    feats = siglip_forward(vcfg, vparams, patches)
+    img = resampler_forward(rcfg, rparams, feats, tgt_size)  # [B, Q, E]
+    h = llama.embed_tokens(config, params, jnp.asarray(input_ids), compute_dtype)
+    mask = jnp.asarray(input_ids == config.image_token_id)
+    # per-row placeholder ordinal -> that row's query slot (a global
+    # cumsum would misassign whenever rows don't all carry exactly Q
+    # placeholders, e.g. a text-only row batched with an image row)
+    B = input_ids.shape[0]
+    Q = img.shape[1]
+    row_cum = jnp.cumsum(mask, axis=1) - 1  # [B, T]
+    idx = jnp.arange(B)[:, None] * Q + jnp.clip(row_cum, 0, Q - 1)
+    flat = img.reshape(-1, img.shape[-1])
+    gathered = flat[idx].astype(compute_dtype)  # [B, T, E]
+    h = jnp.where(mask[..., None], gathered, h)
+    return llama.forward(
+        config, params, h, cache, mode="prefill", input_is_hidden=True,
+        compute_dtype=compute_dtype, last_logits_only=last_logits_only,
+    )

@@ -1,0 +1,248 @@
+"""Yuan-2 family — llama-shaped decoder with Localized Filtering-based
+Attention (LFA).
+
+TPU-native re-design of the reference's patched forward
+(/root/reference/python/llm/src/ipex_llm/transformers/models/yuan.py and
+the bundled original at transformers/gguf/models/model_implement/yuan2/
+yuan_hf_model.py:46-130): before the q/k projections, the post-norm
+hidden passes a two-stage causal conv filter (kernel 2 over time) with a
+residual RMSNorm — Mega-style EMA smoothing; v projects from the
+unfiltered hidden. A kernel-2 conv over time is just `shift + matmul`,
+so the whole filter is two pairs of MXU matmuls here, no conv op.
+
+Decode needs the last TWO post-norm hiddens per layer to recompute the
+filter for the next token (the reference appends them as a third element
+of past_key_value, yuan.py:120-128). `YuanCache` composes the standard
+KVCache with that [L, B, 2, C] conv state and satisfies the
+`generate_tokens` family-cache contract (`start` field + `init_cache`
+hook), like RWKV's recurrent state.
+
+Left-padding: pad positions zero their post-norm hidden and their
+first-stage conv outputs, reproducing the reference's zero conv padding
+at the true sequence start.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.kvcache import KVCache
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import apply_rotary_emb, attention, linear, rms_norm, rope_cos_sin
+from bigdl_tpu.ops.rope import make_inv_freq_scaled
+
+Params = dict[str, Any]
+
+_NEG_INF = -1e30
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class YuanCache:
+    kv: KVCache
+    lf: jax.Array  # [L, B, 2, C] f32: last two post-norm hiddens
+    start: jax.Array  # [B] int32 (mirrored into kv at forward entry)
+
+    @property
+    def pos(self):
+        return self.kv.pos
+
+
+def init_cache(
+    config: ModelConfig,
+    batch: int,
+    cache_len: int,
+    quantize_kv: bool = False,
+    dtype=jnp.bfloat16,
+) -> YuanCache:
+    kv = kvcache.init_cache(
+        config.num_hidden_layers, batch, cache_len,
+        config.num_key_value_heads, config.head_dim_,
+        quantize_kv=quantize_kv, dtype=dtype,
+    )
+    lf = jnp.zeros(
+        (config.num_hidden_layers, batch, 2, config.hidden_size), jnp.float32
+    )
+    return YuanCache(kv=kv, lf=lf, start=kv.start)
+
+
+def init_params(
+    config: ModelConfig,
+    key: jax.Array,
+    dtype=jnp.bfloat16,
+    scale: float = 0.02,
+) -> Params:
+    """Random dense init (tests/benchmarks run without checkpoints)."""
+    L, H, I = config.num_hidden_layers, config.hidden_size, config.intermediate_size
+    V, QD, KD = config.vocab_size, config.q_dim, config.kv_dim
+    Hh = H // 2
+    keys = iter(jax.random.split(key, 24))
+
+    def w(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((L, H), dtype),
+        "mlp_norm": jnp.ones((L, H), dtype),
+        "wq": w((L, QD, H)),
+        "wk": w((L, KD, H)),
+        "wv": w((L, KD, H)),
+        "wo": w((L, H, QD)),
+        "w_gate": w((L, I, H)),
+        "w_up": w((L, I, H)),
+        "w_down": w((L, H, I)),
+        "lf_w1a": w((L, Hh, H)), "lf_w1b": w((L, Hh, H)),
+        "lf_b1": jnp.zeros((L, Hh), dtype),
+        "lf_w2a": w((L, H, Hh)), "lf_w2b": w((L, H, Hh)),
+        "lf_b2": jnp.zeros((L, H), dtype),
+        "lf_norm": jnp.ones((L, H), dtype),
+    }
+    return {
+        "embed": w((V, H)),
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype),
+        "lm_head": w((V, H)),
+    }
+
+
+# llama's quantizer covers yuan's tree: the shared wq/wk/wv/wo and
+# gate/up/down names quantize, the lf_* conv weights (absent from its
+# _QUANT_TARGETS) stay dense — they are [C/2, C] (tiny next to the
+# attention/MLP linears) and feed the f32 filter path
+quantize_params = llama.quantize_params
+
+
+def lfa_filter(x, lf_state, real, ent0_real, p, eps, compute_dtype):
+    """Localized filtering: two causal kernel-2 convs + residual RMSNorm.
+
+    x: [B, T, C] post-norm hidden, already zeroed at pad positions;
+    lf_state: [B, 2, C] the two hiddens before this chunk; real: [B, T]
+    1.0 at non-pad positions; ent0_real: [B, 1] whether slot pos-1 (the
+    first-stage entry recomputed from the state) is a real position.
+    Returns (filtered [B, T, C], new state [B, 2, C]).
+
+    conv(k=2)[t] = Wa·x[t-1] + Wb·x[t] + b — shift + two matmuls. The
+    first-stage outputs at pre-start positions are zeroed to reproduce
+    the reference's zero conv padding at the sequence start
+    (yuan_hf_model.py:99-105: `output1[:, :, :seq_len]` after pad=1) —
+    the conv BIAS would otherwise leak through zeroed inputs.
+    """
+    xf = x.astype(jnp.float32)
+    ext = jnp.concatenate([lf_state.astype(jnp.float32), xf], axis=1)  # [B,T+2,C]
+
+    def mm(v, wname):
+        return jnp.einsum("btc,oc->bto", v, p[wname].astype(jnp.float32))
+
+    # c1 entries j=0..T at positions (slot pos-1, x_0..x_{T-1})
+    c1 = mm(ext[:, :-1], "lf_w1a") + mm(ext[:, 1:], "lf_w1b")
+    c1 = c1 + p["lf_b1"].astype(jnp.float32)
+    c1_mask = jnp.concatenate([ent0_real, real], axis=1)[:, :, None]
+    c1 = c1 * c1_mask
+    # c2[t] = W2a·c1[t-1 pos] + W2b·c1[t pos], positions x_0..x_{T-1}
+    c2 = mm(c1[:, :-1], "lf_w2a") + mm(c1[:, 1:], "lf_w2b")
+    c2 = c2 + p["lf_b2"].astype(jnp.float32)
+    c2 = c2 * real[:, :, None]
+    out = rms_norm((c2 + xf).astype(compute_dtype), p["lf_norm"], eps)
+    out = out * real[:, :, None].astype(out.dtype)
+    return out, ext[:, -2:]
+
+
+def forward(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32
+    cache: Optional[YuanCache],
+    mode: str = "prefill",
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = False,
+) -> tuple[jax.Array, Optional[YuanCache]]:
+    """Returns (logits [B, T, V] float32, advanced cache)."""
+    assert mode in ("prefill", "decode")
+    B, T = tokens.shape
+    Hq, Hkv, D = (config.num_attention_heads, config.num_key_value_heads,
+                  config.head_dim_)
+    eps = config.rms_norm_eps
+
+    fresh = cache is None
+    if fresh:
+        cache = init_cache(config, B, T)
+    kv = dataclasses.replace(cache.kv, start=cache.start)
+
+    pos_col = kv.pos[:, None] if kv.pos.ndim == 1 else kv.pos
+    slots = pos_col + jnp.arange(T)[None, :]  # [B|1, T]
+    positions = kv.next_positions(T)  # [B, T]
+    real = (slots >= cache.start[:, None]).astype(jnp.float32)
+    if real.shape[0] != B:
+        real = jnp.broadcast_to(real, (B, T))
+    ent0_real = (
+        (slots[:, :1] - 1) >= cache.start[:, None]
+    ).astype(jnp.float32)
+    if ent0_real.shape[0] != B:
+        ent0_real = jnp.broadcast_to(ent0_real, (B, 1))
+
+    from bigdl_tpu.embedding import embed_lookup
+
+    h = embed_lookup(params["embed"], tokens, compute_dtype)
+
+    inv_freq, att_scale = make_inv_freq_scaled(
+        config.rotary_dim, config.rope_theta, config.rope_scaling_dict,
+        seq_len=kv.max_len,
+    )
+    cos, sin = rope_cos_sin(positions, inv_freq, scale=att_scale)
+
+    S = kv.max_len
+    sj = jnp.arange(S)
+    mask = (sj[None, None, :] <= slots[..., None]) & (
+        sj[None, None, :] >= cache.start[:, None, None]
+    )  # [B, T, S]
+    mask = mask[:, None, None]  # [B,1,1,T,S]
+
+    def proj(x, p, wname):
+        return linear(x, p[wname], None, compute_dtype)
+
+    def body(carry, xs):
+        hidden, c, idx = carry
+        p, lf_st = xs
+
+        x = rms_norm(hidden, p["attn_norm"], eps)
+        x = x * real[:, :, None].astype(x.dtype)  # zero pads for the filter
+        filtered, new_lf = lfa_filter(
+            x, lf_st, real, ent0_real, p, eps, compute_dtype
+        )
+
+        q = proj(filtered, p, "wq").reshape(B, T, Hq, D)
+        k = proj(filtered, p, "wk").reshape(B, T, Hkv, D)
+        v = proj(x, p, "wv").reshape(B, T, Hkv, D)
+        q, k = apply_rotary_emb(q, k, cos, sin, False)
+
+        c = kvcache.update_layer(c, idx, k, v)
+        k_att, v_att = kvcache.read_layer(c, idx, compute_dtype)
+        attn = attention(q, k_att, v_att, mask)
+        out = proj(attn.reshape(B, T, Hq * D), p, "wo")
+        hidden = hidden + out
+
+        x2 = rms_norm(hidden, p["mlp_norm"], eps)
+        gate = proj(x2, p, "w_gate")
+        up = proj(x2, p, "w_up")
+        hidden = hidden + proj(jax.nn.silu(gate) * up, p, "w_down")
+        return (hidden, c, idx + 1), new_lf
+
+    (h, kv, _), new_lf = jax.lax.scan(
+        body, (h, kv, jnp.zeros((), jnp.int32)), (params["layers"], cache.lf)
+    )
+
+    if last_logits_only:
+        h = h[:, -1:]
+    hN = rms_norm(h, params["final_norm"], eps)
+    logits = linear(hN, params["lm_head"], None, compute_dtype).astype(jnp.float32)
+
+    if fresh:
+        return logits, None
+    kv = kvcache.advance(kv, T)
+    return logits, YuanCache(kv=kv, lf=new_lf, start=cache.start)

@@ -24,13 +24,13 @@ from bigdl_tpu.models.config import ModelConfig
 TOKENS = np.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
 
 
-def hf_tiny(cls_name, cfg_name, **kw):
+def hf_tiny(cls_name, cfg_name, attn_impl="eager", **kw):
     import transformers
 
     cfg_cls = getattr(transformers, cfg_name)
     model_cls = getattr(transformers, cls_name)
     cfg = cfg_cls(**kw)
-    cfg._attn_implementation = "eager"
+    cfg._attn_implementation = attn_impl
     torch.manual_seed(0)
     model = model_cls(cfg).eval().to(torch.float32)
     return cfg, model
@@ -349,3 +349,49 @@ def test_internlm2_wqkv_split():
     assert np.all(k[0] == g) and np.all(k[1] == 10 + g)
     v = out["wv"].reshape(Hkv, D, H)
     assert np.all(v[0] == g + 1) and np.all(v[1] == 10 + g + 1)
+
+
+def test_falcon7b_style_equivalence():
+    """falcon-7b layout: multi-query + parallel attn/mlp sharing one
+    input layernorm, bias-free linears, non-gated gelu MLP."""
+    cfg, model = hf_tiny(
+        "FalconForCausalLM", "FalconConfig",
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False, alibi=False,
+    )
+    config = check(cfg, model)
+    assert config.num_key_value_heads == 1
+    assert config.parallel_residual and not config.gated_mlp
+
+
+def test_falcon40b_style_equivalence():
+    """falcon-40b layout: new_decoder_architecture — GQA with separate
+    ln_attn/ln_mlp, still parallel residual."""
+    cfg, model = hf_tiny(
+        "FalconForCausalLM", "FalconConfig",
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2, multi_query=False,
+        new_decoder_architecture=True, bias=False, alibi=False,
+    )
+    config = check(cfg, model)
+    assert config.num_key_value_heads == 2
+
+
+def test_falcon_rw_style_equivalence():
+    """falcon-rw layout: per-head full attention, biased linears, alibi
+    positions, sequential residual with post_attention_layernorm —
+    exercises the fused-bias ungrouping and the non-parallel fallback."""
+    # sdpa attention: this transformers version's EAGER falcon path
+    # double-applies alibi (the bias is folded into the causal mask AND
+    # added again in the module) — the sdpa path applies it once, which
+    # matches the original tiiuae falcon-rw semantics we implement
+    cfg, model = hf_tiny(
+        "FalconForCausalLM", "FalconConfig", attn_impl="sdpa",
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False, parallel_attn=False,
+        new_decoder_architecture=False, bias=True, alibi=True,
+    )
+    config = check(cfg, model)
+    assert config.alibi and not config.parallel_residual
+    assert config.attention_bias and config.mlp_bias

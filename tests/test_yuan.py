@@ -1,0 +1,173 @@
+"""Yuan-2 family tests.
+
+The LFA filter is checked against a torch Conv2d oracle that follows the
+original module's semantics exactly (yuan_hf_model.py:46-130 in the
+reference's bundled copy: Conv2d(k=(2,1), pad=(1,0)) -> [:seq_len],
+twice, + residual RMSNorm) — an independent formulation from our
+shift+matmul implementation. Whole-model checks: prefill↔decode
+state-carry equality (the [B,2,C] conv state), left-padding invariance
+through the generate path, and a quantized TpuModel smoke.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.convert import params_from_state_dict
+from bigdl_tpu.generate import GenerationConfig, generate_tokens, pad_prompts
+from bigdl_tpu.models import get_family, yuan
+from bigdl_tpu.models.config import ModelConfig
+
+CONFIG = ModelConfig(
+    model_type="yuan", vocab_size=96, hidden_size=32, intermediate_size=64,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+    max_position_embeddings=64,
+)
+
+
+def torch_lfa(x_np, w1, b1, w2, b2, nw, eps):
+    """Oracle: the original LocalizedFiltering._train_forward."""
+    conv1 = torch.nn.Conv2d(32, 16, (2, 1), padding=(1, 0))
+    conv2 = torch.nn.Conv2d(16, 32, (2, 1), padding=(1, 0))
+    with torch.no_grad():
+        conv1.weight.copy_(torch.from_numpy(w1))
+        conv1.bias.copy_(torch.from_numpy(b1))
+        conv2.weight.copy_(torch.from_numpy(w2))
+        conv2.bias.copy_(torch.from_numpy(b2))
+    x = torch.from_numpy(x_np).transpose(0, 1)  # [T, B, C]
+    T, B, C = x.shape
+    residual = x
+    inp = x.view(T, 1, B, C).permute(2, 3, 0, 1)  # [B, C, T, 1]
+    o1 = conv1(inp)[:, :, :T, :]
+    o2 = conv2(o1)[:, :, :T, :].permute(2, 3, 0, 1).reshape(T, B, C)
+    s = o2 + residual
+    var = s.pow(2).mean(-1, keepdim=True)
+    out = s * torch.rsqrt(var + eps) * torch.from_numpy(nw)
+    return out.transpose(0, 1).detach().numpy()
+
+
+def test_lfa_filter_matches_conv_oracle():
+    rng = np.random.default_rng(0)
+    B, T, C = 2, 7, 32
+    x = rng.standard_normal((B, T, C)).astype(np.float32)
+    w1 = rng.standard_normal((16, 32, 2, 1)).astype(np.float32) * 0.1
+    b1 = rng.standard_normal(16).astype(np.float32) * 0.1
+    w2 = rng.standard_normal((32, 16, 2, 1)).astype(np.float32) * 0.1
+    b2 = rng.standard_normal(32).astype(np.float32) * 0.1
+    nw = rng.standard_normal(C).astype(np.float32)
+
+    expect = torch_lfa(x, w1, b1, w2, b2, nw, 1e-6)
+
+    p = {
+        "lf_w1a": jnp.asarray(w1[:, :, 0, 0]),
+        "lf_w1b": jnp.asarray(w1[:, :, 1, 0]),
+        "lf_b1": jnp.asarray(b1),
+        "lf_w2a": jnp.asarray(w2[:, :, 0, 0]),
+        "lf_w2b": jnp.asarray(w2[:, :, 1, 0]),
+        "lf_b2": jnp.asarray(b2),
+        "lf_norm": jnp.asarray(nw),
+    }
+    real = jnp.ones((B, T), jnp.float32)
+    ent0 = jnp.zeros((B, 1), jnp.float32)  # fresh sequence: slot -1 is pad
+    out, state = yuan.lfa_filter(
+        jnp.asarray(x), jnp.zeros((B, 2, C), jnp.float32), real, ent0,
+        p, 1e-6, jnp.float32,
+    )
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(state), x[:, -2:], rtol=1e-6, atol=0)
+
+
+def _params(config):
+    return yuan.init_params(config, jax.random.PRNGKey(1), dtype=jnp.float32)
+
+
+def test_yuan_state_carry_matches_full_prefill():
+    params = _params(CONFIG)
+    toks = np.asarray([[5, 9, 2, 6, 5, 3, 8, 7]], np.int32)
+    full, _ = yuan.forward(
+        CONFIG, params, jnp.asarray(toks), yuan.init_cache(CONFIG, 1, 16),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    lg, st = yuan.forward(
+        CONFIG, params, jnp.asarray(toks[:, :5]), yuan.init_cache(CONFIG, 1, 16),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    for t in (5, 6, 7):
+        lg, st = yuan.forward(
+            CONFIG, params, jnp.asarray(toks[:, t:t + 1]), st,
+            mode="decode", compute_dtype=jnp.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full[:, t]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_yuan_left_padding_invariance():
+    params = _params(CONFIG)
+    prompt = [3, 1, 4, 1, 5]
+    gen = GenerationConfig(max_new_tokens=6)
+
+    def run(prompts, bucket):
+        tokens, start = pad_prompts(prompts, pad_id=0, bucket=bucket)
+        return np.asarray(generate_tokens(
+            CONFIG, params, jnp.asarray(tokens), jnp.asarray(start),
+            jax.random.PRNGKey(0), gen, yuan.forward, cache_len=32,
+            cache_init=yuan.init_cache,
+        ))
+
+    a = run([prompt], 8)
+    b = run([prompt], 16)
+    np.testing.assert_array_equal(a[0], b[0])
+    c = run([prompt, [9, 2, 6]], 8)
+    np.testing.assert_array_equal(c[0], a[0])
+    np.testing.assert_array_equal(c[1], run([[9, 2, 6]], 8)[0])
+
+
+def test_yuan_translator_and_quantized_generate():
+    """HF-name state dict -> params (conv tap split) -> TpuModel path."""
+    from bigdl_tpu.api import TpuModel
+
+    config = ModelConfig(
+        model_type="yuan", vocab_size=96, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+    )
+    rng = np.random.default_rng(3)
+    H, I, V = 64, 128, 96
+    sd = {}
+    for i in range(2):
+        p = f"model.layers.{i}."
+        for name, shape in [
+            ("self_attn.q_proj.weight", (H, H)),
+            ("self_attn.k_proj.weight", (H, H)),
+            ("self_attn.v_proj.weight", (H, H)),
+            ("self_attn.o_proj.weight", (H, H)),
+            ("mlp.gate_proj.weight", (I, H)),
+            ("mlp.up_proj.weight", (I, H)),
+            ("mlp.down_proj.weight", (H, I)),
+            ("self_attn.lf_gate.conv1.weight", (H // 2, H, 2, 1)),
+            ("self_attn.lf_gate.conv1.bias", (H // 2,)),
+            ("self_attn.lf_gate.conv2.weight", (H, H // 2, 2, 1)),
+            ("self_attn.lf_gate.conv2.bias", (H,)),
+        ]:
+            sd[p + name] = rng.standard_normal(shape).astype(np.float32) * 0.05
+        sd[p + "input_layernorm.weight"] = np.ones(H, np.float32)
+        sd[p + "post_attention_layernorm.weight"] = np.ones(H, np.float32)
+        sd[p + "self_attn.lf_gate.output_layernorm.weight"] = np.ones(H, np.float32)
+    sd["model.embed_tokens.weight"] = rng.standard_normal((V, H)).astype(np.float32) * 0.05
+    sd["model.norm.weight"] = np.ones(H, np.float32)
+    sd["lm_head.weight"] = rng.standard_normal((V, H)).astype(np.float32) * 0.05
+
+    params = params_from_state_dict(config, sd.__getitem__, qtype="sym_int4")
+    from bigdl_tpu.quant import QTensor
+
+    assert isinstance(params["layers"]["wq"], QTensor)
+    assert params["layers"]["lf_w1a"].shape == (2, H // 2, H)
+    m = TpuModel(config, params, "sym_int4")
+    a = m.generate([[1, 2, 3, 4]], max_new_tokens=5)
+    b = m.generate([[1, 2, 3, 4]], max_new_tokens=5)
+    np.testing.assert_array_equal(a, b)
+    assert get_family("yuan") is yuan
