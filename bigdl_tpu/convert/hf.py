@@ -699,6 +699,56 @@ _FAMILY_TOP = {
 }
 
 
+def _mllama_tree(config: ModelConfig, get: Get, quant) -> tuple[list, list, dict]:
+    """Mllama's decoder is heterogeneous: self-attn layers (llama names)
+    interleaved with cross-attn layers at config.cross_attention_layers
+    (HF modeling_mllama; reference models/mllama.py). Returns
+    (self_layer_dicts, cross_layer_dicts, top_dict) with `quant` applied
+    per layer as tensors stream in (peak host memory ~one fp32 layer) —
+    the self stack keeps llama's leaf names so models/mllama.py scans it
+    unchanged. Accepts both MllamaForCausalLM (`model.`) and
+    MllamaForConditionalGeneration (`language_model.model.`) prefixes."""
+
+    def g(name):
+        try:
+            return get(name)
+        except KeyError:
+            return get("language_model." + name)
+
+    cross_set = set(config.cross_attention_layers or ())
+    self_dicts, cross_dicts = [], []
+    for i in range(config.num_hidden_layers):
+        p = f"model.layers.{i}."
+        if i in cross_set:
+            cross_dicts.append({
+                "attn_norm": g(p + "input_layernorm.weight"),
+                "mlp_norm": g(p + "post_attention_layernorm.weight"),
+                "wq": g(p + "cross_attn.q_proj.weight"),
+                "wk": g(p + "cross_attn.k_proj.weight"),
+                "wv": g(p + "cross_attn.v_proj.weight"),
+                "wo": g(p + "cross_attn.o_proj.weight"),
+                "q_norm": g(p + "cross_attn.q_norm.weight"),
+                "k_norm": g(p + "cross_attn.k_norm.weight"),
+                "attn_gate": np.asarray(g(p + "cross_attn_attn_gate")).reshape(()),
+                "mlp_gate": np.asarray(g(p + "cross_attn_mlp_gate")).reshape(()),
+                "w_gate": g(p + "mlp.gate_proj.weight"),
+                "w_up": g(p + "mlp.up_proj.weight"),
+                "w_down": g(p + "mlp.down_proj.weight"),
+            })
+            cross_dicts[-1] = {k: quant(k, v) for k, v in cross_dicts[-1].items()}
+        else:
+            self_dicts.append(
+                {k: quant(k, v)
+                 for k, v in _llama_layer(config, i, g).items()}
+            )
+    top = {
+        "embed": g("model.embed_tokens.weight"),  # vocab_size + 8 rows
+        "final_norm": g("model.norm.weight"),
+        "lm_head": g("lm_head.weight"),
+    }
+    return self_dicts, cross_dicts, top
+
+
 def layer_tensors(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     fn = _FAMILY_LAYER.get(config.model_type, _llama_layer)
     return fn(config, i, get)
@@ -764,20 +814,36 @@ def params_from_state_dict(
             return quantize(jnp.asarray(arr, jnp.float32), use_spec.name)
         return jnp.asarray(arr).astype(dtype)
 
-    # per-layer dicts -> stacked leaves
-    per_layer: list[dict] = []
-    for i in range(config.num_hidden_layers):
-        tensors = layer_tensors(config, i, get_tensor)
-        per_layer.append({k: maybe_quant(k, v) for k, v in tensors.items()})
-    layers = {}
-    for k in per_layer[0]:
-        vals = [d[k] for d in per_layer]
-        if isinstance(vals[0], QTensor):
-            layers[k] = _stack_qtensors(vals)
-        else:
-            layers[k] = jnp.stack(vals)
+    def stack_dicts(dicts: list[dict]) -> dict:
+        """Stack already-quantized per-layer dicts along a leading axis."""
+        out = {}
+        for k in dicts[0]:
+            vals = [d[k] for d in dicts]
+            if isinstance(vals[0], QTensor):
+                out[k] = _stack_qtensors(vals)
+            else:
+                out[k] = jnp.stack(vals)
+        return out
 
-    params: dict = {"layers": layers}
+    if config.model_type in ("mllama", "mllama_text_model") \
+            and config.cross_attention_layers:
+        self_dicts, cross_dicts, top = _mllama_tree(
+            config, get_tensor, maybe_quant
+        )
+        params = {"layers": stack_dicts(self_dicts),
+                  "cross": stack_dicts(cross_dicts)}
+        for k, v in top.items():
+            params[k] = maybe_quant(k, v)
+        return params
+
+    # quantize layer by layer AS tensors stream in — peak host memory
+    # stays ~one fp32 layer, not the whole checkpoint
+    per_layer = [
+        {k: maybe_quant(k, v)
+         for k, v in layer_tensors(config, i, get_tensor).items()}
+        for i in range(config.num_hidden_layers)
+    ]
+    params = {"layers": stack_dicts(per_layer)}
     for k, v in top_tensors(config, get_tensor).items():
         params[k] = maybe_quant(k, v)
     return params

@@ -52,6 +52,11 @@ from bigdl_tpu.models import minicpmv  # noqa: E402  (delegates text to llama)
 
 _FAMILIES["minicpmv"] = minicpmv
 
+from bigdl_tpu.models import mllama  # noqa: E402  (cross-attn decoder)
+
+_FAMILIES["mllama"] = mllama
+_FAMILIES["mllama_text_model"] = mllama  # nested text_config model_type
+
 from bigdl_tpu.models import yuan  # noqa: E402  (LFA conv-filtered attention)
 
 # yuan's cache composes the KV cache with the conv-filter state, so it

@@ -78,6 +78,9 @@ class ModelConfig:
     # or force "dense" / "ragged" (models/llama.py _moe_mlp)
     moe_dispatch: Optional[str] = None
     moe_capacity_factor: float = 1.25  # ragged: slots per expert vs even load
+    # mllama (llama-3.2 vision): indices of the tanh-gated cross-attention
+    # layers interleaved into the decoder (models/mllama.py)
+    cross_attention_layers: Optional[tuple] = None
     # RWKV (v4/v5): attention-free recurrence (models/rwkv.py). head_size
     # set = v5 multi-head matrix state; None = v4 scalar WKV
     attention_hidden_size: Optional[int] = None
@@ -407,6 +410,17 @@ def _hf_mpt(hf, kw):
         )
 
 
+def _hf_mllama(hf, kw):
+    """Mllama / Llama-3.2-Vision text side (reference models/mllama.py;
+    HF MllamaTextConfig — from_hf_config already merged the nested
+    text_config). The embedding table carries 8 extra special-image rows
+    beyond vocab_size (handled by the translator); lm_head stays at
+    vocab_size."""
+    kw["cross_attention_layers"] = tuple(
+        int(i) for i in hf.get("cross_attention_layers", ())
+    )
+
+
 def _hf_minicpmv(hf, kw):
     """MiniCPM-V (reference models/minicpmv.py): the LLM half is
     llama3-shaped (2_5) or qwen2-shaped (2_6, version >= 2.6 in
@@ -519,6 +533,8 @@ _HF_BUILDERS = {
     "falcon": _hf_falcon,
     "yuan": _hf_yuan,
     "minicpmv": _hf_minicpmv,
+    "mllama": _hf_mllama,
+    "mllama_text_model": _hf_mllama,
 }
 
 

@@ -1,0 +1,363 @@
+"""Mllama (Llama-3.2-Vision) text model — llama decoder with interleaved
+tanh-gated cross-attention layers.
+
+TPU-native counterpart of the reference's mllama support
+(/root/reference/python/llm/src/ipex_llm/transformers/models/mllama.py
+patches MllamaTextCrossAttention/self-attention; dispatch at
+convert.py:1251-2027). Architecture per HF modeling_mllama:
+
+- self-attention layers: plain llama3 GQA + rope (every index NOT in
+  config.cross_attention_layers);
+- cross-attention layers: q from the hidden state with per-head RMSNorm,
+  k/v from the vision states with per-head RMSNorm on k, NO rope; the
+  attention and MLP branches re-enter the residual through
+  `tanh(gate)` scalars, and the MLP branch is zeroed for tokens whose
+  cross-attention row is fully masked (HF full_text_row_masked_out_mask;
+  those rows' attention runs UNMASKED — _prepare_cross_attention_mask
+  zeroes their -inf row, yielding uniform attention — reproduced here);
+- embed table has 8 extra special-image rows past vocab_size; lm_head
+  stays at vocab_size.
+
+Layer heterogeneity vs the scan-stacked llama family: self layers stack
+into contiguous segments separated by cross layers (positions are
+static config), so the forward runs `lax.scan` per segment with a
+layer-index offset and applies one cross layer between segments —
+compile time stays O(segments), not O(layers).
+
+`MllamaCache` composes the self-attention KVCache with the per-layer
+cross K/V (computed once from the vision states at multimodal prefill;
+`ck is None` = text-only, cross layers skip entirely — matching HF's
+layer skip when no image is present).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.kvcache import KVCache
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import apply_rotary_emb, attention, linear, rms_norm, rope_cos_sin
+from bigdl_tpu.ops.rope import make_inv_freq_scaled
+
+Params = dict[str, Any]
+
+
+def _segments(config: ModelConfig) -> list[int]:
+    """Self-layer run lengths between cross layers. cross layer s sits
+    after segment s; a trailing segment may have no cross layer."""
+    cross = list(config.cross_attention_layers or ())
+    sizes, prev = [], 0
+    for c in cross:
+        sizes.append(c - prev)
+        prev = c + 1
+    sizes.append(config.num_hidden_layers - prev)
+    return sizes
+
+
+def num_self_layers(config: ModelConfig) -> int:
+    return config.num_hidden_layers - len(config.cross_attention_layers or ())
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MllamaCache:
+    kv: KVCache  # self-attention layers only
+    ck: Optional[jax.Array]  # [S, B, N, Hkv, D] normed cross keys, or None
+    cv: Optional[jax.Array]  # [S, B, N, Hkv, D]
+    # decode-time cross state, carried from the last prefill token (HF
+    # extends the final cross_attention_mask column over generated
+    # tokens): additive mask over vision tokens + row liveness for the
+    # gated-MLP zeroing
+    cross_amask: Optional[jax.Array]  # [B, N] additive (0 = attend)
+    cross_live: Optional[jax.Array]  # [B] f32: row's cross row not dead
+    start: jax.Array  # [B]
+
+    @property
+    def pos(self):
+        return self.kv.pos
+
+
+def init_cache(
+    config: ModelConfig,
+    batch: int,
+    cache_len: int,
+    quantize_kv: bool = False,
+    dtype=jnp.bfloat16,
+) -> MllamaCache:
+    """Text-only cache (ck=None): cross layers skip, decoder == llama3."""
+    kv = kvcache.init_cache(
+        num_self_layers(config), batch, cache_len,
+        config.num_key_value_heads, config.head_dim_,
+        quantize_kv=quantize_kv, dtype=dtype,
+    )
+    return MllamaCache(kv=kv, ck=None, cv=None, cross_amask=None,
+                       cross_live=None, start=kv.start)
+
+
+def init_params(
+    config: ModelConfig,
+    key: jax.Array,
+    dtype=jnp.bfloat16,
+    scale: float = 0.02,
+) -> Params:
+    """Random init: llama tree for the self layers (num_self_layers deep)
+    + a stacked cross-layer tree."""
+    S = len(config.cross_attention_layers or ())
+    base_cfg = dataclasses.replace(
+        config, num_hidden_layers=num_self_layers(config),
+        cross_attention_layers=None,
+    )
+    params = llama.init_params(base_cfg, key, dtype, scale)
+    H, I = config.hidden_size, config.intermediate_size
+    QD, KD, D = config.q_dim, config.kv_dim, config.head_dim_
+    keys = iter(jax.random.split(jax.random.fold_in(key, 7), 16))
+
+    def w(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
+
+    if S:
+        params["cross"] = {
+            "attn_norm": jnp.ones((S, H), dtype),
+            "mlp_norm": jnp.ones((S, H), dtype),
+            "wq": w((S, QD, H)), "wk": w((S, KD, H)),
+            "wv": w((S, KD, H)), "wo": w((S, H, QD)),
+            "q_norm": jnp.ones((S, D), dtype),
+            "k_norm": jnp.ones((S, D), dtype),
+            "attn_gate": jnp.zeros((S,), dtype),
+            "mlp_gate": jnp.zeros((S,), dtype),
+            "w_gate": w((S, I, H)), "w_up": w((S, I, H)),
+            "w_down": w((S, H, I)),
+        }
+    # embed carries 8 extra special-image rows (HF vocab_size + 8)
+    V, _ = params["embed"].shape
+    extra = (jax.random.normal(next(keys), (8, H), jnp.float32) * scale).astype(dtype)
+    params["embed"] = jnp.concatenate([params["embed"], extra], axis=0)
+    return params
+
+
+def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = None) -> Params:
+    """Self layers + lm head via the llama quantizer; the cross-layer
+    projections quantize with the same body qtype."""
+    from bigdl_tpu.quant import QTensor, quantize
+    from bigdl_tpu.quant.qtypes import resolve_qtype, split_mixed_qtype
+
+    out = llama.quantize_params(params, qtype, lm_head_qtype)
+    body_qtype, _ = split_mixed_qtype(qtype)
+    spec = resolve_qtype(body_qtype)
+    if spec.is_dense or "cross" not in params:
+        return out
+    cross = dict(params["cross"])
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        wv_ = cross.get(name)
+        if wv_ is not None and not isinstance(wv_, QTensor):
+            cross[name] = quantize(wv_, spec.name)
+    out = dict(out)
+    out["cross"] = cross
+    return out
+
+
+def encode_cross_kv(
+    config: ModelConfig,
+    params: Params,
+    cross_states: jax.Array,  # [B, N, H] vision features (projected)
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array]:
+    """Per-cross-layer K/V from the vision states, k per-head-normed —
+    computed once at prefill, reused every decode step (the reference
+    caches them the same way through HF's cache plumbing)."""
+    B, N, _ = cross_states.shape
+    Hkv, D = config.num_key_value_heads, config.head_dim_
+    cp = params["cross"]
+    S = len(config.cross_attention_layers or ())
+    ks, vs = [], []
+    for s in range(S):
+        k = linear(cross_states, _slice(cp["wk"], s), None, compute_dtype)
+        v = linear(cross_states, _slice(cp["wv"], s), None, compute_dtype)
+        k = rms_norm(
+            k.reshape(B, N, Hkv, D), _slice(cp["k_norm"], s),
+            config.rms_norm_eps,
+        )
+        ks.append(k)
+        vs.append(v.reshape(B, N, Hkv, D))
+    return jnp.stack(ks), jnp.stack(vs)
+
+
+def _slice(w, s):
+    from bigdl_tpu.quant import QTensor
+
+    if isinstance(w, QTensor):
+        return QTensor(
+            data=w.data[s], scales=w.scales[s],
+            mins=None if w.mins is None else w.mins[s], qtype=w.qtype,
+        )
+    return w[s]
+
+
+def forward(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32 (or [B, T, H] with input_is_hidden)
+    cache: Optional[MllamaCache],
+    mode: str = "prefill",
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = False,
+    input_is_hidden: bool = False,
+    # prefill-only: [B, T, N] bool, True where token t may attend vision
+    # token n. Tokens with an all-False row are "dead" (HF
+    # full_text_row_masked_out_mask): their additive mask becomes all
+    # zeros (uniform attention — exactly what HF's
+    # _prepare_cross_attention_mask produces) and their gated MLP branch
+    # is zeroed. None = every token attends everything.
+    cross_mask: Optional[jax.Array] = None,
+) -> tuple[jax.Array, Optional[MllamaCache]]:
+    assert mode in ("prefill", "decode")
+    B, T = tokens.shape[:2]
+    Hq, Hkv, D = (config.num_attention_heads, config.num_key_value_heads,
+                  config.head_dim_)
+    eps = config.rms_norm_eps
+
+    fresh = cache is None
+    if fresh:
+        cache = init_cache(config, B, T, dtype=jnp.float32)
+    kv = dataclasses.replace(cache.kv, start=cache.start)
+
+    pos_col = kv.pos[:, None] if kv.pos.ndim == 1 else kv.pos
+    slots = pos_col + jnp.arange(T)[None, :]
+    positions = kv.next_positions(T)
+
+    if input_is_hidden:
+        h = tokens.astype(compute_dtype)
+    else:
+        h = llama.embed_tokens(config, params, tokens, compute_dtype)
+
+    inv_freq, att_scale = make_inv_freq_scaled(
+        config.rotary_dim, config.rope_theta, config.rope_scaling_dict,
+        seq_len=kv.max_len,
+    )
+    cos, sin = rope_cos_sin(positions, inv_freq, scale=att_scale)
+
+    Smax = kv.max_len
+    sj = jnp.arange(Smax)
+    self_mask = (sj[None, None, :] <= slots[..., None]) & (
+        sj[None, None, :] >= cache.start[:, None, None]
+    )
+    self_mask = self_mask[:, None, None]
+
+    # cross-attention additive mask + per-token row liveness, HF
+    # _prepare_cross_attention_mask semantics: dead rows' -inf collapses
+    # to all-zero (uniform attention) and their MLP branch is zeroed
+    if cache.ck is not None:
+        N = cache.ck.shape[2]
+        if cross_mask is not None:
+            live = jnp.any(cross_mask, axis=-1).astype(jnp.float32)  # [B, T]
+            amask = jnp.where(cross_mask, 0.0, -1e30) * live[..., None]
+        elif mode == "decode" and cache.cross_amask is not None:
+            live = jnp.broadcast_to(cache.cross_live[:, None], (B, T))
+            amask = jnp.broadcast_to(
+                cache.cross_amask[:, None, :], (B, T, N)
+            )
+        else:
+            live = jnp.ones((B, T), jnp.float32)
+            amask = jnp.zeros((B, T, N), jnp.float32)
+        amask5 = amask[:, None, None]  # [B, 1, 1, T, N]
+    else:
+        live = amask5 = amask = None
+
+    def self_body(carry, p):
+        hidden, c, idx = carry
+        x = rms_norm(hidden, p["attn_norm"], eps)
+        q = linear(x, p["wq"], None, compute_dtype).reshape(B, T, Hq, D)
+        k = linear(x, p["wk"], None, compute_dtype).reshape(B, T, Hkv, D)
+        v = linear(x, p["wv"], None, compute_dtype).reshape(B, T, Hkv, D)
+        q, k = apply_rotary_emb(q, k, cos, sin, False)
+        c = kvcache.update_layer(c, idx, k, v)
+        k_att, v_att = kvcache.read_layer(c, idx, compute_dtype)
+        attn = attention(q, k_att, v_att, self_mask)
+        hidden = hidden + linear(
+            attn.reshape(B, T, Hq * D), p["wo"], None, compute_dtype
+        )
+        x = rms_norm(hidden, p["mlp_norm"], eps)
+        gate = linear(x, p["w_gate"], None, compute_dtype)
+        up = linear(x, p["w_up"], None, compute_dtype)
+        hidden = hidden + linear(
+            jax.nn.silu(gate) * up, p["w_down"], None, compute_dtype
+        )
+        return (hidden, c, idx + 1), None
+
+    def cross_body(hidden, s):
+        cp = params["cross"]
+        x = rms_norm(hidden, _slice(cp["attn_norm"], s), eps)
+        q = linear(x, _slice(cp["wq"], s), None, compute_dtype).reshape(B, T, Hq, D)
+        q = rms_norm(q, _slice(cp["q_norm"], s), eps)
+        attn = attention(q, cache.ck[s].astype(compute_dtype),
+                         cache.cv[s].astype(compute_dtype), amask5)
+        out = linear(attn.reshape(B, T, Hq * D), _slice(cp["wo"], s), None,
+                     compute_dtype)
+        g_attn = jnp.tanh(cp["attn_gate"][s].astype(jnp.float32)).astype(compute_dtype)
+        hidden = hidden + g_attn * out
+
+        x = rms_norm(hidden, _slice(cp["mlp_norm"], s), eps)
+        gate = linear(x, _slice(cp["w_gate"], s), None, compute_dtype)
+        up = linear(x, _slice(cp["w_up"], s), None, compute_dtype)
+        mlp = linear(jax.nn.silu(gate) * up, _slice(cp["w_down"], s), None,
+                     compute_dtype)
+        g_mlp = jnp.tanh(cp["mlp_gate"][s].astype(jnp.float32)).astype(compute_dtype)
+        return hidden + g_mlp * mlp * live[..., None].astype(compute_dtype)
+
+    sizes = _segments(config)
+    off = 0
+    idx = jnp.asarray(0, jnp.int32)
+    for si, size in enumerate(sizes):
+        if size:
+            # QTensor is a pytree node, so the map slices data/scales too
+            seg = jax.tree.map(lambda a: a[off:off + size], params["layers"])
+            (h, kv, idx), _ = jax.lax.scan(self_body, (h, kv, idx), seg)
+            off += size
+        if si < len(sizes) - 1 and cache.ck is not None:
+            h = cross_body(h, si)
+
+    if last_logits_only:
+        h = h[:, -1:]
+    logits = llama.lm_head_logits(config, params, h, compute_dtype)
+
+    if fresh:
+        return logits, None
+    kv = kvcache.advance(kv, T)
+    cache = dataclasses.replace(cache, kv=kv)
+    if cache.ck is not None and mode == "prefill":
+        # generated tokens inherit the last prompt token's cross row
+        # (HF extends the final cross_attention_mask column)
+        cache = dataclasses.replace(
+            cache, cross_amask=amask[:, -1], cross_live=live[:, -1]
+        )
+    return logits, cache
+
+
+def multimodal_prefill(
+    config: ModelConfig,
+    params: Params,
+    input_ids,  # [B, T]
+    cross_states: jax.Array,  # [B, N, H] projected vision features
+    cache_len: int,
+    cross_mask: Optional[jax.Array] = None,  # [B, T, N] bool
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = True,
+):
+    """Encode the cross K/V once, then prefill. Returns (logits, cache)
+    ready for plain decode steps (cross K/V and the last token's cross
+    row ride in the cache)."""
+    B, T = input_ids.shape
+    base = init_cache(config, B, cache_len, dtype=compute_dtype)
+    ck, cv = encode_cross_kv(config, params, cross_states, compute_dtype)
+    cache = dataclasses.replace(base, ck=ck, cv=cv)
+    return forward(
+        config, params, jnp.asarray(input_ids), cache, mode="prefill",
+        compute_dtype=compute_dtype, last_logits_only=last_logits_only,
+        cross_mask=cross_mask,
+    )

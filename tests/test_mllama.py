@@ -1,0 +1,165 @@
+"""Mllama (Llama-3.2-Vision) text-model tests against transformers'
+MllamaForCausalLM (fp32 CPU eager): text-only (cross layers skipped),
+full cross-attention, dead-row masking (HF full_text_row_masked_out_mask
+semantics), and decode state-carry through the composite cache.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.convert import params_from_state_dict
+from bigdl_tpu.models import get_family, mllama
+from bigdl_tpu.models.config import ModelConfig
+
+TOKENS = np.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
+
+
+def tiny_hf():
+    from transformers import MllamaForCausalLM
+    from transformers.models.mllama.configuration_mllama import MllamaTextConfig
+
+    cfg = MllamaTextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        cross_attention_layers=[1, 3], max_position_embeddings=64,
+        pad_token_id=0,
+        rope_theta=10000.0, rope_scaling={"rope_type": "default"},
+    )
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    model = MllamaForCausalLM(cfg).eval().to(torch.float32)
+    # zero-init gates make cross layers invisible; give them signal
+    with torch.no_grad():
+        for i in (1, 3):
+            model.model.layers[i].cross_attn_attn_gate.fill_(0.5)
+            model.model.layers[i].cross_attn_mlp_gate.fill_(-0.3)
+    return cfg, model
+
+
+def ours_from_hf(cfg, model):
+    config = ModelConfig.from_hf_config(cfg.to_dict())
+    assert config.cross_attention_layers == (1, 3)
+    sd = model.state_dict()
+    get = lambda name: sd[name].detach().to(torch.float32).numpy()
+    params = params_from_state_dict(config, get, qtype="bf16", dtype=jnp.float32)
+    return config, params
+
+
+CROSS_N = 6  # vision tokens
+
+
+def hf_run(model, tokens, cross=None, amask=None, row_live=None):
+    kw = {}
+    if cross is not None:
+        kw["cross_attention_states"] = torch.from_numpy(cross)
+        if amask is not None:
+            kw["cross_attention_mask"] = torch.from_numpy(amask)
+        if row_live is not None:
+            kw["full_text_row_masked_out_mask"] = torch.from_numpy(row_live)
+    with torch.no_grad():
+        return model(torch.from_numpy(tokens).long(), **kw).logits.numpy()
+
+
+def test_mllama_text_only_equivalence():
+    cfg, model = tiny_hf()
+    config, params = ours_from_hf(cfg, model)
+    hf_logits = hf_run(model, TOKENS)
+    cache = mllama.init_cache(config, 1, 16, dtype=jnp.float32)
+    logits, _ = mllama.forward(
+        config, params, jnp.asarray(TOKENS), cache, mode="prefill",
+        compute_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-3, atol=2e-3)
+
+
+def test_mllama_cross_attention_equivalence():
+    cfg, model = tiny_hf()
+    config, params = ours_from_hf(cfg, model)
+    rng = np.random.default_rng(0)
+    cross = rng.standard_normal((1, CROSS_N, 64)).astype(np.float32)
+
+    hf_logits = hf_run(model, TOKENS, cross)
+    logits, cache = mllama.multimodal_prefill(
+        config, params, TOKENS, jnp.asarray(cross), cache_len=16,
+        compute_dtype=jnp.float32, last_logits_only=False,
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-3, atol=2e-3)
+
+    # decode continues with cached cross KV and matches HF's next step
+    with torch.no_grad():
+        from transformers import DynamicCache
+
+        pkv = DynamicCache(config=model.config)
+        model(torch.from_numpy(TOKENS).long(),
+              cross_attention_states=torch.from_numpy(cross),
+              past_key_values=pkv, use_cache=True)
+        nxt = model(torch.tensor([[7]]), past_key_values=pkv,
+                    use_cache=True).logits.numpy()
+    lg, cache = mllama.forward(
+        config, params, jnp.asarray([[7]], np.int32), cache, mode="decode",
+        compute_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(np.asarray(lg[:, -1]), nxt[:, -1],
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_mllama_dead_row_masking():
+    """Tokens before the image (all-False cross rows) get uniform
+    attention + zeroed MLP branch, exactly HF's preprocessed-mask
+    behavior."""
+    cfg, model = tiny_hf()
+    config, params = ours_from_hf(cfg, model)
+    rng = np.random.default_rng(1)
+    cross = rng.standard_normal((1, CROSS_N, 64)).astype(np.float32)
+
+    T = TOKENS.shape[1]
+    allowed = np.zeros((1, T, CROSS_N), bool)
+    allowed[:, 3:, :4] = True  # tokens 0-2 dead; later tokens see 4 tiles
+
+    live = allowed.any(-1).astype(np.float32)  # [1, T]
+    amask = np.where(allowed, 0.0, np.finfo(np.float32).min).astype(np.float32)
+    amask = amask * live[..., None]  # dead rows -> all zeros (HF)
+    hf_logits = hf_run(
+        model, TOKENS, cross,
+        amask=amask[:, None],  # [B, 1, T, N]
+        row_live=live[:, None, :, None].astype(np.float32),  # [B, 1, T, 1]
+    )
+    logits, _ = mllama.multimodal_prefill(
+        config, params, TOKENS, jnp.asarray(cross), cache_len=16,
+        cross_mask=jnp.asarray(allowed), compute_dtype=jnp.float32,
+        last_logits_only=False,
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-3, atol=2e-3)
+
+
+def test_mllama_registered_and_quantizes():
+    fam = get_family("mllama")
+    assert fam is mllama and hasattr(fam, "init_cache")
+    config = ModelConfig(
+        model_type="mllama", vocab_size=96, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=2, cross_attention_layers=(1,),
+    )
+    params = mllama.init_params(config, jax.random.PRNGKey(0))
+    assert params["embed"].shape[0] == 96 + 8
+    q = mllama.quantize_params(params, "sym_int4")
+    from bigdl_tpu.quant import QTensor
+
+    assert isinstance(q["layers"]["wq"], QTensor)
+    assert isinstance(q["cross"]["wq"], QTensor)
+    # text-only generate through the family cache hook
+    from bigdl_tpu.generate import GenerationConfig, generate_tokens, pad_prompts
+
+    tokens, start = pad_prompts([[1, 2, 3]], pad_id=0)
+    out = generate_tokens(
+        config, q, jnp.asarray(tokens), jnp.asarray(start),
+        jax.random.PRNGKey(0), GenerationConfig(max_new_tokens=4),
+        mllama.forward, cache_len=32, cache_init=mllama.init_cache,
+    )
+    assert out.shape == (1, 4)
