@@ -62,6 +62,12 @@ def _child_setup():
     """Shared child preamble: compile cache + params-on-device helper.
     Returns (jax, device). One definition so decode and train children
     can never drift apart in jax config."""
+    # Python's default SIGTERM disposition kills the process without
+    # finalization; convert it to SystemExit so the PJRT destructors run
+    # and the device claim is released — otherwise the parent's
+    # timeout-terminate leaves a stale tunnel lease that wedges every
+    # subsequent claim for minutes (observed r03).
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     import jax
 
