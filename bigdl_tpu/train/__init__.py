@@ -16,6 +16,7 @@ from bigdl_tpu.train.recipes import (
     sample_lisa_mask,
 )
 from bigdl_tpu.train.dpo import dpo_loss, make_dpo_step, sequence_logprob
+from bigdl_tpu.train.galore import GaLoreState, galore
 
 __all__ = [
     "init_lora",
@@ -31,4 +32,6 @@ __all__ = [
     "dpo_loss",
     "make_dpo_step",
     "sequence_logprob",
+    "GaLoreState",
+    "galore",
 ]

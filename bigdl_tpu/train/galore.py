@@ -1,0 +1,152 @@
+"""GaLore — Gradient Low-Rank Projection as an optax transform.
+
+Counterpart of the reference's GaLore finetuning recipe
+(/root/reference/python/llm/example/GPU/LLM-Finetuning/GaLore/, which
+drives the galore-torch AdamW8bit optimizer): full-parameter training at
+LoRA-like optimizer memory by running the inner optimizer in a low-rank
+subspace of the gradient. Per 2-D weight G [m, n]:
+
+    P   <- top-r singular vectors of G (recomputed every
+           `update_proj_gap` steps; projects the SMALLER side)
+    low <- project(G, P)              # [r, n] or [m, r]
+    upd <- inner.update(low)          # Adam moments live at rank r
+    dW  <- scale * back_project(upd)
+
+TPU-native formulation: the projector refresh is a `lax.cond`-guarded
+`jnp.linalg.svd` inside the jitted update (no host sync, works under
+pjit — XLA computes the SVD on device), and the whole thing composes as
+a standard `optax.GradientTransformation`, so it drops into the existing
+full-FT train step (train/recipes.py make_full_train_step).
+
+Non-2-D leaves (norms, biases, stacked-scan 3-D weights below the rank
+threshold... anything is_projected rejects) pass through the inner
+optimizer unprojected, matching galore-torch's param-group split.
+
+The inner transform must not require the parameter values (the moments
+live at projected shapes, where no real params exist): use
+`optax.adam` / `optax.scale_by_adam`, and compose weight decay OUTSIDE
+the projection — where galore-torch also applies it:
+
+    optax.chain(galore(optax.scale_by_adam(), rank=128),
+                optax.add_decayed_weights(1e-2), optax.scale(-lr))
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class GaLoreState(NamedTuple):
+    step: jax.Array  # scalar int32
+    proj: dict  # per-leaf projector (None for pass-through leaves)
+    inner: optax.OptState  # inner optimizer state over projected shapes
+
+
+def _is_projected(p, rank: int) -> bool:
+    # stacked-scan layers are [L, O, I]: project per layer over (O, I)
+    return p.ndim in (2, 3) and min(p.shape[-2:]) > rank
+
+
+def _orient_left(p) -> bool:
+    # project the smaller side: left (rows) when m <= n
+    return p.shape[-2] <= p.shape[-1]
+
+
+def _project(g, P, left: bool):
+    if left:  # P [..., m, r]
+        return jnp.einsum("...mr,...mn->...rn", P, g)
+    return jnp.einsum("...mn,...nr->...mr", g, P)  # P [..., n, r]
+
+
+def _back(low, P, left: bool):
+    if left:
+        return jnp.einsum("...mr,...rn->...mn", P, low)
+    return jnp.einsum("...mr,...nr->...mn", low, P)
+
+
+def _svd_projector(g, rank: int, left: bool):
+    gf = g.astype(jnp.float32)
+    if not left:
+        gf = jnp.swapaxes(gf, -1, -2)  # svd of g^T: U spans the n side
+    u, _, _ = jnp.linalg.svd(gf, full_matrices=False)
+    return u[..., :rank]
+
+
+def galore(
+    inner: optax.GradientTransformation,
+    rank: int = 128,
+    update_proj_gap: int = 200,
+    scale: float = 0.25,
+) -> optax.GradientTransformation:
+    """Wrap `inner` (e.g. optax.adam / optax.scale_by_adam — NOT adamw;
+    see the module docstring for weight-decay composition) with GaLore
+    projection."""
+
+    def proj_shape(p):
+        if not _is_projected(p, rank):
+            return p
+        if _orient_left(p):
+            return jnp.zeros((*p.shape[:-2], rank, p.shape[-1]), p.dtype)
+        return jnp.zeros((*p.shape[:-2], p.shape[-2], rank), p.dtype)
+
+    def init(params):
+        # pass-through leaves get a zero-size placeholder (None would be
+        # an empty pytree node and break multi-tree maps)
+        proj = jax.tree.map(
+            lambda p: (
+                jnp.zeros(
+                    (*p.shape[:-2], p.shape[-2] if _orient_left(p)
+                     else p.shape[-1], rank),
+                    jnp.float32,
+                )
+                if _is_projected(p, rank) else jnp.zeros((0,), jnp.float32)
+            ),
+            params,
+        )
+        virtual = jax.tree.map(proj_shape, params)
+        return GaLoreState(
+            step=jnp.zeros((), jnp.int32), proj=proj,
+            inner=inner.init(virtual),
+        )
+
+    def update(grads, state, params=None):
+        refresh = state.step % update_proj_gap == 0
+
+        def upd_proj(g, P):
+            if P.size == 0:
+                return P
+            left = _orient_left(g)
+            return jax.lax.cond(
+                refresh,
+                lambda: _svd_projector(g, rank, left),
+                lambda: P,
+            )
+
+        proj = jax.tree.map(upd_proj, grads, state.proj)
+
+        def low_g(g, P):
+            if P.size == 0:
+                return g
+            return _project(g.astype(jnp.float32), P, _orient_left(g)).astype(g.dtype)
+
+        low = jax.tree.map(low_g, grads, proj)
+        # params=None: moments live at projected shapes (see module doc)
+        low_upd, inner_state = inner.update(low, state.inner)
+
+        def full_upd(u, P, g):
+            if P.size == 0:
+                return u
+            return (
+                scale * _back(u.astype(jnp.float32), P, _orient_left(g))
+            ).astype(u.dtype)
+
+        updates = jax.tree.map(full_upd, low_upd, proj, grads)
+        return updates, GaLoreState(
+            step=state.step + 1, proj=proj, inner=inner_state
+        )
+
+    return optax.GradientTransformation(init, update)
