@@ -1,0 +1,144 @@
+"""LongBench-style long-context evaluation.
+
+Counterpart of the reference's LongBench harness
+(/root/reference/python/llm/dev/benchmark/LongBench/pred.py): score a
+model on long-document tasks by (1) middle-truncating over-long prompts
+to the model's window — keeping the head and tail halves, where
+LongBench puts the instruction and the question — (2) greedy-generating
+an answer, (3) scoring with the task metric. The three metric families
+LongBench uses most (token-F1 for QA, Rouge-L for summarization, exact
+classification accuracy) are implemented here self-contained, so the
+harness needs no external eval dependency.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Callable, Optional, Sequence
+
+
+def middle_truncate(tokens: Sequence[int], max_len: int) -> list[int]:
+    """Keep the first and last max_len/2 tokens (LongBench pred.py:
+    `prompt[:half] + prompt[-half:]` on the tokenized prompt) — the
+    instruction preamble and the trailing question both survive."""
+    tokens = list(tokens)
+    if len(tokens) <= max_len:
+        return tokens
+    half = max_len // 2
+    return tokens[:half] + tokens[len(tokens) - (max_len - half):]
+
+
+def _normalize(text: str) -> list[str]:
+    """Lowercase word tokens; CJK segments split per CHARACTER (the
+    LongBench reference scores zh tasks with qa_f1_zh_score, which is
+    character-level — treating a run of hanzi as one token would
+    degenerate F1 to exact match)."""
+    text = text.lower()
+    text = re.sub(r"([一-鿿])", r" \1 ", text)
+    text = re.sub(r"[^a-z0-9一-鿿]+", " ", text)
+    return text.split()
+
+
+def qa_f1_score(prediction: str, ground_truths: Sequence[str]) -> float:
+    """Token-level F1 against the best-matching reference (LongBench
+    metrics.py qa_f1_score)."""
+    best = 0.0
+    pred = _normalize(prediction)
+    for gt in ground_truths:
+        ref = _normalize(gt)
+        if not pred or not ref:
+            best = max(best, float(pred == ref))
+            continue
+        common = Counter(pred) & Counter(ref)
+        overlap = sum(common.values())
+        if overlap == 0:
+            continue
+        p = overlap / len(pred)
+        r = overlap / len(ref)
+        best = max(best, 2 * p * r / (p + r))
+    return best
+
+
+def rouge_l(prediction: str, ground_truths: Sequence[str]) -> float:
+    """Rouge-L F1 via longest common subsequence (LongBench rouge_score
+    for summarization tasks)."""
+    best = 0.0
+    pred = _normalize(prediction)
+    for gt in ground_truths:
+        ref = _normalize(gt)
+        if not pred or not ref:
+            best = max(best, float(pred == ref))
+            continue
+        # O(len(pred)*len(ref)) LCS with a rolling row
+        prev = [0] * (len(ref) + 1)
+        for a in pred:
+            cur = [0]
+            for j, b in enumerate(ref, 1):
+                cur.append(max(prev[j], cur[-1], prev[j - 1] + (a == b)))
+            prev = cur
+        lcs = prev[-1]
+        if lcs == 0:
+            continue
+        p = lcs / len(pred)
+        r = lcs / len(ref)
+        best = max(best, 2 * p * r / (p + r))
+    return best
+
+
+def classification_score(prediction: str, ground_truths: Sequence[str]) -> float:
+    """1.0 iff any reference appears verbatim in the prediction
+    (LongBench classification_score for trec/lsht-style tasks)."""
+    pred = prediction.lower()
+    return float(any(gt.lower() in pred for gt in ground_truths))
+
+
+METRICS: dict[str, Callable[[str, Sequence[str]], float]] = {
+    "qa_f1": qa_f1_score,
+    "rouge_l": rouge_l,
+    "classification": classification_score,
+}
+
+
+def evaluate_longbench(
+    model,
+    tokenizer,
+    samples: Sequence[dict],
+    metric: str = "qa_f1",
+    max_prompt_len: int = 3500,
+    max_new_tokens: int = 64,
+    eos_token_id: Optional[int] = None,
+    stop_newline: bool = False,
+    batch_size: int = 4,
+) -> dict:
+    """samples: [{"prompt": str, "answers": [str, ...]}, ...] (the
+    flattened LongBench jsonl schema). Returns {"score", "n", "metric"}.
+
+    model: TpuModel (api.py); tokenizer: anything with encode()/decode().
+    Prompts middle-truncate to max_prompt_len; generation is greedy
+    (LongBench pred.py uses do_sample=False)."""
+    score_fn = METRICS[metric]
+    scores: list[float] = []
+    for i in range(0, len(samples), batch_size):
+        chunk = samples[i:i + batch_size]
+        prompts = [
+            middle_truncate(tokenizer.encode(s["prompt"]), max_prompt_len)
+            for s in chunk
+        ]
+        out = model.generate(
+            prompts, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id,
+        )
+        for s, row in zip(chunk, out):
+            ids = [int(t) for t in row]
+            if eos_token_id is not None and eos_token_id in ids:
+                ids = ids[: ids.index(eos_token_id)]
+            text = tokenizer.decode(ids)
+            if stop_newline:  # several LongBench tasks cut at first newline
+                text = text.split("\n")[0]
+            scores.append(score_fn(text, s["answers"]))
+    return {
+        "metric": metric,
+        "n": len(scores),
+        "score": float(sum(scores) / max(len(scores), 1)),
+    }
