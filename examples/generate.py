@@ -1,0 +1,44 @@
+"""Low-bit generate — the reference's first example
+(example/GPU/HuggingFace/LLM/llama2: from_pretrained(load_in_4bit) +
+generate), TPU-native.
+
+    python examples/generate.py [/path/to/hf-checkpoint] [qtype]
+"""
+
+import sys
+
+import jax
+
+
+def load(path, qtype):
+    if path:
+        from bigdl_tpu import AutoModelForCausalLM
+
+        return AutoModelForCausalLM.from_pretrained(path, load_in_low_bit=qtype)
+    # no checkpoint: tiny random model (same code path post-quantization)
+    from bigdl_tpu.api import TpuModel, optimize_model
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+
+    cfg = PRESETS["tiny-llama"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return TpuModel(cfg, optimize_model(params, cfg, low_bit=qtype), qtype)
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else None
+    qtype = sys.argv[2] if len(sys.argv) > 2 else "sym_int4"
+    model = load(path, qtype)
+
+    prompt = [1, 15043, 29892, 590, 1024, 338]  # llama2 "Hello, my name is"
+    greedy = model.generate([prompt], max_new_tokens=32)
+    print("greedy :", greedy[0].tolist())
+    sampled = model.generate(
+        [prompt], max_new_tokens=32, do_sample=True, temperature=0.8,
+        top_p=0.95, seed=7,
+    )
+    print("sampled:", sampled[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""GGUF import — the reference's GGUF example
+(example/GPU/HuggingFace/Advanced-Quantizations/GGUF: from_gguf):
+llama.cpp blocks repack zero-dequant into QTensors.
+
+    python examples/gguf_import.py /path/to/model.gguf
+"""
+
+import sys
+
+from bigdl_tpu.api import TpuModel
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        print("(no .gguf path given — nothing to do; see tests/test_gguf.py "
+              "for synthetic round-trip coverage)")
+        return
+    model = TpuModel.from_gguf(sys.argv[1])
+    out = model.generate([[1]], max_new_tokens=32)
+    print(out[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

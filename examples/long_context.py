@@ -1,0 +1,36 @@
+"""Long-context levers — the reference's Long-Context example set
+(example/GPU/Long-Context; IPEX_LLM_QUANTIZE_KV_CACHE /
+IPEX_LLM_COMPRESS_KV_CACHE): FP8-quantized KV cache and SnapKV prompt
+compression, both per-call kwargs here.
+
+    python examples/long_context.py
+"""
+
+import jax
+
+from bigdl_tpu.api import TpuModel, optimize_model
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS
+
+
+def main():
+    cfg = PRESETS["tiny-llama"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    model = TpuModel(cfg, optimize_model(params, cfg), "sym_int4")
+
+    long_prompt = list(range(2, 98))  # longer than the SnapKV budget below
+
+    out = model.generate([long_prompt], max_new_tokens=16)
+    print("dense KV       :", out[0].tolist())
+
+    out_fp8 = model.generate([long_prompt], max_new_tokens=16, quantize_kv=True)
+    print("fp8 KV         :", out_fp8[0].tolist())
+
+    # SnapKV: prompt KV compressed to 48 slots before decode — decode-time
+    # cache size becomes independent of the prompt length
+    out_snap = model.generate([long_prompt], max_new_tokens=16, compress_kv=48)
+    print("snapkv (48)    :", out_snap[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""QLoRA finetune — the reference's QLoRA recipe
+(example/GPU/LLM-Finetuning/QLoRA: nf4 base + LoRA adapters through
+peft) as one jitted train step over a frozen quantized base.
+
+    python examples/qlora_finetune.py [/path/to/hf-checkpoint]
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS
+from bigdl_tpu.train import init_lora, make_train_step
+
+
+def main():
+    if len(sys.argv) > 1:
+        from bigdl_tpu.convert import load_hf_checkpoint
+
+        config, params, _ = load_hf_checkpoint(sys.argv[1], qtype="nf4")
+    else:
+        config = PRESETS["tiny-llama"]
+        params = llama.quantize_params(
+            llama.init_params(config, jax.random.PRNGKey(0)), "nf4"
+        )
+
+    lora = init_lora(config, jax.random.PRNGKey(1), rank=8)
+    optimizer = optax.adamw(1e-4)
+    opt_state = optimizer.init(lora["layers"])
+    step = jax.jit(
+        make_train_step(config, llama.forward, optimizer),
+        donate_argnames=("lora", "opt_state"),
+    )
+
+    rng = np.random.default_rng(0)
+    B, T = 2, 64
+    for i in range(5):
+        tokens = jnp.asarray(
+            rng.integers(1, config.vocab_size, (B, T + 1)), jnp.int32
+        )
+        mask = jnp.ones((B, T + 1), jnp.float32)
+        lora, opt_state, loss = step(params, lora, opt_state, tokens, mask)
+        print(f"step {i}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Quantize once, reload in seconds — the reference's Save-Load example
+(example/GPU/HuggingFace/Save-Load: save_low_bit/load_low_bit).
+
+    python examples/save_load_low_bit.py [/path/to/hf-checkpoint]
+"""
+
+import sys
+import tempfile
+
+import jax
+import numpy as np
+
+from bigdl_tpu.api import TpuModel, optimize_model
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS
+
+
+def main():
+    if len(sys.argv) > 1:
+        from bigdl_tpu import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            sys.argv[1], load_in_low_bit="sym_int4"
+        )
+    else:
+        cfg = PRESETS["tiny-llama"]
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        model = TpuModel(cfg, optimize_model(params, cfg), "sym_int4")
+
+    prompt = [3, 1, 4, 1, 5, 9]
+    before = model.generate([prompt], max_new_tokens=16)
+
+    with tempfile.TemporaryDirectory() as d:
+        model.save_low_bit(d)
+        from bigdl_tpu import AutoModelForCausalLM
+        reloaded = AutoModelForCausalLM.load_low_bit(d)
+        after = reloaded.generate([prompt], max_new_tokens=16)
+
+    assert np.array_equal(before, after), "reload must be bit-identical"
+    print("reload bit-identical:", after[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Continuous-batching serving — the reference's Lightweight-Serving
+example (serving/fastapi): submit concurrent requests with per-request
+sampling into the slot engine; the OpenAI/TGI HTTP servers (cli.py
+`serve`) wrap this same engine.
+
+    python examples/serving.py
+"""
+
+import jax
+
+from bigdl_tpu.api import TpuModel, optimize_model
+from bigdl_tpu.generate import GenerationConfig
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS
+from bigdl_tpu.serving.engine import InferenceEngine
+
+
+def main():
+    cfg = PRESETS["tiny-llama"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    model = TpuModel(cfg, optimize_model(params, cfg), "sym_int4")
+
+    engine = InferenceEngine(model, n_slots=4, max_len=128,
+                             gen=GenerationConfig())
+    reqs = [
+        engine.submit([3, 1, 4, 1, 5], max_new_tokens=12),
+        engine.submit([9, 2, 6], max_new_tokens=8, do_sample=True,
+                      temperature=0.7),
+        engine.submit([5, 3, 5], max_new_tokens=10, top_k=20,
+                      do_sample=True),
+    ]
+    engine.run_until_idle()
+    for r in reqs:
+        print(f"request {r.rid}: {r.out_tokens} ({r.finish_reason})")
+
+
+if __name__ == "__main__":
+    main()
