@@ -40,6 +40,9 @@ _QUANT_TARGETS = {
     "w_gate_e", "w_up_e", "w_down_e", "w_gate_s", "w_up_s", "w_down_s",
     # rwkv projections (models/rwkv.py)
     "att_k", "att_v", "att_r", "att_g", "att_o", "ffn_k", "ffn_r", "ffn_v",
+    # MLA projections (models/deepseek.py; the per-head w_uk/w_uv factors
+    # stay dense — they are absorbed into f32 attention math)
+    "w_dq", "w_uq", "w_dkv",
 }
 
 Get = Callable[[str], np.ndarray]
@@ -749,6 +752,75 @@ def _mllama_tree(config: ModelConfig, get: Get, quant) -> tuple[list, list, dict
     return self_dicts, cross_dicts, top
 
 
+def _deepseek_tree(config: ModelConfig, get: Get, quant) -> tuple[list, list, dict]:
+    """DeepSeek-V2/V3 / MiniCPM3 (HF modeling_deepseek_v2/v3; reference
+    models/minicpm3.py): MLA projections per layer — kv_b_proj splits
+    into the per-head W_uk/W_uv factors models/deepseek.py absorbs — and
+    a heterogeneous stack: the first first_k_dense_replace layers carry
+    a dense MLP, the rest DeepSeek-MoE. Returns (dense_dicts, moe_dicts,
+    top) with `quant` applied per layer as tensors stream in."""
+    from bigdl_tpu.models.deepseek import _dims, num_dense_layers
+
+    H, dn, dr, dv, r = _dims(config)
+    K = num_dense_layers(config)
+
+    def attn(p):
+        out = {
+            "attn_norm": get(p + "input_layernorm.weight"),
+            "mlp_norm": get(p + "post_attention_layernorm.weight"),
+            "w_dkv": get(p + "self_attn.kv_a_proj_with_mqa.weight"),
+            "kv_norm": get(p + "self_attn.kv_a_layernorm.weight"),
+            "wo": get(p + "self_attn.o_proj.weight"),
+        }
+        kvb = np.asarray(get(p + "self_attn.kv_b_proj.weight"))
+        kvb = kvb.reshape(H, dn + dv, r)
+        out["w_uk"] = kvb[:, :dn]
+        out["w_uv"] = kvb[:, dn:]
+        if config.q_lora_rank:
+            out["w_dq"] = get(p + "self_attn.q_a_proj.weight")
+            out["q_norm"] = get(p + "self_attn.q_a_layernorm.weight")
+            out["w_uq"] = get(p + "self_attn.q_b_proj.weight")
+        else:
+            out["wq"] = get(p + "self_attn.q_proj.weight")
+        return out
+
+    dense_dicts, moe_dicts = [], []
+    for i in range(config.num_hidden_layers):
+        p = f"model.layers.{i}."
+        d = attn(p)
+        if i < K:
+            d["w_gate"] = get(p + "mlp.gate_proj.weight")
+            d["w_up"] = get(p + "mlp.up_proj.weight")
+            d["w_down"] = get(p + "mlp.down_proj.weight")
+            dense_dicts.append({k: quant(k, v) for k, v in d.items()})
+        else:
+            E = config.num_experts
+            d["router"] = get(p + "mlp.gate.weight")
+            if (config.topk_method or "") == "noaux_tc":
+                d["e_bias"] = get(p + "mlp.gate.e_score_correction_bias")
+            d["w_gate_e"] = np.stack(
+                [get(p + f"mlp.experts.{e}.gate_proj.weight") for e in range(E)]
+            )
+            d["w_up_e"] = np.stack(
+                [get(p + f"mlp.experts.{e}.up_proj.weight") for e in range(E)]
+            )
+            d["w_down_e"] = np.stack(
+                [get(p + f"mlp.experts.{e}.down_proj.weight") for e in range(E)]
+            )
+            if config.n_shared_experts:
+                d["w_gate_s"] = get(p + "mlp.shared_experts.gate_proj.weight")
+                d["w_up_s"] = get(p + "mlp.shared_experts.up_proj.weight")
+                d["w_down_s"] = get(p + "mlp.shared_experts.down_proj.weight")
+            moe_dicts.append({k: quant(k, v) for k, v in d.items()})
+    top = {
+        "embed": get("model.embed_tokens.weight"),
+        "final_norm": get("model.norm.weight"),
+    }
+    if not config.tie_word_embeddings:
+        top["lm_head"] = get("lm_head.weight")
+    return dense_dicts, moe_dicts, top
+
+
 def layer_tensors(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     fn = _FAMILY_LAYER.get(config.model_type, _llama_layer)
     return fn(config, i, get)
@@ -832,6 +904,19 @@ def params_from_state_dict(
         )
         params = {"layers": stack_dicts(self_dicts),
                   "cross": stack_dicts(cross_dicts)}
+        for k, v in top.items():
+            params[k] = maybe_quant(k, v)
+        return params
+
+    if config.model_type in ("deepseek_v2", "deepseek_v3", "minicpm3"):
+        dense_dicts, moe_dicts, top = _deepseek_tree(
+            config, get_tensor, maybe_quant
+        )
+        params = {}
+        if dense_dicts:
+            params["layers"] = stack_dicts(dense_dicts)
+        if moe_dicts:
+            params["moe_layers"] = stack_dicts(moe_dicts)
         for k, v in top.items():
             params[k] = maybe_quant(k, v)
         return params

@@ -57,6 +57,12 @@ from bigdl_tpu.models import mllama  # noqa: E402  (cross-attn decoder)
 _FAMILIES["mllama"] = mllama
 _FAMILIES["mllama_text_model"] = mllama  # nested text_config model_type
 
+from bigdl_tpu.models import deepseek  # noqa: E402  (MLA latent-KV cache)
+
+_FAMILIES["deepseek_v2"] = deepseek
+_FAMILIES["deepseek_v3"] = deepseek
+_FAMILIES["minicpm3"] = deepseek
+
 from bigdl_tpu.models import yuan  # noqa: E402  (LFA conv-filtered attention)
 
 # yuan's cache composes the KV cache with the conv-filter state, so it

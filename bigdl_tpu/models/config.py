@@ -81,6 +81,21 @@ class ModelConfig:
     # mllama (llama-3.2 vision): indices of the tanh-gated cross-attention
     # layers interleaved into the decoder (models/mllama.py)
     cross_attention_layers: Optional[tuple] = None
+    # MLA (deepseek v2/v3, minicpm3 — models/deepseek.py): latent KV
+    # compression ranks and split head dims; kv_lora_rank set = MLA
+    q_lora_rank: Optional[int] = None
+    kv_lora_rank: Optional[int] = None
+    qk_nope_head_dim: Optional[int] = None
+    qk_rope_head_dim: Optional[int] = None
+    v_head_dim: Optional[int] = None
+    # DeepSeek-MoE routing (models/deepseek.py _router)
+    n_group: Optional[int] = None
+    topk_group: Optional[int] = None
+    topk_method: Optional[str] = None  # greedy|group_limited_greedy|noaux_tc
+    scoring_func: str = "softmax"  # v3: sigmoid
+    routed_scaling_factor: float = 1.0
+    first_k_dense_replace: int = 0
+    n_shared_experts: Optional[int] = None  # ungated, n * moe_intermediate
     # RWKV (v4/v5): attention-free recurrence (models/rwkv.py). head_size
     # set = v5 multi-head matrix state; None = v4 scalar WKV
     attention_hidden_size: Optional[int] = None
@@ -410,6 +425,48 @@ def _hf_mpt(hf, kw):
         )
 
 
+def _mla_fields(hf, kw):
+    for f in ("q_lora_rank", "kv_lora_rank", "qk_nope_head_dim",
+              "qk_rope_head_dim", "v_head_dim"):
+        if hf.get(f) is not None:
+            kw[f] = hf[f]
+    kw["rope_interleaved"] = True  # DeepSeek complex-pair rope
+
+
+def _hf_deepseek_v2(hf, kw):
+    """DeepSeek-V2 (HF modeling_deepseek_v2; the reference's minicpm3.py
+    implements the same MLA): latent-KV attention + DeepSeek-MoE with
+    group-limited greedy routing and ungated shared experts."""
+    _mla_fields(hf, kw)
+    kw["num_experts"] = hf.get("n_routed_experts") or 0
+    kw["num_experts_per_tok"] = hf.get("num_experts_per_tok") or 2
+    kw["moe_intermediate_size"] = hf.get("moe_intermediate_size")
+    kw["n_shared_experts"] = hf.get("n_shared_experts")
+    kw["first_k_dense_replace"] = hf.get("first_k_dense_replace", 0)
+    kw["topk_method"] = hf.get("topk_method", "greedy")
+    kw["n_group"] = hf.get("n_group")
+    kw["topk_group"] = hf.get("topk_group")
+    kw["routed_scaling_factor"] = hf.get("routed_scaling_factor", 1.0)
+    kw["norm_topk_prob"] = hf.get("norm_topk_prob", False)
+    kw["scoring_func"] = hf.get("scoring_func", "softmax")
+    if hf.get("moe_layer_freq", 1) != 1:
+        raise NotImplementedError("deepseek moe_layer_freq != 1")
+
+
+def _hf_deepseek_v3(hf, kw):
+    _hf_deepseek_v2(hf, kw)
+    kw["topk_method"] = hf.get("topk_method", "noaux_tc")
+    kw["scoring_func"] = hf.get("scoring_func", "sigmoid")
+    kw["norm_topk_prob"] = hf.get("norm_topk_prob", True)
+
+
+def _hf_minicpm3(hf, kw):
+    """MiniCPM3 (reference models/minicpm3.py): MLA attention + the
+    minicpm residual/embedding/logit scalings, dense MLP."""
+    _hf_minicpm(hf, kw)
+    _mla_fields(hf, kw)
+
+
 def _hf_mllama(hf, kw):
     """Mllama / Llama-3.2-Vision text side (reference models/mllama.py;
     HF MllamaTextConfig — from_hf_config already merged the nested
@@ -535,6 +592,9 @@ _HF_BUILDERS = {
     "minicpmv": _hf_minicpmv,
     "mllama": _hf_mllama,
     "mllama_text_model": _hf_mllama,
+    "deepseek_v2": _hf_deepseek_v2,
+    "deepseek_v3": _hf_deepseek_v3,
+    "minicpm3": _hf_minicpm3,
 }
 
 

@@ -419,6 +419,27 @@ def _moe_dispatch_ragged(
     return out.reshape(B, T, H)
 
 
+def _moe_dispatch_dense(
+    config: ModelConfig, xc: jax.Array, p: Params, compute_dtype,
+    topv: jax.Array, topi: jax.Array,
+) -> jax.Array:
+    """Dense combine: every expert computes every token, top-k weights
+    (zero for unrouted) scatter into a [B,T,E] combine matrix —
+    all-matmul, MXU-friendly, exactly differentiable. Best at small E.
+    Shared by the llama-family router and the DeepSeek router
+    (models/deepseek.py)."""
+    onehot = jax.nn.one_hot(topi, config.num_experts, dtype=jnp.float32)
+    combine = jnp.einsum("btk,btke->bte", topv, onehot)
+    wg = _deq(p["w_gate_e"], compute_dtype)  # [E, I, H]
+    wu = _deq(p["w_up_e"], compute_dtype)
+    wd = _deq(p["w_down_e"], compute_dtype)  # [E, H, I]
+    g = jnp.einsum("bth,eih->btei", xc, wg, preferred_element_type=compute_dtype)
+    u = jnp.einsum("bth,eih->btei", xc, wu, preferred_element_type=compute_dtype)
+    z = _act(config.hidden_act, g) * u
+    d = jnp.einsum("btei,ehi->bteh", z, wd, preferred_element_type=compute_dtype)
+    return jnp.einsum("bteh,bte->bth", d, combine.astype(compute_dtype))
+
+
 def _moe_mlp(config: ModelConfig, x: jax.Array, p: Params, compute_dtype) -> jax.Array:
     """Mixture-of-experts MLP (reference models/mixtral.py, qwen2_moe.py +
     `xe_linear.get_moe_indexes`): top-k routing with softmax weights.
@@ -438,17 +459,7 @@ def _moe_mlp(config: ModelConfig, x: jax.Array, p: Params, compute_dtype) -> jax
     if resolve_moe_dispatch(config) == "ragged":
         out = _moe_dispatch_ragged(config, xc, p, compute_dtype, topv, topi)
     else:
-        # scatter top-k weights back to a dense [B,T,E] combine matrix
-        onehot = jax.nn.one_hot(topi, config.num_experts, dtype=jnp.float32)
-        combine = jnp.einsum("btk,btke->bte", topv, onehot)
-        wg = _deq(p["w_gate_e"], compute_dtype)  # [E, I, H]
-        wu = _deq(p["w_up_e"], compute_dtype)
-        wd = _deq(p["w_down_e"], compute_dtype)  # [E, H, I]
-        g = jnp.einsum("bth,eih->btei", xc, wg, preferred_element_type=compute_dtype)
-        u = jnp.einsum("bth,eih->btei", xc, wu, preferred_element_type=compute_dtype)
-        z = _act(config.hidden_act, g) * u
-        d = jnp.einsum("btei,ehi->bteh", z, wd, preferred_element_type=compute_dtype)
-        out = jnp.einsum("bteh,bte->bth", d, combine.astype(compute_dtype))
+        out = _moe_dispatch_dense(config, xc, p, compute_dtype, topv, topi)
 
     if config.shared_expert_intermediate_size:
         # qwen2_moe shared expert, sigmoid-gated (models/qwen2_moe.py)

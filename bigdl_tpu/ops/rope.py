@@ -57,9 +57,21 @@ def yarn_scaled_inv_freq(
     beta_fast: float = 32.0,
     beta_slow: float = 1.0,
     original_max_position: int = 4096,
+    attention_factor: Optional[float] = None,
+    mscale: Optional[float] = None,
+    mscale_all_dim: Optional[float] = None,
 ) -> tuple[jax.Array, float]:
     """YaRN (deepseek/qwen long-context): NTK-by-parts interpolation plus an
-    attention temperature (returned as mscale; multiply cos/sin by it)."""
+    attention temperature (returned as mscale; multiply cos/sin by it).
+
+    The temperature follows HF _compute_yarn_parameters exactly:
+    explicit `attention_factor` wins; else deepseek-style
+    mscale/mscale_all_dim give get_mscale(f, m)/get_mscale(f, m_all);
+    else the standard 0.1*ln(f)+1. (DeepSeek checkpoints ship
+    mscale == mscale_all_dim, so their ratio is 1.0 — the official
+    remote code instead folds mscale^2 into softmax_scale over ALL
+    channels, a known divergence from HF; we match HF, our test
+    oracle.)"""
 
     def find_dim(num_rot):
         return (
@@ -76,8 +88,20 @@ def yarn_scaled_inv_freq(
     )
     interp = inv_freq / factor  # fully interpolated (long range)
     inv = interp * ramp + inv_freq * (1 - ramp)
-    mscale = 0.1 * math.log(factor) + 1.0 if factor > 1.0 else 1.0
-    return inv, mscale
+
+    def get_mscale(scale, m=1.0):
+        if scale <= 1.0 or m == 0:
+            return 1.0
+        return 0.1 * m * math.log(scale) + 1.0
+
+    if attention_factor is not None:
+        att = float(attention_factor)
+    elif mscale is not None or mscale_all_dim is not None:
+        att = get_mscale(factor, mscale if mscale is not None else 1.0) / \
+            get_mscale(factor, mscale_all_dim if mscale_all_dim is not None else 1.0)
+    else:
+        att = get_mscale(factor)
+    return inv, att
 
 
 def make_inv_freq(
@@ -141,6 +165,9 @@ def make_inv_freq_scaled(
             original_max_position=rope_scaling.get(
                 "original_max_position_embeddings", 4096
             ),
+            attention_factor=rope_scaling.get("attention_factor"),
+            mscale=rope_scaling.get("mscale"),
+            mscale_all_dim=rope_scaling.get("mscale_all_dim"),
         )
     if rope_type in ("longrope", "su"):
         # phi3 long/short per-frequency factors

@@ -1,0 +1,198 @@
+"""DeepSeek-V2/V3 (MLA + DeepSeek-MoE) tests against transformers'
+DeepseekV2ForCausalLM / DeepseekV3ForCausalLM (fp32 CPU eager).
+
+The absorbed-latent attention must match HF's expanded K/V formulation
+exactly (it is the same linear algebra); routing covers greedy,
+group_limited_greedy, and noaux_tc with the correction bias. Plus decode
+state-carry through the latent cache and the family generate hook.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.convert import params_from_state_dict
+from bigdl_tpu.generate import GenerationConfig, generate_tokens, pad_prompts
+from bigdl_tpu.models import deepseek, get_family
+from bigdl_tpu.models.config import ModelConfig
+
+TOKENS = np.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
+
+MLA_KW = dict(
+    vocab_size=128, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=4,
+    q_lora_rank=24, kv_lora_rank=16, qk_nope_head_dim=16,
+    qk_rope_head_dim=8, v_head_dim=16, max_position_embeddings=64,
+    rope_theta=10000.0,
+)
+
+
+def hf_model(cls_name, cfg_name, **extra):
+    import transformers
+
+    cfg = getattr(transformers, cfg_name)(**{**MLA_KW, **extra})
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    model = getattr(transformers, cls_name)(cfg).eval().to(torch.float32)
+    return cfg, model
+
+
+def ours(cfg, model):
+    config = ModelConfig.from_hf_config(cfg.to_dict())
+    sd = model.state_dict()
+    get = lambda name: sd[name].detach().to(torch.float32).numpy()
+    params = params_from_state_dict(config, get, qtype="bf16", dtype=jnp.float32)
+    return config, params
+
+
+def check(cfg, model, tol=3e-3):
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(TOKENS).long()).logits.numpy()
+    config, params = ours(cfg, model)
+    cache = deepseek.init_cache(config, 1, 16, dtype=jnp.float32)
+    logits, _ = deepseek.forward(
+        config, params, jnp.asarray(TOKENS), cache, mode="prefill",
+        compute_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=tol, atol=tol)
+    return config, params
+
+
+def test_deepseek_v2_dense_equivalence():
+    """All-dense (first_k_dense_replace >= L): pure MLA decoder."""
+    cfg, model = hf_model(
+        "DeepseekV2ForCausalLM", "DeepseekV2Config",
+        n_routed_experts=4, first_k_dense_replace=3,  # all layers dense
+        moe_intermediate_size=32, n_shared_experts=1,
+    )
+    config, _ = check(cfg, model)
+    assert config.kv_lora_rank == 16 and config.rope_interleaved
+
+
+def test_deepseek_v2_moe_equivalence():
+    """Dense first layer + 2 MoE layers, group_limited_greedy routing."""
+    cfg, model = hf_model(
+        "DeepseekV2ForCausalLM", "DeepseekV2Config",
+        n_routed_experts=8, num_experts_per_tok=2, first_k_dense_replace=1,
+        moe_intermediate_size=32, n_shared_experts=1,
+        topk_method="group_limited_greedy", n_group=4, topk_group=2,
+        routed_scaling_factor=1.5, norm_topk_prob=False,
+    )
+    config, _ = check(cfg, model)
+    assert config.first_k_dense_replace == 1
+    assert config.topk_method == "group_limited_greedy"
+
+
+def test_deepseek_v3_noaux_equivalence():
+    """V3: sigmoid scores, noaux_tc top2-sum group selection with
+    e_score_correction_bias, normalized + scaled weights."""
+    cfg, model = hf_model(
+        "DeepseekV3ForCausalLM", "DeepseekV3Config",
+        n_routed_experts=8, num_experts_per_tok=2, first_k_dense_replace=1,
+        moe_intermediate_size=32, n_shared_experts=1,
+        n_group=4, topk_group=2, routed_scaling_factor=2.0,
+        norm_topk_prob=True,
+    )
+    # a nonzero correction bias exercises the select-vs-weight split
+    with torch.no_grad():
+        for layer in model.model.layers[1:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.2, 0.2)
+    config, _ = check(cfg, model)
+    assert config.topk_method == "noaux_tc" and config.scoring_func == "sigmoid"
+
+
+def test_mla_decode_state_carry():
+    cfg, model = hf_model(
+        "DeepseekV2ForCausalLM", "DeepseekV2Config",
+        n_routed_experts=4, first_k_dense_replace=1,
+        moe_intermediate_size=32, n_shared_experts=1,
+    )
+    config, params = ours(cfg, model)
+    full, _ = deepseek.forward(
+        config, params, jnp.asarray(TOKENS), deepseek.init_cache(config, 1, 16, dtype=jnp.float32),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    lg, st = deepseek.forward(
+        config, params, jnp.asarray(TOKENS[:, :5]),
+        deepseek.init_cache(config, 1, 16, dtype=jnp.float32),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    for t in (5, 6, 7):
+        lg, st = deepseek.forward(
+            config, params, jnp.asarray(TOKENS[:, t:t + 1]), st,
+            mode="decode", compute_dtype=jnp.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full[:, t]), rtol=3e-4, atol=3e-4
+        )
+
+
+def test_minicpm3_config_and_generate():
+    """minicpm3 = MLA + minicpm scalings, via the family generate hook
+    with sym_int4 quantization (no HF oracle: not in transformers)."""
+    hf = dict(
+        model_type="minicpm3", vocab_size=96, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        q_lora_rank=32, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, scale_emb=2.0, scale_depth=1.4,
+        dim_model_base=32,
+    )
+    config = ModelConfig.from_hf_config(hf)
+    assert config.kv_lora_rank == 32 and config.embedding_scale == 2.0
+    assert get_family("minicpm3") is deepseek
+    params = deepseek.quantize_params(
+        deepseek.init_params(config, jax.random.PRNGKey(0)), "sym_int4"
+    )
+    from bigdl_tpu.quant import QTensor
+
+    assert isinstance(params["layers"]["w_uq"], QTensor)
+    assert not isinstance(params["layers"]["w_uk"], QTensor)  # stays dense
+    tokens, start = pad_prompts([[1, 2, 3, 4]], pad_id=0)
+    out = generate_tokens(
+        config, params, jnp.asarray(tokens), jnp.asarray(start),
+        jax.random.PRNGKey(0), GenerationConfig(max_new_tokens=5),
+        deepseek.forward, cache_len=32, cache_init=deepseek.init_cache,
+    )
+    assert out.shape == (1, 5)
+    # left-pad invariance for the MLA cache
+    tokens2, start2 = pad_prompts([[1, 2, 3, 4]], pad_id=0, bucket=16)
+    out2 = generate_tokens(
+        config, params, jnp.asarray(tokens2), jnp.asarray(start2),
+        jax.random.PRNGKey(0), GenerationConfig(max_new_tokens=5),
+        deepseek.forward, cache_len=32, cache_init=deepseek.init_cache,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_deepseek_yarn_mscale_equivalence():
+    """Real DeepSeek checkpoints ship yarn rope with
+    mscale == mscale_all_dim: the HF attention factor is their ratio
+    (= 1.0), NOT the standard 0.1*ln(f)+1 — logits must still match."""
+    rope_scaling = {
+        "rope_type": "yarn", "factor": 4.0, "mscale": 0.707,
+        "mscale_all_dim": 0.707, "beta_fast": 32, "beta_slow": 1,
+        "original_max_position_embeddings": 16,
+    }
+    cfg, model = hf_model(
+        "DeepseekV2ForCausalLM", "DeepseekV2Config",
+        n_routed_experts=4, first_k_dense_replace=3,
+        moe_intermediate_size=32, n_shared_experts=1,
+        rope_scaling=rope_scaling,
+    )
+    check(cfg, model)
+
+    from bigdl_tpu.ops.rope import make_inv_freq_scaled
+
+    _, att = make_inv_freq_scaled(8, 10000.0, rope_scaling, seq_len=64)
+    assert att == pytest.approx(1.0)
+    # standard yarn (no mscale keys) keeps the 0.1*ln(f)+1 temperature
+    _, att_std = make_inv_freq_scaled(
+        8, 10000.0, {"rope_type": "yarn", "factor": 4.0,
+                     "original_max_position_embeddings": 16}, seq_len=64,
+    )
+    assert att_std == pytest.approx(0.1 * np.log(4.0) + 1.0)
