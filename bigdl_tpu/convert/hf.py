@@ -513,6 +513,34 @@ def _prefixed(get: Get, prefix: str) -> Get:
     return g
 
 
+def _internvl_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """InternVL (HF-converted): standard qwen2/llama decoder under the
+    `model.language_model.` prefix (vision tower + projector load
+    separately via models/internvl.py)."""
+    try:
+        return _llama_layer(config, i, _prefixed(get, "model.language_"))
+    except KeyError:  # older conversions: language_model.model.layers...
+        return _llama_layer(config, i, _prefixed(get, "language_model."))
+
+
+def _internvl_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    try:
+        out = {
+            "embed": get("model.language_model.embed_tokens.weight"),
+            "final_norm": get("model.language_model.norm.weight"),
+        }
+        head_name = "lm_head.weight"
+    except KeyError:
+        out = {
+            "embed": get("language_model.model.embed_tokens.weight"),
+            "final_norm": get("language_model.model.norm.weight"),
+        }
+        head_name = "language_model.lm_head.weight"
+    if not config.tie_word_embeddings:
+        out["lm_head"] = get(head_name)
+    return out
+
+
 def _minicpmv_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     """MiniCPM-V stores its language model under the `llm.` prefix
     (OpenBMB MiniCPMV: self.llm = Qwen2/Llama ForCausalLM); layer layout
@@ -684,6 +712,7 @@ _FAMILY_LAYER = {
     "falcon": _falcon_layer,
     "yuan": _yuan_layer,
     "minicpmv": _minicpmv_layer,
+    "internvl": _internvl_layer,
 }
 
 _FAMILY_TOP = {
@@ -699,6 +728,7 @@ _FAMILY_TOP = {
     "rwkv5": _rwkv_top,
     "falcon": _falcon_top,
     "minicpmv": _minicpmv_top,
+    "internvl": _internvl_top,
 }
 
 

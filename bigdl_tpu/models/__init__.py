@@ -57,6 +57,10 @@ from bigdl_tpu.models import mllama  # noqa: E402  (cross-attn decoder)
 _FAMILIES["mllama"] = mllama
 _FAMILIES["mllama_text_model"] = mllama  # nested text_config model_type
 
+from bigdl_tpu.models import internvl  # noqa: E402  (delegates text to llama)
+
+_FAMILIES["internvl"] = internvl
+
 from bigdl_tpu.models import deepseek  # noqa: E402  (MLA latent-KV cache)
 
 _FAMILIES["deepseek_v2"] = deepseek

@@ -467,6 +467,17 @@ def _hf_minicpm3(hf, kw):
     _mla_fields(hf, kw)
 
 
+def _hf_internvl(hf, kw):
+    """InternVL (HF-converted layout): the merged text_config is
+    qwen2 or llama shaped; apply the text architecture's defaults and
+    keep the image token id (models/internvl.py scatters features
+    there)."""
+    inner = (hf.get("text_config") or {}).get("model_type", "qwen2")
+    if inner == "qwen2":
+        kw.setdefault("attention_bias", True)
+    kw["image_token_id"] = hf.get("image_token_id", hf.get("image_token_index"))
+
+
 def _hf_mllama(hf, kw):
     """Mllama / Llama-3.2-Vision text side (reference models/mllama.py;
     HF MllamaTextConfig — from_hf_config already merged the nested
@@ -595,6 +606,7 @@ _HF_BUILDERS = {
     "deepseek_v2": _hf_deepseek_v2,
     "deepseek_v3": _hf_deepseek_v3,
     "minicpm3": _hf_minicpm3,
+    "internvl": _hf_internvl,
 }
 
 

@@ -182,6 +182,12 @@ _QUANT_TARGETS = ("wq", "w_dq", "w_uq", "w_dkv", "w_uk", "w_uv", "wo",
                   "w_gate_e", "w_up_e", "w_down_e",
                   "w_gate_s", "w_up_s", "w_down_s")
 
+# per-head absorbed factors that must stay dense under quantization —
+# the single source of truth for BOTH the random-init path below and
+# the checkpoint path (convert/hf.py leaves them out of its
+# _QUANT_TARGETS include-list for the same reason)
+MLA_DENSE_FACTORS = ("w_uk", "w_uv")
+
 
 def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = None) -> Params:
     from bigdl_tpu.quant import QTensor, quantize
@@ -201,7 +207,7 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
             wv = g.get(name)
             if wv is None or isinstance(wv, QTensor):
                 continue
-            if name in ("w_uk", "w_uv"):
+            if name in MLA_DENSE_FACTORS:
                 continue  # 4-D per-head factors stay dense (tiny, f32 math)
             g[name] = quantize(wv, spec.name)
         out[group] = g
@@ -265,7 +271,19 @@ def _moe_mlp(config: ModelConfig, x, p, compute_dtype):
     topi = topi.reshape(B, T, -1)
 
     if llama.resolve_moe_dispatch(config) == "ragged":
-        out = llama._moe_dispatch_ragged(config, xc, p, compute_dtype, topv, topi)
+        rcfg = config
+        if (config.topk_method or "greedy") != "greedy" and config.n_group:
+            # group-limited routing concentrates every token's k experts
+            # into topk_group of n_group groups, so per-expert load can
+            # exceed the uniform-load capacity by G/topk_group — scale
+            # the capacity factor accordingly or hot experts silently
+            # drop tokens (GShard overflow) where HF computes the full sum
+            rcfg = dataclasses.replace(
+                config,
+                moe_capacity_factor=config.moe_capacity_factor
+                * config.n_group / max(config.topk_group or 1, 1),
+            )
+        out = llama._moe_dispatch_ragged(rcfg, xc, p, compute_dtype, topv, topi)
     else:
         out = llama._moe_dispatch_dense(config, xc, p, compute_dtype, topv, topi)
 

@@ -1,0 +1,246 @@
+"""InternVL (2/2.5/3, HF-converted layout): InternViT vision tower +
+pixel-shuffle projector over a qwen2/llama decoder.
+
+TPU-native counterpart of the reference's internvl support
+(/root/reference/python/llm/src/ipex_llm/transformers/models/internvl.py;
+dispatch at convert.py:1251-2027). Architecture per HF
+modeling_internvl:
+
+- vision tower (InternViT): Conv2d patch embed + cls token + learned
+  position embeddings; pre-LN blocks whose attention output scales by a
+  per-channel LayerScale lambda_1 and MLP by lambda_2; optional
+  full-width RMSNorm on q/k (use_qk_norm);
+- feature path: drop the cls token, reshape to the patch grid,
+  pixel-shuffle downsample (spatial -> channels), then the multimodal
+  projector (LayerNorm -> linear -> gelu -> linear) into the text
+  hidden size;
+- text side: HF-converted InternVL checkpoints carry a standard
+  qwen2/llama decoder under `language_model.` — ingest/quantize/TP all
+  reuse the llama-family path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import layer_norm, rms_norm
+
+# the text side delegates wholesale to the llama family
+init_params = llama.init_params
+quantize_params = llama.quantize_params
+forward = llama.forward
+merge_fused_params = llama.merge_fused_params
+unmerge_fused_params = llama.unmerge_fused_params
+
+
+@dataclasses.dataclass(frozen=True)
+class InternVLVisionConfig:
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    image_size: int = 448
+    patch_size: int = 14
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-6
+    use_qk_norm: bool = False
+    attention_bias: bool = True
+    downsample_ratio: float = 0.5
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "InternVLVisionConfig":
+        keys = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in hf.items() if k in keys}
+        img = hf.get("image_size")
+        if isinstance(img, (list, tuple)):
+            kw["image_size"] = int(img[0])
+        patch = hf.get("patch_size")
+        if isinstance(patch, (list, tuple)):
+            kw["patch_size"] = int(patch[0])
+        return cls(**kw)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.num_channels * self.patch_size ** 2
+
+
+def vision_params_from_state_dict(
+    vcfg: InternVLVisionConfig, get, prefix="model.vision_tower."
+) -> dict:
+    def g(name):
+        return np.asarray(get(prefix + name), np.float32)
+
+    E = vcfg.hidden_size
+    blocks: dict[str, list] = {}
+    names = [
+        ("ln1_w", "layernorm_before.weight"), ("ln1_b", "layernorm_before.bias"),
+        ("ln2_w", "layernorm_after.weight"), ("ln2_b", "layernorm_after.bias"),
+        ("wq", "attention.q_proj.weight"), ("wk", "attention.k_proj.weight"),
+        ("wv", "attention.v_proj.weight"),
+        ("wo", "attention.projection_layer.weight"),
+        ("bo", "attention.projection_layer.bias"),
+        ("fc1_w", "mlp.fc1.weight"), ("fc1_b", "mlp.fc1.bias"),
+        ("fc2_w", "mlp.fc2.weight"), ("fc2_b", "mlp.fc2.bias"),
+        ("lambda1", "lambda_1"), ("lambda2", "lambda_2"),
+    ]
+    if vcfg.attention_bias:
+        names += [("bq", "attention.q_proj.bias"),
+                  ("bk", "attention.k_proj.bias"),
+                  ("bv", "attention.v_proj.bias")]
+    if vcfg.use_qk_norm:
+        names += [("q_norm", "attention.q_norm.weight"),
+                  ("k_norm", "attention.k_norm.weight")]
+    for i in range(vcfg.num_hidden_layers):
+        for key, suffix in names:
+            blocks.setdefault(key, []).append(g(f"encoder.layer.{i}.{suffix}"))
+    params = {
+        "patch_proj": g("embeddings.patch_embeddings.projection.weight").reshape(E, -1),
+        "patch_bias": g("embeddings.patch_embeddings.projection.bias"),
+        "cls_token": g("embeddings.cls_token").reshape(1, E),
+        "pos_embed": g("embeddings.position_embeddings")[0],  # [N+1, E]
+        "blocks": {k: jnp.asarray(np.stack(v)) for k, v in blocks.items()},
+    }
+    try:  # use_mean_pooling=False variants carry a final layernorm
+        params["post_ln_w"] = g("layernorm.weight")
+        params["post_ln_b"] = g("layernorm.bias")
+    except KeyError:
+        pass
+    return jax.tree.map(jnp.asarray, params)
+
+
+def projector_params_from_state_dict(get, prefix="model.multi_modal_projector.") -> dict:
+    def g(name):
+        return jnp.asarray(np.asarray(get(prefix + name), np.float32))
+
+    return {
+        "ln_w": g("layer_norm.weight"), "ln_b": g("layer_norm.bias"),
+        "fc1_w": g("linear_1.weight"), "fc1_b": g("linear_1.bias"),
+        "fc2_w": g("linear_2.weight"), "fc2_b": g("linear_2.bias"),
+    }
+
+
+def vision_forward(
+    vcfg: InternVLVisionConfig,
+    vparams: dict,
+    patches: jax.Array,  # [B, N, patch_dim] flattened pixel patches
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """[B, N, patch_dim] -> [B, N+1, E] hidden states (cls token first),
+    matching InternVLVisionModel.last_hidden_state."""
+    B, N, _ = patches.shape
+    E, Hh, D = vcfg.hidden_size, vcfg.num_attention_heads, vcfg.head_dim
+    eps = vcfg.layer_norm_eps
+
+    h = (
+        jnp.einsum("bnd,ed->bne", patches.astype(jnp.float32),
+                   vparams["patch_proj"])
+        + vparams["patch_bias"]
+    )
+    cls = jnp.broadcast_to(vparams["cls_token"][None], (B, 1, E))
+    h = jnp.concatenate([cls, h], axis=1)  # [B, N+1, E]
+    h = h + vparams["pos_embed"][None, : N + 1]
+    S = N + 1
+    scale = D ** -0.5
+
+    def block(h, p):
+        x = layer_norm(h, p["ln1_w"], p["ln1_b"], eps)
+        q = jnp.einsum("bne,fe->bnf", x, p["wq"])
+        k = jnp.einsum("bne,fe->bnf", x, p["wk"])
+        v = jnp.einsum("bne,fe->bnf", x, p["wv"])
+        if "bq" in p:
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        if "q_norm" in p:  # full-width RMSNorm BEFORE the head split
+            q = rms_norm(q, p["q_norm"], eps)
+            k = rms_norm(k, p["k_norm"], eps)
+        q = q.reshape(B, S, Hh, D)
+        k = k.reshape(B, S, Hh, D)
+        v = v.reshape(B, S, Hh, D)
+        att = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
+        att = jax.nn.softmax(att, axis=-1)
+        ctx = jnp.einsum("bhnm,bmhd->bnhd", att, v).reshape(B, S, E)
+        out = jnp.einsum("bne,fe->bnf", ctx, p["wo"]) + p["bo"]
+        h = h + out * p["lambda1"]
+
+        x = layer_norm(h, p["ln2_w"], p["ln2_b"], eps)
+        x = jnp.einsum("bne,fe->bnf", x, p["fc1_w"]) + p["fc1_b"]
+        x = jax.nn.gelu(x, approximate=False)
+        x = jnp.einsum("bnf,ef->bne", x, p["fc2_w"]) + p["fc2_b"]
+        h = h + x * p["lambda2"]
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, vparams["blocks"])
+    if "post_ln_w" in vparams:
+        h = layer_norm(h, vparams["post_ln_w"], vparams["post_ln_b"], eps)
+    return h.astype(out_dtype)
+
+
+def pixel_shuffle(feats: jax.Array, scale: float = 0.5) -> jax.Array:
+    """[B, W, H, C] -> [B, H*s, W*s, C/s^2] (HF InternVLModel.pixel_shuffle
+    — note the width/height swap dance is reproduced exactly)."""
+    B, W, H, C = feats.shape
+    x = feats.reshape(B, W, int(H * scale), int(C / scale))
+    x = jnp.transpose(x, (0, 2, 1, 3))
+    x = x.reshape(B, int(H * scale), int(W * scale), int(C / (scale * scale)))
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
+def image_features(
+    vcfg: InternVLVisionConfig,
+    vparams: dict,
+    pparams: dict,
+    patches: jax.Array,  # [B, N, patch_dim], N = grid*grid
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """Full HF get_image_features path: tower -> drop cls -> grid ->
+    pixel shuffle -> projector. Returns [B, N*ds^2, text_hidden]."""
+    h = vision_forward(vcfg, vparams, patches)[:, 1:]  # drop cls
+    B, N, E = h.shape
+    g = int(round(float(np.sqrt(N))))
+    ds = vcfg.downsample_ratio
+    x = pixel_shuffle(h.reshape(B, g, g, E), ds)
+    x = x.reshape(B, -1, x.shape[-1])
+    x = layer_norm(x, pparams["ln_w"], pparams["ln_b"], 1e-5)
+    x = jnp.einsum("bnk,fk->bnf", x, pparams["fc1_w"]) + pparams["fc1_b"]
+    x = jax.nn.gelu(x, approximate=False)
+    x = jnp.einsum("bnf,ef->bne", x, pparams["fc2_w"]) + pparams["fc2_b"]
+    return x.astype(out_dtype)
+
+
+def multimodal_prefill(
+    config: ModelConfig,
+    vcfg: InternVLVisionConfig,
+    params: dict,
+    vparams: dict,
+    pparams: dict,
+    input_ids: np.ndarray,  # [B, T] with image_token_id placeholders
+    patches: jax.Array,  # [B, N, patch_dim]
+    cache,
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = True,
+):
+    """Scatter projected image features over the placeholder tokens
+    (per-row indexing, as minicpmv) -> standard prefill."""
+    img = image_features(vcfg, vparams, pparams, patches)  # [B, Q, E]
+    h = llama.embed_tokens(config, params, jnp.asarray(input_ids), compute_dtype)
+    mask = jnp.asarray(input_ids == config.image_token_id)
+    B = input_ids.shape[0]
+    Q = img.shape[1]
+    row_cum = jnp.cumsum(mask, axis=1) - 1
+    idx = jnp.arange(B)[:, None] * Q + jnp.clip(row_cum, 0, Q - 1)
+    flat = img.reshape(-1, img.shape[-1])
+    h = jnp.where(mask[..., None], flat[idx].astype(compute_dtype), h)
+    return llama.forward(
+        config, params, h, cache, mode="prefill", input_is_hidden=True,
+        compute_dtype=compute_dtype, last_logits_only=last_logits_only,
+    )

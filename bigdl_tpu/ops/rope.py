@@ -96,9 +96,8 @@ def yarn_scaled_inv_freq(
 
     if attention_factor is not None:
         att = float(attention_factor)
-    elif mscale is not None or mscale_all_dim is not None:
-        att = get_mscale(factor, mscale if mscale is not None else 1.0) / \
-            get_mscale(factor, mscale_all_dim if mscale_all_dim is not None else 1.0)
+    elif mscale and mscale_all_dim:  # BOTH truthy — HF's exact condition
+        att = get_mscale(factor, mscale) / get_mscale(factor, mscale_all_dim)
     else:
         att = get_mscale(factor)
     return inv, att

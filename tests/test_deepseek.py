@@ -196,3 +196,21 @@ def test_deepseek_yarn_mscale_equivalence():
                      "original_max_position_embeddings": 16}, seq_len=64,
     )
     assert att_std == pytest.approx(0.1 * np.log(4.0) + 1.0)
+
+
+def test_deepseek_ragged_dispatch_matches_hf():
+    """E=16 (> the dense threshold) routes through the capacity-based
+    ragged dispatch; with the group-limited capacity boost, no tokens
+    drop and logits still match HF's full-sum computation."""
+    cfg, model = hf_model(
+        "DeepseekV2ForCausalLM", "DeepseekV2Config",
+        n_routed_experts=16, num_experts_per_tok=2, first_k_dense_replace=1,
+        moe_intermediate_size=32, n_shared_experts=1,
+        topk_method="group_limited_greedy", n_group=4, topk_group=1,
+        routed_scaling_factor=1.0,
+    )
+    config, params = ours(cfg, model)
+    from bigdl_tpu.models.llama import resolve_moe_dispatch
+
+    assert resolve_moe_dispatch(config) == "ragged"
+    check(cfg, model)

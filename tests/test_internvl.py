@@ -1,0 +1,155 @@
+"""InternVL tests against transformers' InternVLVisionModel /
+InternVLModel (fp32 CPU eager): tower hidden states, the full
+get_image_features path (cls drop + pixel shuffle + projector), and the
+placeholder-scatter prefill over the text decoder."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.models import get_family, internvl, llama
+from bigdl_tpu.models.config import ModelConfig
+
+
+def tiny_vision_cfg(**kw):
+    from transformers import InternVLVisionConfig
+
+    return InternVLVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, image_size=28, patch_size=14,
+        use_qk_norm=kw.pop("use_qk_norm", False), **kw,
+    )
+
+
+def pixels_to_patches(pixels, p):
+    B, C, Hh, W = pixels.shape
+    g = Hh // p
+    return (
+        pixels.reshape(B, C, g, p, g, p)
+        .transpose(0, 2, 4, 1, 3, 5)
+        .reshape(B, g * g, -1)
+    )
+
+
+@pytest.mark.parametrize("qk_norm", [False, True])
+def test_internvl_vision_tower_matches_hf(qk_norm):
+    from transformers import InternVLVisionModel
+
+    cfg = tiny_vision_cfg(use_qk_norm=qk_norm)
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    model = InternVLVisionModel(cfg).eval().to(torch.float32)
+    # nontrivial layer scales
+    with torch.no_grad():
+        for layer in model.encoder.layer:
+            layer.lambda_1.uniform_(0.5, 1.5)
+            layer.lambda_2.uniform_(0.5, 1.5)
+
+    rng = np.random.default_rng(0)
+    pixels = rng.standard_normal((1, 3, 28, 28)).astype(np.float32)
+    with torch.no_grad():
+        hf_out = model(torch.from_numpy(pixels)).last_hidden_state.numpy()
+
+    vcfg = internvl.InternVLVisionConfig.from_hf(cfg.to_dict())
+    sd = model.state_dict()
+    vparams = internvl.vision_params_from_state_dict(
+        vcfg, lambda n: sd[n].numpy(), prefix=""
+    )
+    patches = pixels_to_patches(pixels, 14)
+    ours = internvl.vision_forward(vcfg, vparams, jnp.asarray(patches))
+    np.testing.assert_allclose(np.asarray(ours), hf_out, rtol=2e-3, atol=2e-3)
+
+
+def test_internvl_image_features_match_hf():
+    """Full path incl. pixel shuffle + projector vs
+    InternVLModel.get_image_features."""
+    from transformers import InternVLConfig, InternVLModel
+    from transformers.models.qwen2 import Qwen2Config
+
+    vis = tiny_vision_cfg()
+    txt = Qwen2Config(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+    )
+    cfg = InternVLConfig(
+        vision_config=vis.to_dict(), text_config=txt.to_dict(),
+        downsample_ratio=0.5, image_token_id=5,
+    )
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(1)
+    model = InternVLModel(cfg).eval().to(torch.float32)
+
+    rng = np.random.default_rng(1)
+    pixels = rng.standard_normal((1, 3, 28, 28)).astype(np.float32)
+    with torch.no_grad():
+        hf_feats = model.get_image_features(torch.from_numpy(pixels)).numpy()
+
+    vcfg = internvl.InternVLVisionConfig.from_hf(
+        {**vis.to_dict(), "downsample_ratio": 0.5}
+    )
+    sd = model.state_dict()
+    get = lambda n: sd[n].numpy()
+    vparams = internvl.vision_params_from_state_dict(vcfg, get, prefix="vision_tower.")
+    pparams = internvl.projector_params_from_state_dict(get, prefix="multi_modal_projector.")
+    patches = pixels_to_patches(pixels, 14)
+    ours = internvl.image_features(vcfg, vparams, pparams, jnp.asarray(patches))
+    np.testing.assert_allclose(np.asarray(ours), hf_feats, rtol=3e-3, atol=3e-3)
+
+
+def test_internvl_prefill_and_decode():
+    from bigdl_tpu import kvcache
+
+    config = ModelConfig.from_hf_config({
+        "model_type": "internvl", "image_token_id": 5,
+        "text_config": {"model_type": "qwen2", "vocab_size": 96,
+                        "hidden_size": 48, "intermediate_size": 96,
+                        "num_hidden_layers": 1, "num_attention_heads": 4,
+                        "num_key_value_heads": 2},
+    })
+    assert config.attention_bias and config.image_token_id == 5
+    assert get_family("internvl") is internvl
+    vcfg = internvl.InternVLVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=1,
+        num_attention_heads=4, image_size=28, patch_size=14,
+    )
+    rng = np.random.default_rng(2)
+    key = jax.random.PRNGKey(2)
+    params = llama.init_params(config, key, dtype=jnp.float32)
+
+    def w(*shape):
+        return jnp.asarray(rng.standard_normal(shape).astype(np.float32) * 0.05)
+
+    vparams = {
+        "patch_proj": w(32, 3 * 14 * 14), "patch_bias": w(32),
+        "cls_token": w(1, 32), "pos_embed": w(5, 32),
+        "blocks": {k: w(1, *s) for k, s in [
+            ("ln1_w", (32,)), ("ln1_b", (32,)), ("ln2_w", (32,)), ("ln2_b", (32,)),
+            ("wq", (32, 32)), ("bq", (32,)), ("wk", (32, 32)), ("bk", (32,)),
+            ("wv", (32, 32)), ("bv", (32,)), ("wo", (32, 32)), ("bo", (32,)),
+            ("fc1_w", (64, 32)), ("fc1_b", (64,)),
+            ("fc2_w", (32, 64)), ("fc2_b", (32,)),
+            ("lambda1", (32,)), ("lambda2", (32,)),
+        ]},
+    }
+    pparams = {
+        "ln_w": jnp.ones(128), "ln_b": jnp.zeros(128),
+        "fc1_w": w(48, 128), "fc1_b": w(48),
+        "fc2_w": w(48, 48), "fc2_b": w(48),
+    }
+    # 2x2 grid -> pixel shuffle 0.5 -> 1 feature token
+    ids = np.asarray([[7, 8, 5, 9]], np.int32)
+    patches = w(1, 4, 3 * 14 * 14)
+    cache = kvcache.init_cache(1, 1, 16, 2, 12, dtype=jnp.float32)
+    logits, cache = internvl.multimodal_prefill(
+        config, vcfg, params, vparams, pparams, ids, patches, cache,
+        compute_dtype=jnp.float32,
+    )
+    assert logits.shape == (1, 1, 96)
+    lg, _ = llama.forward(
+        config, params, jnp.asarray([[11]], np.int32), cache, mode="decode",
+        compute_dtype=jnp.float32,
+    )
+    assert np.all(np.isfinite(np.asarray(lg)))
