@@ -5,7 +5,7 @@ Role-equivalent of the reference's `llm-cli` / `llm-chat` shell dispatch
 binary; here every path is the same XLA program) plus `llm_convert`
 (convert_model.py:31).
 
-    python -m bigdl_tpu.cli convert  <hf_dir> -o <out_dir> -q sym_int4
+    python -m bigdl_tpu.cli convert  <hf_dir> -o <out_dir> --qtype sym_int4
     python -m bigdl_tpu.cli generate <model_dir> -p "..." -n 64
     python -m bigdl_tpu.cli serve    <model_dir> --port 8000
     python -m bigdl_tpu.cli bench    <model_dir>
@@ -85,6 +85,7 @@ def cmd_serve(args):
     server = ApiServer(
         model, tokenizer=tok, host=args.host,
         port=args.port, n_slots=args.slots, max_len=args.max_len, gen=gen,
+        paged=args.paged,
     )
     server.start()
     print(f"bigdl-tpu serving {args.model} on {args.host}:{server.port}")
@@ -97,43 +98,61 @@ def cmd_serve(args):
 
 def cmd_bench(args):
     model = _load(args.model, args.qtype)
-    ids = list(range(1, 33))
-    model.generate([ids], max_new_tokens=4)  # warm
+    n_in, n_out = args.in_len, args.out_len
+    ids = list(range(1, n_in + 1))
+    # warm BOTH jit specializations (max_new_tokens is static) before
+    # any timing, or the first-token run would include a compile
+    model.generate([ids], max_new_tokens=1)
+    model.generate([ids], max_new_tokens=n_out)
+    t1 = time.time()
+    model.generate([ids], max_new_tokens=1)
+    first = time.time() - t1
     t0 = time.time()
-    model.generate([ids], max_new_tokens=32)
-    dt = (time.time() - t0) / 32 * 1000
-    print(json.dumps({"metric": "decode_latency", "value": round(dt, 2),
-                      "unit": "ms/token"}))
+    model.generate([ids], max_new_tokens=n_out)
+    dt = max((time.time() - t0 - first) / max(n_out - 1, 1), 1e-5) * 1000
+    print(json.dumps({
+        "metric": "decode_latency", "value": round(dt, 2),
+        "unit": "ms/token", "first_token_ms": round(first * 1000, 1),
+        "protocol": f"in{n_in}-out{n_out}",
+    }))
 
 
 def main(argv=None):
     p = argparse.ArgumentParser(prog="bigdl-tpu")
-    p.add_argument("-q", "--qtype", default=None,
-               help="sym_int4 (HF default) / q4_k_m / ... ; gguf keeps native formats unless set")
+    # shared option parent: -q works AFTER the subcommand (the documented
+    # position)
+    qp = argparse.ArgumentParser(add_help=False)
+    qp.add_argument("-q", "--qtype", default=None,
+                    help="sym_int4 (HF default) / q4_k_m / ... ; gguf keeps "
+                         "native formats unless set")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    c = sub.add_parser("convert", help="quantize + save_low_bit")
+    c = sub.add_parser("convert", help="quantize + save_low_bit", parents=[qp])
     c.add_argument("model")
     c.add_argument("-o", "--output", required=True)
     c.set_defaults(fn=cmd_convert)
 
-    g = sub.add_parser("generate", help="one-shot generation")
+    g = sub.add_parser("generate", help="one-shot generation", parents=[qp])
     g.add_argument("model")
     g.add_argument("-p", "--prompt", required=True)
     g.add_argument("-n", "--max-new-tokens", type=int, default=64)
     g.add_argument("-t", "--temperature", type=float, default=0.0)
     g.set_defaults(fn=cmd_generate)
 
-    s = sub.add_parser("serve", help="OpenAI-compatible server")
+    s = sub.add_parser("serve", help="OpenAI-compatible server", parents=[qp])
     s.add_argument("model")
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=8000)
     s.add_argument("--slots", type=int, default=8)
     s.add_argument("--max-len", type=int, default=2048)
+    s.add_argument("--paged", action="store_true",
+                   help="paged KV pool + prefix caching")
     s.set_defaults(fn=cmd_serve)
 
-    b = sub.add_parser("bench", help="quick decode-latency check")
+    b = sub.add_parser("bench", help="quick decode-latency check", parents=[qp])
     b.add_argument("model")
+    b.add_argument("--in-len", type=int, default=32)
+    b.add_argument("--out-len", type=int, default=32)
     b.set_defaults(fn=cmd_bench)
 
     args = p.parse_args(argv)

@@ -236,6 +236,12 @@ def multimodal_prefill(
     mask = jnp.asarray(input_ids == config.image_token_id)
     B = input_ids.shape[0]
     Q = img.shape[1]
+    counts = np.asarray(input_ids == config.image_token_id).sum(axis=1)
+    if not (counts == Q).all():  # HF raises the same mismatch
+        raise ValueError(
+            f"image placeholder count per row {counts.tolist()} != "
+            f"projected feature count {Q}"
+        )
     row_cum = jnp.cumsum(mask, axis=1) - 1
     idx = jnp.arange(B)[:, None] * Q + jnp.clip(row_cum, 0, Q - 1)
     flat = img.reshape(-1, img.shape[-1])

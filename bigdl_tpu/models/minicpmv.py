@@ -260,6 +260,12 @@ def multimodal_prefill(
     # placeholders, e.g. a text-only row batched with an image row)
     B = input_ids.shape[0]
     Q = img.shape[1]
+    counts = np.asarray(input_ids == config.image_token_id).sum(axis=1)
+    if not np.all((counts == Q) | (counts == 0)):  # 0 = text-only row
+        raise ValueError(
+            f"image placeholder count per row {counts.tolist()} must be "
+            f"0 or exactly {Q} (the resampler query count)"
+        )
     row_cum = jnp.cumsum(mask, axis=1) - 1  # [B, T]
     idx = jnp.arange(B)[:, None] * Q + jnp.clip(row_cum, 0, Q - 1)
     flat = img.reshape(-1, img.shape[-1])

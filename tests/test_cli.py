@@ -1,0 +1,49 @@
+"""CLI round-trip: convert a tiny model to a low-bit dir, generate from
+it, and run the bench protocol — the documented docs/quickstart.md
+invocations, in-process via cli.main()."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu import cli
+from bigdl_tpu.api import TpuModel, optimize_model
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import PRESETS
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tiny") / "model"
+    cfg = PRESETS["tiny-llama"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    TpuModel(cfg, optimize_model(params, cfg), "sym_int4").save_low_bit(str(d))
+    return str(d)
+
+
+def test_cli_convert_roundtrip(saved_model, tmp_path, capsys):
+    out = tmp_path / "out"
+    cli.main(["convert", saved_model, "-o", str(out), "--qtype", "sym_int4"])
+    assert "saved" in capsys.readouterr().out
+    from bigdl_tpu.api import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.load_low_bit(str(out))
+    assert m.generate([[1, 2, 3]], max_new_tokens=4).shape == (1, 4)
+
+
+def test_cli_generate(saved_model, capsys):
+    # no tokenizer in the dir: the prompt parses as whitespace token ids
+    cli.main(["generate", saved_model, "-p", "3 1 4 1 5", "-n", "8"])
+    out = capsys.readouterr().out
+    assert "[" in out  # token-id list printed
+
+
+def test_cli_bench_protocol(saved_model, capsys):
+    cli.main(["bench", saved_model, "--in-len", "16", "--out-len", "8"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    res = json.loads(line)
+    assert res["metric"] == "decode_latency" and res["value"] > 0
+    assert res["protocol"] == "in16-out8"
+    assert "first_token_ms" in res
