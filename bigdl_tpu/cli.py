@@ -119,12 +119,15 @@ def cmd_bench(args):
 
 def main(argv=None):
     p = argparse.ArgumentParser(prog="bigdl-tpu")
-    # shared option parent: -q works AFTER the subcommand (the documented
-    # position)
+    # -q works BOTH before the subcommand (top-level, original position)
+    # and after it (documented position): the subparser copy defaults to
+    # SUPPRESS so it never clobbers a top-level value
+    p.add_argument("-q", "--qtype", default=None,
+                   help="sym_int4 (HF default) / q4_k_m / ... ; gguf keeps "
+                        "native formats unless set")
     qp = argparse.ArgumentParser(add_help=False)
-    qp.add_argument("-q", "--qtype", default=None,
-                    help="sym_int4 (HF default) / q4_k_m / ... ; gguf keeps "
-                         "native formats unless set")
+    qp.add_argument("-q", "--qtype", default=argparse.SUPPRESS,
+                    help=argparse.SUPPRESS)
     sub = p.add_subparsers(dest="cmd", required=True)
 
     c = sub.add_parser("convert", help="quantize + save_low_bit", parents=[qp])
@@ -151,8 +154,14 @@ def main(argv=None):
 
     b = sub.add_parser("bench", help="quick decode-latency check", parents=[qp])
     b.add_argument("model")
+    def _min2(v):
+        iv = int(v)
+        if iv < 2:  # one timed token can't separate decode from first-token
+            raise argparse.ArgumentTypeError("--out-len must be >= 2")
+        return iv
+
     b.add_argument("--in-len", type=int, default=32)
-    b.add_argument("--out-len", type=int, default=32)
+    b.add_argument("--out-len", type=_min2, default=32)
     b.set_defaults(fn=cmd_bench)
 
     args = p.parse_args(argv)

@@ -541,6 +541,22 @@ def _internvl_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
     return out
 
 
+def _janus_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Janus: llama decoder under `model.language_model.` (HF layout;
+    vision tower + aligner load separately via models/janus.py)."""
+    return _llama_layer(config, i, _prefixed(get, "model.language_"))
+
+
+def _janus_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    out = {
+        "embed": get("model.language_model.embed_tokens.weight"),
+        "final_norm": get("model.language_model.norm.weight"),
+    }
+    if not config.tie_word_embeddings:
+        out["lm_head"] = get("lm_head.weight")
+    return out
+
+
 def _minicpmv_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     """MiniCPM-V stores its language model under the `llm.` prefix
     (OpenBMB MiniCPMV: self.llm = Qwen2/Llama ForCausalLM); layer layout
@@ -713,6 +729,7 @@ _FAMILY_LAYER = {
     "yuan": _yuan_layer,
     "minicpmv": _minicpmv_layer,
     "internvl": _internvl_layer,
+    "janus": _janus_layer,
 }
 
 _FAMILY_TOP = {
@@ -729,6 +746,7 @@ _FAMILY_TOP = {
     "falcon": _falcon_top,
     "minicpmv": _minicpmv_top,
     "internvl": _internvl_top,
+    "janus": _janus_top,
 }
 
 

@@ -61,6 +61,10 @@ from bigdl_tpu.models import internvl  # noqa: E402  (delegates text to llama)
 
 _FAMILIES["internvl"] = internvl
 
+from bigdl_tpu.models import janus  # noqa: E402  (delegates text to llama)
+
+_FAMILIES["janus"] = janus
+
 from bigdl_tpu.models import deepseek  # noqa: E402  (MLA latent-KV cache)
 
 _FAMILIES["deepseek_v2"] = deepseek

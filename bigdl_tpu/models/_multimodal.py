@@ -1,0 +1,43 @@
+"""Shared multimodal glue: scatter projected image features over
+placeholder tokens — used by minicpmv, internvl, and janus (qwen2_vl
+needs its own path: its features are globally concatenated across
+images, not per-row)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+
+
+def scatter_image_features(
+    config: ModelConfig,
+    params: dict,
+    input_ids: np.ndarray,  # [B, T]
+    img: jnp.ndarray,  # [B, Q, E] per-row projected image features
+    compute_dtype,
+    allow_text_rows: bool = True,
+) -> jnp.ndarray:
+    """Token embeddings with row b's Q features scattered over its
+    image_token_id placeholders (per-row indexing — a global cumsum
+    would misassign in mixed batches). Rows must carry exactly Q
+    placeholders (or zero, when allow_text_rows — their patches are
+    ignored); anything else raises like HF's masked_scatter path."""
+    h = llama.embed_tokens(config, params, jnp.asarray(input_ids), compute_dtype)
+    mask = jnp.asarray(input_ids == config.image_token_id)
+    B = input_ids.shape[0]
+    Q = img.shape[1]
+    counts = np.asarray(input_ids == config.image_token_id).sum(axis=1)
+    ok = (counts == Q) | ((counts == 0) if allow_text_rows else False)
+    if not np.all(ok):
+        raise ValueError(
+            f"image placeholder count per row {counts.tolist()} must be "
+            f"{'0 or ' if allow_text_rows else ''}exactly {Q} "
+            "(the projected feature count)"
+        )
+    row_cum = jnp.cumsum(mask, axis=1) - 1
+    idx = jnp.arange(B)[:, None] * Q + jnp.clip(row_cum, 0, Q - 1)
+    flat = img.reshape(-1, img.shape[-1])
+    return jnp.where(mask[..., None], flat[idx].astype(compute_dtype), h)

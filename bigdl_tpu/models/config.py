@@ -467,6 +467,13 @@ def _hf_minicpm3(hf, kw):
     _mla_fields(hf, kw)
 
 
+def _hf_janus(hf, kw):
+    """Janus/Janus-Pro understanding path: the merged text_config is
+    llama-shaped; keep the image placeholder id for the feature
+    scatter (models/janus.py)."""
+    kw["image_token_id"] = hf.get("image_token_id", hf.get("image_token_index"))
+
+
 def _hf_internvl(hf, kw):
     """InternVL (HF-converted layout): the merged text_config is
     qwen2 or llama shaped; apply the text architecture's defaults and
@@ -607,6 +614,7 @@ _HF_BUILDERS = {
     "deepseek_v3": _hf_deepseek_v3,
     "minicpm3": _hf_minicpm3,
     "internvl": _hf_internvl,
+    "janus": _hf_janus,
 }
 
 

@@ -52,6 +52,7 @@ class InternVLVisionConfig:
     layer_norm_eps: float = 1e-6
     use_qk_norm: bool = False
     attention_bias: bool = True
+    hidden_act: str = "gelu"  # HF default: exact erf gelu
     downsample_ratio: float = 0.5
 
     @classmethod
@@ -174,7 +175,8 @@ def vision_forward(
 
         x = layer_norm(h, p["ln2_w"], p["ln2_b"], eps)
         x = jnp.einsum("bne,fe->bnf", x, p["fc1_w"]) + p["fc1_b"]
-        x = jax.nn.gelu(x, approximate=False)
+        # HF ACT2FN[hidden_act]: "gelu" = exact erf
+        x = jax.nn.gelu(x, approximate=vcfg.hidden_act != "gelu")
         x = jnp.einsum("bnf,ef->bne", x, p["fc2_w"]) + p["fc2_b"]
         h = h + x * p["lambda2"]
         return h, None
@@ -231,21 +233,10 @@ def multimodal_prefill(
 ):
     """Scatter projected image features over the placeholder tokens
     (per-row indexing, as minicpmv) -> standard prefill."""
+    from bigdl_tpu.models._multimodal import scatter_image_features
+
     img = image_features(vcfg, vparams, pparams, patches)  # [B, Q, E]
-    h = llama.embed_tokens(config, params, jnp.asarray(input_ids), compute_dtype)
-    mask = jnp.asarray(input_ids == config.image_token_id)
-    B = input_ids.shape[0]
-    Q = img.shape[1]
-    counts = np.asarray(input_ids == config.image_token_id).sum(axis=1)
-    if not (counts == Q).all():  # HF raises the same mismatch
-        raise ValueError(
-            f"image placeholder count per row {counts.tolist()} != "
-            f"projected feature count {Q}"
-        )
-    row_cum = jnp.cumsum(mask, axis=1) - 1
-    idx = jnp.arange(B)[:, None] * Q + jnp.clip(row_cum, 0, Q - 1)
-    flat = img.reshape(-1, img.shape[-1])
-    h = jnp.where(mask[..., None], flat[idx].astype(compute_dtype), h)
+    h = scatter_image_features(config, params, input_ids, img, compute_dtype)
     return llama.forward(
         config, params, h, cache, mode="prefill", input_is_hidden=True,
         compute_dtype=compute_dtype, last_logits_only=last_logits_only,

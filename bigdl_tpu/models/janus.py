@@ -1,0 +1,206 @@
+"""Janus / Janus-Pro — understanding path: SigLIP-style vision encoder +
+aligner MLP over the llama decoder.
+
+TPU-native counterpart of the reference's janus support
+(/root/reference/python/llm/src/ipex_llm/transformers/models/janus.py —
+it, too, optimizes only the vision attention; dispatch at
+convert.py:1251-2027). Architecture per HF modeling_janus:
+
+- vision: Conv2d patch embed + learned position embeddings (no cls
+  token), pre-LN blocks (LN -> MHA -> LN -> gelu MLP), final
+  post_layernorm;
+- aligner: fc1 to projection_dim then (depth-1) x (act -> linear);
+- text: llama-shaped decoder; image features scatter over the
+  placeholder tokens like the other multimodal families.
+
+The image-GENERATION path (JanusVQVAE decoding image tokens) is out of
+scope — the reference likewise leaves the VQVAE untouched and only
+accelerates the understanding stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import layer_norm
+
+# the text side delegates wholesale to the llama family
+init_params = llama.init_params
+quantize_params = llama.quantize_params
+forward = llama.forward
+merge_fused_params = llama.merge_fused_params
+unmerge_fused_params = llama.unmerge_fused_params
+
+
+@dataclasses.dataclass(frozen=True)
+class JanusVisionConfig:
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    image_size: int = 384
+    patch_size: int = 16
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-6
+    attention_bias: bool = True
+    hidden_act: str = "gelu"  # HF JanusVisionConfig default: exact erf
+    projection_dim: int = 2048  # aligner output (text hidden)
+    depth: int = 2  # aligner layers
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "JanusVisionConfig":
+        keys = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in hf.items() if k in keys})
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.num_channels * self.patch_size ** 2
+
+
+def vision_params_from_state_dict(
+    vcfg: JanusVisionConfig, get, prefix="model.vision_model."
+) -> dict:
+    def g(name):
+        return np.asarray(get(prefix + name), np.float32)
+
+    E = vcfg.hidden_size
+    blocks: dict[str, list] = {}
+    names = [
+        ("ln1_w", "layer_norm1.weight"), ("ln1_b", "layer_norm1.bias"),
+        ("ln2_w", "layer_norm2.weight"), ("ln2_b", "layer_norm2.bias"),
+        ("wq", "self_attn.q_proj.weight"), ("wk", "self_attn.k_proj.weight"),
+        ("wv", "self_attn.v_proj.weight"),
+        ("wo", "self_attn.projection_layer.weight"),
+        ("bo", "self_attn.projection_layer.bias"),
+        ("fc1_w", "mlp.fc1.weight"), ("fc1_b", "mlp.fc1.bias"),
+        ("fc2_w", "mlp.fc2.weight"), ("fc2_b", "mlp.fc2.bias"),
+    ]
+    if vcfg.attention_bias:
+        names += [("bq", "self_attn.q_proj.bias"),
+                  ("bk", "self_attn.k_proj.bias"),
+                  ("bv", "self_attn.v_proj.bias")]
+    for i in range(vcfg.num_hidden_layers):
+        for key, suffix in names:
+            blocks.setdefault(key, []).append(g(f"encoder.layers.{i}.{suffix}"))
+    params = {
+        "patch_proj": g("embeddings.patch_embedding.weight").reshape(E, -1),
+        "patch_bias": g("embeddings.patch_embedding.bias"),
+        "pos_embed": g("embeddings.position_embedding.weight"),  # [N, E]
+        "blocks": {k: jnp.asarray(np.stack(v)) for k, v in blocks.items()},
+        "post_ln_w": g("post_layernorm.weight"),
+        "post_ln_b": g("post_layernorm.bias"),
+    }
+    return jax.tree.map(jnp.asarray, params)
+
+
+def aligner_params_from_state_dict(vcfg: JanusVisionConfig, get,
+                                   prefix="model.aligner.") -> dict:
+    def g(name):
+        return jnp.asarray(np.asarray(get(prefix + name), np.float32))
+
+    out = {"fc1_w": g("fc1.weight"), "fc1_b": g("fc1.bias"), "hidden": []}
+    for i in range(vcfg.depth - 1):
+        out["hidden"].append(
+            (g(f"hidden_layers.{i}.weight"), g(f"hidden_layers.{i}.bias"))
+        )
+    return out
+
+
+def _act(vcfg: JanusVisionConfig, x):
+    # HF ACT2FN[config.hidden_act]: "gelu" = exact erf, tanh variants approx
+    exact = vcfg.hidden_act == "gelu"
+    return jax.nn.gelu(x, approximate=not exact)
+
+
+def vision_forward(
+    vcfg: JanusVisionConfig,
+    vparams: dict,
+    patches: jax.Array,  # [B, N, patch_dim]
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """[B, N, patch_dim] -> [B, N, E] (post_layernorm applied), matching
+    JanusVisionModel.last_hidden_state."""
+    B, N, _ = patches.shape
+    E, Hh, D = vcfg.hidden_size, vcfg.num_attention_heads, vcfg.head_dim
+    eps = vcfg.layer_norm_eps
+
+    h = (
+        jnp.einsum("bnd,ed->bne", patches.astype(jnp.float32),
+                   vparams["patch_proj"])
+        + vparams["patch_bias"]
+    )
+    h = h + vparams["pos_embed"][None, :N]
+    scale = D ** -0.5
+
+    def block(h, p):
+        x = layer_norm(h, p["ln1_w"], p["ln1_b"], eps)
+        q = jnp.einsum("bne,fe->bnf", x, p["wq"])
+        k = jnp.einsum("bne,fe->bnf", x, p["wk"])
+        v = jnp.einsum("bne,fe->bnf", x, p["wv"])
+        if "bq" in p:
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        q = q.reshape(B, N, Hh, D)
+        k = k.reshape(B, N, Hh, D)
+        v = v.reshape(B, N, Hh, D)
+        att = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
+        att = jax.nn.softmax(att, axis=-1)
+        ctx = jnp.einsum("bhnm,bmhd->bnhd", att, v).reshape(B, N, E)
+        h = h + jnp.einsum("bne,fe->bnf", ctx, p["wo"]) + p["bo"]
+
+        x = layer_norm(h, p["ln2_w"], p["ln2_b"], eps)
+        x = jnp.einsum("bne,fe->bnf", x, p["fc1_w"]) + p["fc1_b"]
+        x = _act(vcfg, x)
+        h = h + jnp.einsum("bnf,ef->bne", x, p["fc2_w"]) + p["fc2_b"]
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, vparams["blocks"])
+    h = layer_norm(h, vparams["post_ln_w"], vparams["post_ln_b"], eps)
+    return h.astype(out_dtype)
+
+
+def image_features(
+    vcfg: JanusVisionConfig,
+    vparams: dict,
+    aparams: dict,
+    patches: jax.Array,
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """Tower + aligner MLP = HF JanusModel.get_image_features."""
+    h = vision_forward(vcfg, vparams, patches)
+    h = jnp.einsum("bne,pe->bnp", h, aparams["fc1_w"]) + aparams["fc1_b"]
+    for w, b in aparams["hidden"]:
+        h = _act(vcfg, h)
+        h = jnp.einsum("bnp,qp->bnq", h, w) + b
+    return h.astype(out_dtype)
+
+
+def multimodal_prefill(
+    config: ModelConfig,
+    vcfg: JanusVisionConfig,
+    params: dict,
+    vparams: dict,
+    aparams: dict,
+    input_ids: np.ndarray,  # [B, T] with image_token_id placeholders
+    patches: jax.Array,  # [B, N, patch_dim]
+    cache,
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = True,
+):
+    from bigdl_tpu.models._multimodal import scatter_image_features
+
+    img = image_features(vcfg, vparams, aparams, patches)  # [B, Q, E]
+    h = scatter_image_features(config, params, input_ids, img, compute_dtype)
+    return llama.forward(
+        config, params, h, cache, mode="prefill", input_is_hidden=True,
+        compute_dtype=compute_dtype, last_logits_only=last_logits_only,
+    )

@@ -251,26 +251,11 @@ def multimodal_prefill(
     """Vision tower -> resampler -> scatter the query embeddings over the
     placeholder tokens -> standard 1-D-rope prefill (minicpm-v's LLM uses
     plain rope — no M-RoPE)."""
+    from bigdl_tpu.models._multimodal import scatter_image_features
+
     feats = siglip_forward(vcfg, vparams, patches)
     img = resampler_forward(rcfg, rparams, feats, tgt_size)  # [B, Q, E]
-    h = llama.embed_tokens(config, params, jnp.asarray(input_ids), compute_dtype)
-    mask = jnp.asarray(input_ids == config.image_token_id)
-    # per-row placeholder ordinal -> that row's query slot (a global
-    # cumsum would misassign whenever rows don't all carry exactly Q
-    # placeholders, e.g. a text-only row batched with an image row)
-    B = input_ids.shape[0]
-    Q = img.shape[1]
-    counts = np.asarray(input_ids == config.image_token_id).sum(axis=1)
-    if not np.all((counts == Q) | (counts == 0)):  # 0 = text-only row
-        raise ValueError(
-            f"image placeholder count per row {counts.tolist()} must be "
-            f"0 or exactly {Q} (the resampler query count)"
-        )
-    row_cum = jnp.cumsum(mask, axis=1) - 1  # [B, T]
-    idx = jnp.arange(B)[:, None] * Q + jnp.clip(row_cum, 0, Q - 1)
-    flat = img.reshape(-1, img.shape[-1])
-    gathered = flat[idx].astype(compute_dtype)  # [B, T, E]
-    h = jnp.where(mask[..., None], gathered, h)
+    h = scatter_image_features(config, params, input_ids, img, compute_dtype)
     return llama.forward(
         config, params, h, cache, mode="prefill", input_is_hidden=True,
         compute_dtype=compute_dtype, last_logits_only=last_logits_only,

@@ -1,0 +1,152 @@
+"""Janus understanding-path tests against transformers' JanusVisionModel
+/ JanusModel.get_image_features (fp32 CPU eager), plus the scatter
+prefill over the text decoder."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.models import get_family, janus, llama
+from bigdl_tpu.models.config import ModelConfig
+
+
+def tiny_vision_cfg():
+    from transformers import JanusVisionConfig
+
+    return JanusVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, image_size=32, patch_size=16,
+        projection_dim=48, depth=2,
+    )
+
+
+def pixels_to_patches(pixels, p):
+    B, C, Hh, W = pixels.shape
+    g = Hh // p
+    return (
+        pixels.reshape(B, C, g, p, g, p)
+        .transpose(0, 2, 4, 1, 3, 5)
+        .reshape(B, g * g, -1)
+    )
+
+
+def test_janus_vision_tower_matches_hf():
+    from transformers import JanusVisionModel
+
+    cfg = tiny_vision_cfg()
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    model = JanusVisionModel(cfg).eval().to(torch.float32)
+
+    rng = np.random.default_rng(0)
+    pixels = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        hf_out = model(torch.from_numpy(pixels)).last_hidden_state.numpy()
+
+    vcfg = janus.JanusVisionConfig.from_hf(cfg.to_dict())
+    sd = model.state_dict()
+    vparams = janus.vision_params_from_state_dict(
+        vcfg, lambda n: sd[n].numpy(), prefix=""
+    )
+    patches = pixels_to_patches(pixels, 16)
+    ours = janus.vision_forward(vcfg, vparams, jnp.asarray(patches))
+    np.testing.assert_allclose(np.asarray(ours), hf_out, rtol=2e-3, atol=2e-3)
+
+
+def test_janus_image_features_match_hf():
+    from transformers import JanusConfig, JanusModel, JanusVQVAEConfig
+    from transformers.models.llama import LlamaConfig
+
+    vis = tiny_vision_cfg()
+    txt = LlamaConfig(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+    )
+    vq = JanusVQVAEConfig(
+        embed_dim=32, num_embeddings=16, base_channels=32,
+        channel_multiplier=[1, 1], num_res_blocks=1, in_channels=3,
+        out_channels=3, projection_dim=16, image_token_embed_dim=48,
+        num_patches=4,
+    )
+    cfg = JanusConfig(vision_config=vis.to_dict(), text_config=txt.to_dict(),
+                      vq_config=vq.to_dict())
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(1)
+    model = JanusModel(cfg).eval().to(torch.float32)
+
+    rng = np.random.default_rng(1)
+    pixels = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        hf_feats = model.get_image_features(torch.from_numpy(pixels)).numpy()
+
+    vcfg = janus.JanusVisionConfig.from_hf(vis.to_dict())
+    sd = model.state_dict()
+    get = lambda n: sd[n].numpy()
+    vparams = janus.vision_params_from_state_dict(vcfg, get, prefix="vision_model.")
+    aparams = janus.aligner_params_from_state_dict(vcfg, get, prefix="aligner.")
+    patches = pixels_to_patches(pixels, 16)
+    ours = janus.image_features(vcfg, vparams, aparams, jnp.asarray(patches))
+    np.testing.assert_allclose(np.asarray(ours), hf_feats, rtol=3e-3, atol=3e-3)
+
+
+def test_janus_prefill_and_decode():
+    from bigdl_tpu import kvcache
+
+    config = ModelConfig.from_hf_config({
+        "model_type": "janus", "image_token_id": 5,
+        "text_config": {"model_type": "llama", "vocab_size": 96,
+                        "hidden_size": 48, "intermediate_size": 96,
+                        "num_hidden_layers": 1, "num_attention_heads": 4,
+                        "num_key_value_heads": 2},
+    })
+    assert config.image_token_id == 5
+    assert get_family("janus") is janus
+    vcfg = janus.JanusVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=1,
+        num_attention_heads=4, image_size=32, patch_size=16,
+        projection_dim=48, depth=2,
+    )
+    rng = np.random.default_rng(2)
+    params = llama.init_params(config, jax.random.PRNGKey(2), dtype=jnp.float32)
+
+    def w(*shape):
+        return jnp.asarray(rng.standard_normal(shape).astype(np.float32) * 0.05)
+
+    vparams = {
+        "patch_proj": w(32, 3 * 16 * 16), "patch_bias": w(32),
+        "pos_embed": w(4, 32),
+        "blocks": {k: w(1, *s) for k, s in [
+            ("ln1_w", (32,)), ("ln1_b", (32,)), ("ln2_w", (32,)), ("ln2_b", (32,)),
+            ("wq", (32, 32)), ("bq", (32,)), ("wk", (32, 32)), ("bk", (32,)),
+            ("wv", (32, 32)), ("bv", (32,)), ("wo", (32, 32)), ("bo", (32,)),
+            ("fc1_w", (64, 32)), ("fc1_b", (64,)),
+            ("fc2_w", (32, 64)), ("fc2_b", (32,)),
+        ]},
+        "post_ln_w": jnp.ones(32), "post_ln_b": jnp.zeros(32),
+    }
+    aparams = {"fc1_w": w(48, 32), "fc1_b": w(48),
+               "hidden": [(w(48, 48), w(48))]}
+    ids = np.asarray([[7, 5, 5, 5, 5, 9]], np.int32)  # 4 patches -> 4 tokens
+    patches = w(1, 4, 3 * 16 * 16)
+    cache = kvcache.init_cache(1, 1, 16, 2, 12, dtype=jnp.float32)
+    logits, cache = janus.multimodal_prefill(
+        config, vcfg, params, vparams, aparams, ids, patches, cache,
+        compute_dtype=jnp.float32,
+    )
+    assert logits.shape == (1, 1, 96)
+    lg, _ = llama.forward(
+        config, params, jnp.asarray([[11]], np.int32), cache, mode="decode",
+        compute_dtype=jnp.float32,
+    )
+    assert np.all(np.isfinite(np.asarray(lg)))
+    # mismatched placeholder count raises (HF parity)
+    bad = np.asarray([[7, 5, 5, 9, 8, 6]], np.int32)
+    with pytest.raises(ValueError):
+        janus.multimodal_prefill(
+            config, vcfg, params, vparams, aparams, bad, patches,
+            kvcache.init_cache(1, 1, 16, 2, 12, dtype=jnp.float32),
+            compute_dtype=jnp.float32,
+        )
