@@ -16,6 +16,7 @@ Axes:
 """
 
 from bigdl_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from bigdl_tpu.parallel.multihost import host_aware_mesh, init_multihost
 from bigdl_tpu.parallel.sharding import (
     layer_specs,
     param_specs,
@@ -24,6 +25,8 @@ from bigdl_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "host_aware_mesh",
+    "init_multihost",
     "make_mesh",
     "mesh_shape_for",
     "param_specs",

@@ -1,0 +1,115 @@
+"""Multi-host initialization and host-aware mesh layout.
+
+The reference scales past one machine with per-feature process backends
+— Intel MPI k8s jobs for training, oneCCL process groups for pipeline
+parallelism, Ray actors for vLLM TP (SURVEY.md §2.3). The TPU-native
+replacement is ONE call per process (`jax.distributed.initialize`) after
+which `jax.devices()` is the global device set and every jitted program
+in this framework — generate, the serving engine, the (dp, sp, tp, pp)
+train steps — runs SPMD across hosts with zero further changes: XLA
+lays collectives on ICI within a slice and DCN across slices.
+
+The one thing that DOES need care across hosts is the MESH LAYOUT:
+axes that carry heavy collectives (tp's per-layer psum, sp's per-step
+ppermute ring) must stay inside a host/slice so they ride ICI, while
+light axes (dp's once-per-step gradient reduce, pp's once-per-
+microbatch boundary transfer) absorb the slow DCN hops.
+`host_aware_mesh` builds exactly that layout from `jax.local_device_count()`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host job (the reference's mpirun/Ray launch step).
+
+    On TPU pods with standard launchers (GKE, queued resources) all
+    arguments auto-detect and this is `jax.distributed.initialize()`
+    verbatim. Explicit args (or BIGDL_TPU_COORDINATOR / _NUM_PROCS /
+    _PROC_ID env fallbacks) cover bare-metal launches. Safe to call on
+    a single host: with no coordinator configured it is a no-op.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "BIGDL_TPU_COORDINATOR"
+    )
+    if num_processes is None and os.environ.get("BIGDL_TPU_NUM_PROCS"):
+        num_processes = int(os.environ["BIGDL_TPU_NUM_PROCS"])
+    if process_id is None and os.environ.get("BIGDL_TPU_PROC_ID"):
+        process_id = int(os.environ["BIGDL_TPU_PROC_ID"])
+    explicit = (coordinator_address, num_processes, process_id)
+    if any(v is not None for v in explicit):
+        if any(v is None for v in explicit):
+            # a partial config silently auto-joining would give the
+            # process a wrong identity — fail loudly instead
+            raise ValueError(
+                "init_multihost needs coordinator_address, num_processes "
+                f"AND process_id together; got {explicit}"
+            )
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return
+    # auto-detect ONLY when a distributed launcher left its markers —
+    # and then let failures propagate: swallowing them would silently
+    # degrade a pod job to one host (other processes would hang in
+    # cross-host collectives waiting for this one)
+    markers = ("COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+               "MEGASCALE_COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID")
+    if any(m in os.environ for m in markers):
+        jax.distributed.initialize()
+
+
+def host_aware_mesh(
+    tp: int = 1,
+    sp: int = 1,
+    pp: int = 1,
+    dp: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    local_devices: Optional[int] = None,
+    axes: Sequence[str] = ("dp", "pp", "sp", "tp"),
+) -> Mesh:
+    """A (dp, pp, sp, tp) mesh whose heavy axes stay intra-host.
+
+    Devices order host-major (jax.devices() already groups by process);
+    the mesh reshapes so tp (fastest-varying) and sp tile WITHIN one
+    host's devices whenever tp*sp <= local_device_count — their
+    per-layer/per-step collectives then never cross DCN — and dp/pp
+    span hosts. Raises if tp*sp cannot fit in one host, with the
+    cross-DCN implication spelled out, unless BIGDL_TPU_ALLOW_DCN_TP=1.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    local = local_devices or jax.local_device_count()
+    if dp is None:
+        dp = n // (tp * sp * pp)
+    if dp * pp * sp * tp != n:
+        raise ValueError(
+            f"dp*pp*sp*tp = {dp}*{pp}*{sp}*{tp} != {n} devices"
+        )
+    # contiguity of a tp row within one host requires tp*sp to DIVIDE the
+    # local device count, not merely fit in it (tp=6 on local=8 would
+    # straddle the host boundary at device 8)
+    if (tp * sp > local or local % (tp * sp) != 0) \
+            and os.environ.get("BIGDL_TPU_ALLOW_DCN_TP") != "1":
+        raise ValueError(
+            f"tp*sp = {tp * sp} does not tile the {local} devices of one "
+            "host: per-layer tensor-parallel psums would cross DCN and "
+            "dominate step time. Pick tp*sp dividing the local device "
+            "count and shard the rest over pp/dp across hosts, or set "
+            "BIGDL_TPU_ALLOW_DCN_TP=1 to accept the slow layout."
+        )
+    from bigdl_tpu.parallel.mesh import make_mesh
+
+    return make_mesh((dp, pp, sp, tp), devices=devices, axes=axes)

@@ -133,3 +133,52 @@ def test_ring_with_left_padding(rng, sp_mesh):
     np.testing.assert_allclose(
         np.asarray(ring)[1], np.asarray(dense)[1], rtol=2e-5, atol=2e-5
     )
+
+
+def test_host_aware_mesh_layout():
+    """tp stays within a simulated host's device block; oversubscription
+    raises with the DCN warning."""
+    import pytest
+
+    from bigdl_tpu.parallel.multihost import host_aware_mesh
+
+    devs = jax.devices()[:8]
+    # simulate 2 hosts x 4 local devices
+    mesh = host_aware_mesh(tp=4, dp=2, devices=devs, local_devices=4)
+    assert mesh.axis_names == ("dp", "pp", "sp", "tp")
+    assert mesh.devices.shape == (2, 1, 1, 4)
+    # each tp row must be one host's contiguous block
+    row0 = mesh.devices[0, 0, 0, :].tolist()
+    assert row0 == devs[:4]
+
+    with pytest.raises(ValueError, match="DCN"):
+        host_aware_mesh(tp=8, devices=devs, local_devices=4)
+
+    # generate on a host-aware mesh stays bit-identical
+    from bigdl_tpu.api import TpuModel, optimize_model
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+
+    cfg = PRESETS["tiny-llama"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    m = TpuModel(cfg, optimize_model(params, cfg), "sym_int4")
+    single = m.generate([[1, 2, 3, 4]], max_new_tokens=6)
+    sharded = m.to_mesh(mesh=host_aware_mesh(tp=2, dp=4, devices=devs,
+                                             local_devices=4))
+    np.testing.assert_array_equal(single, sharded.generate([[1, 2, 3, 4]],
+                                                           max_new_tokens=6))
+
+
+def test_init_multihost_guards(monkeypatch):
+    import pytest
+
+    from bigdl_tpu.parallel.multihost import init_multihost
+
+    # partial explicit config fails loudly
+    with pytest.raises(ValueError, match="together"):
+        init_multihost(process_id=3)
+    # no markers, no explicit config: clean no-op on a single host
+    for m in ("COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+              "MEGASCALE_COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID"):
+        monkeypatch.delenv(m, raising=False)
+    init_multihost()
