@@ -595,6 +595,99 @@ def _yuan_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     }
 
 
+def _qwen3_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Qwen3: llama names + per-head q/k RMSNorm weights."""
+    out = _llama_layer(config, i, get)
+    p = f"model.layers.{i}."
+    out["q_norm"] = get(p + "self_attn.q_norm.weight")
+    out["k_norm"] = get(p + "self_attn.k_norm.weight")
+    return out
+
+
+def _qwen3_moe_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    p = f"model.layers.{i}."
+    E = config.num_experts
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "q_norm": get(p + "self_attn.q_norm.weight"),
+        "k_norm": get(p + "self_attn.k_norm.weight"),
+        "router": get(p + "mlp.gate.weight"),
+        "w_gate_e": np.stack(
+            [get(p + f"mlp.experts.{e}.gate_proj.weight") for e in range(E)]
+        ),
+        "w_up_e": np.stack(
+            [get(p + f"mlp.experts.{e}.up_proj.weight") for e in range(E)]
+        ),
+        "w_down_e": np.stack(
+            [get(p + f"mlp.experts.{e}.down_proj.weight") for e in range(E)]
+        ),
+    }
+
+
+def _phi_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Phi-1/2: parallel attn+mlp read the SAME input layernorm — it
+    loads into both attn_norm and mlp_norm slots (falcon-7b pattern);
+    fc1/fc2 MLP and `self_attn.dense` output, all biased."""
+    p = f"model.layers.{i}."
+    ln_w = get(p + "input_layernorm.weight")
+    ln_b = get(p + "input_layernorm.bias")
+    return {
+        "attn_norm": ln_w, "attn_norm_b": ln_b,
+        "mlp_norm": ln_w, "mlp_norm_b": ln_b,
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "bq": get(p + "self_attn.q_proj.bias"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "bk": get(p + "self_attn.k_proj.bias"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "bv": get(p + "self_attn.v_proj.bias"),
+        "wo": get(p + "self_attn.dense.weight"),
+        "bo": get(p + "self_attn.dense.bias"),
+        "w_up": get(p + "mlp.fc1.weight"),
+        "b_up": get(p + "mlp.fc1.bias"),
+        "w_down": get(p + "mlp.fc2.weight"),
+        "b_down": get(p + "mlp.fc2.bias"),
+    }
+
+
+def _phi_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    out = {
+        "embed": get("model.embed_tokens.weight"),
+        "final_norm": get("model.final_layernorm.weight"),
+        "final_norm_b": get("model.final_layernorm.bias"),
+    }
+    if not config.tie_word_embeddings:
+        out["lm_head"] = get("lm_head.weight")
+        out["lm_head_b"] = get("lm_head.bias")
+    return out
+
+
+def _cohere_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Cohere: one shared bias-free LayerNorm feeds both parallel
+    branches."""
+    p = f"model.layers.{i}."
+    ln = get(p + "input_layernorm.weight")
+    out = {
+        "attn_norm": ln, "mlp_norm": ln,
+        "wq": get(p + "self_attn.q_proj.weight"),
+        "wk": get(p + "self_attn.k_proj.weight"),
+        "wv": get(p + "self_attn.v_proj.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "w_gate": get(p + "mlp.gate_proj.weight"),
+        "w_up": get(p + "mlp.up_proj.weight"),
+        "w_down": get(p + "mlp.down_proj.weight"),
+    }
+    if config.attention_bias:
+        out["bq"] = get(p + "self_attn.q_proj.bias")
+        out["bk"] = get(p + "self_attn.k_proj.bias")
+        out["bv"] = get(p + "self_attn.v_proj.bias")
+    return out
+
+
 def _falcon_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     """Falcon fused query_key_value is grouped per kv-head
     ([q0..q_{g-1}, k, v] x num_kv, HF FalconAttention._split_heads):
@@ -726,6 +819,10 @@ _FAMILY_LAYER = {
     "rwkv": _rwkv_layer,
     "rwkv5": _rwkv_layer,
     "falcon": _falcon_layer,
+    "qwen3": _qwen3_layer,
+    "qwen3_moe": _qwen3_moe_layer,
+    "phi": _phi_layer,
+    "cohere": _cohere_layer,
     "yuan": _yuan_layer,
     "minicpmv": _minicpmv_layer,
     "internvl": _internvl_layer,
@@ -744,6 +841,7 @@ _FAMILY_TOP = {
     "rwkv": _rwkv_top,
     "rwkv5": _rwkv_top,
     "falcon": _falcon_top,
+    "phi": _phi_top,
     "minicpmv": _minicpmv_top,
     "internvl": _internvl_top,
     "janus": _janus_top,

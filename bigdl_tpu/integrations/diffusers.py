@@ -1,0 +1,124 @@
+"""Stable Diffusion (diffusers) integration — gated.
+
+Counterpart of the reference's sd support
+(/root/reference/python/llm/src/ipex_llm/transformers/models/sd.py:
+an `AttnProcessor2_0` subclass that routes diffusers UNet/transformer
+attention through its fused SYCL sdp kernels, + `upcast_vae`). Here the
+processor routes through `bigdl_tpu.ops.attention` (jnp; XLA fuses it),
+so a diffusers pipeline whose tensors are torch-CPU round-trips through
+the TPU for its attention — the same scope the reference covers (it
+does not reimplement the UNet either; it accelerates attention inside
+stock diffusers).
+
+The `diffusers` package is NOT part of this environment's baked deps,
+so everything here degrades with a clear ImportError at use time (the
+module itself always imports). The processor is deliberately
+torch<->jax boundary-explicit: inputs arrive as torch tensors from
+diffusers' attention call protocol and return as torch tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+HAVE_DIFFUSERS = True
+try:  # pragma: no cover - environment without diffusers
+    import diffusers  # noqa: F401
+except Exception:
+    HAVE_DIFFUSERS = False
+
+
+class TpuAttnProcessor:
+    """Drop-in diffusers attention processor (reference sd.py:45-143).
+
+    Usage (requires `pip install diffusers`):
+
+        pipe = StableDiffusionPipeline.from_pretrained(...)
+        pipe.unet.set_attn_processor(TpuAttnProcessor())
+    """
+
+    def __init__(self):
+        if not HAVE_DIFFUSERS:
+            raise ImportError(
+                "TpuAttnProcessor needs the `diffusers` package, which is "
+                "not installed in this environment (pip install diffusers)"
+            )
+
+    def __call__(
+        self,
+        attn,
+        hidden_states,
+        encoder_hidden_states=None,
+        attention_mask=None,
+        temb=None,
+        **kwargs,
+    ):
+        import jax.numpy as jnp
+        import numpy as np
+        import torch
+
+        from bigdl_tpu.ops import attention as tpu_attention
+
+        residual = hidden_states
+        if attn.spatial_norm is not None:
+            hidden_states = attn.spatial_norm(hidden_states, temb)
+
+        input_ndim = hidden_states.ndim
+        if input_ndim == 4:
+            b, c, h, w = hidden_states.shape
+            hidden_states = hidden_states.view(b, c, h * w).transpose(1, 2)
+
+        if attn.group_norm is not None:
+            hidden_states = attn.group_norm(
+                hidden_states.transpose(1, 2)
+            ).transpose(1, 2)
+
+        query = attn.to_q(hidden_states)
+        ctx = (hidden_states if encoder_hidden_states is None
+               else encoder_hidden_states)
+        if attn.norm_cross and encoder_hidden_states is not None:
+            ctx = attn.norm_encoder_hidden_states(ctx)
+        key = attn.to_k(ctx)
+        value = attn.to_v(ctx)
+
+        heads = attn.heads
+        B, T, _ = query.shape
+        S = key.shape[1]
+
+        def to_jax(t, n):
+            return jnp.asarray(
+                t.detach().to(torch.float32).numpy()
+            ).reshape(B, n, heads, -1)
+
+        mask = None
+        if attention_mask is not None:
+            am = attn.prepare_attention_mask(attention_mask, S, B)
+            mask = jnp.asarray(
+                am.detach().to(torch.float32).numpy()
+            ).reshape(B, heads, 1, -1, S)  # additive bias [B,Hkv,G,T,S]
+
+        out = tpu_attention(
+            to_jax(query, T), to_jax(key, S), to_jax(value, S), mask
+        )
+        out = torch.from_numpy(np.asarray(out).reshape(B, T, -1)).to(
+            residual.dtype
+        )
+
+        out = attn.to_out[0](out)
+        out = attn.to_out[1](out)  # dropout (identity at inference)
+
+        if input_ndim == 4:
+            out = out.transpose(-1, -2).reshape(b, c, h, w)
+        if attn.residual_connection:
+            out = out + residual
+        return out / attn.rescale_output_factor
+
+
+def upcast_vae(pipe) -> None:
+    """Run the VAE in float32 (reference sd.py:145-152: SD upscaler VAEs
+    overflow in fp16)."""
+    if not HAVE_DIFFUSERS:
+        raise ImportError("upcast_vae needs the `diffusers` package")
+    import torch
+
+    pipe.vae.to(dtype=torch.float32)

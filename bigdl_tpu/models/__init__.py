@@ -38,6 +38,10 @@ _FAMILIES = {
     "gpt_neox": llama,
     "mixtral": llama,
     "qwen2_moe": llama,
+    "qwen3": llama,  # per-head qk RMSNorm via qk_norm flag
+    "qwen3_moe": llama,
+    "phi": llama,  # parallel residual + shared norm, biased everything
+    "cohere": llama,  # parallel residual, interleaved rope, logit scale
     "yi": llama,
     # parallel attn/mlp + grouped fused qkv, translated in
     # config._hf_falcon and convert/hf._falcon_layer

@@ -57,6 +57,7 @@ class ModelConfig:
     # minicpm residual scaling: hidden += scale_depth/sqrt(L) * block_out
     residual_scale: Optional[float] = None
     logit_scale: Optional[float] = None  # minicpm/cohere: logits *= scale
+    lm_head_bias: bool = False  # phi-1/2: the lm head carries a bias
     # positions
     partial_rotary_factor: float = 1.0  # stablelm 0.25, glm 0.5
     rope_interleaved: bool = False  # GPT-NeoX/GLM pair-interleaved rope
@@ -467,6 +468,64 @@ def _hf_minicpm3(hf, kw):
     _mla_fields(hf, kw)
 
 
+def _hf_qwen3(hf, kw):
+    """Qwen3: qwen2 minus the qkv bias plus per-head q/k RMSNorm."""
+    kw["qk_norm"] = True
+    kw.setdefault("head_dim", hf.get("head_dim"))
+
+
+def _hf_qwen3_moe(hf, kw):
+    _hf_qwen3(hf, kw)
+    kw["num_experts"] = hf.get("num_experts", 128)
+    kw["num_experts_per_tok"] = hf.get("num_experts_per_tok", 8)
+    kw["moe_intermediate_size"] = hf.get("moe_intermediate_size", 768)
+    kw["norm_topk_prob"] = hf.get("norm_topk_prob", True)
+    if hf.get("mlp_only_layers") or hf.get("decoder_sparse_step", 1) != 1:
+        # mixed dense/MoE stacks would hit the translator with dense
+        # layers lacking expert weights — fail with a clear message
+        raise NotImplementedError(
+            "qwen3_moe with mlp_only_layers/decoder_sparse_step != 1"
+        )
+
+
+def _hf_phi(hf, kw):
+    """Phi-1/1.5/2 (HF modeling_phi): parallel attn+mlp sharing ONE
+    input layernorm (the translator duplicates it, like falcon-7b),
+    biased linears everywhere incl. the lm head, partial rotary,
+    gelu_new MLP."""
+    kw["norm_type"] = "layernorm"
+    kw["norm_bias"] = True
+    kw["parallel_residual"] = True
+    kw["gated_mlp"] = False
+    kw["mlp_bias"] = True
+    kw["attention_bias"] = True
+    kw["attention_out_bias"] = True
+    kw["lm_head_bias"] = True
+    kw["rms_norm_eps"] = hf.get("layer_norm_eps", 1e-5)
+    kw.setdefault("partial_rotary_factor", hf.get("partial_rotary_factor", 0.5))
+    kw["hidden_act"] = hf.get("hidden_act", "gelu_new")
+    if hf.get("qk_layernorm"):
+        # the translator would silently drop q/k layernorm weights
+        raise NotImplementedError("phi with qk_layernorm=True")
+
+
+def _hf_cohere(hf, kw):
+    """Cohere / Command-R: bias-free LayerNorm, parallel attn+mlp over
+    one shared norm, interleaved rope, logits scaled by logit_scale,
+    tied embeddings."""
+    kw["norm_type"] = "layernorm"
+    kw["parallel_residual"] = True
+    kw["rope_interleaved"] = True
+    kw["rms_norm_eps"] = hf.get("layer_norm_eps", 1e-5)
+    kw["logit_scale"] = hf.get("logit_scale", 0.0625)
+    kw["attention_bias"] = bool(hf.get("attention_bias", False))
+    kw.setdefault("tie_word_embeddings", hf.get("tie_word_embeddings", True))
+    if hf.get("use_qk_norm"):
+        raise NotImplementedError(
+            "cohere use_qk_norm=True (per-head LayerNorm) is not supported"
+        )
+
+
 def _hf_janus(hf, kw):
     """Janus/Janus-Pro understanding path: the merged text_config is
     llama-shaped; keep the image placeholder id for the feature
@@ -615,6 +674,10 @@ _HF_BUILDERS = {
     "minicpm3": _hf_minicpm3,
     "internvl": _hf_internvl,
     "janus": _hf_janus,
+    "qwen3": _hf_qwen3,
+    "qwen3_moe": _hf_qwen3_moe,
+    "phi": _hf_phi,
+    "cohere": _hf_cohere,
 }
 
 

@@ -127,6 +127,8 @@ def init_params(
         params["embed_norm_b"] = jnp.zeros((H,), dtype)
     if not config.tie_word_embeddings:
         params["lm_head"] = w((V, H))
+        if config.lm_head_bias:
+            params["lm_head_b"] = jnp.zeros((V,), dtype)
     return params
 
 
@@ -322,7 +324,9 @@ def lm_head_logits(config: ModelConfig, params: Params, h: jax.Array,
         h = rms_norm(h, params["final_norm"], config.rms_norm_eps,
                      offset=config.rms_norm_offset)
     lm_head = params.get("lm_head", params["embed"])
-    logits = linear(h, lm_head, None, compute_dtype).astype(jnp.float32)
+    logits = linear(
+        h, lm_head, params.get("lm_head_b"), compute_dtype
+    ).astype(jnp.float32)
     if config.logit_scale:
         logits = logits * config.logit_scale
     return _softcap(logits, config.final_logit_softcap)

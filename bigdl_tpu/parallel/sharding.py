@@ -96,6 +96,8 @@ def param_specs(config: ModelConfig, tie_word_embeddings: bool | None = None) ->
         specs.update({"embed_norm": _REP, "embed_norm_b": _REP})
     if not tie:
         specs["lm_head"] = P("tp", None)
+        # phi: the lm head bias shards with its output (vocab) axis
+        specs["lm_head_b"] = P("tp") if config.lm_head_bias else _REP
     return specs
 
 

@@ -395,3 +395,72 @@ def test_falcon_rw_style_equivalence():
     config = check(cfg, model)
     assert config.alibi and not config.parallel_residual
     assert config.attention_bias and config.mlp_bias
+
+
+def test_qwen3_equivalence():
+    cfg, model = hf_tiny(
+        "Qwen3ForCausalLM", "Qwen3Config",
+        **{**COMMON, "head_dim": 16, "rope_theta": 1000000.0},
+    )
+    config = check(cfg, model)
+    assert config.qk_norm and not config.attention_bias
+
+
+def test_qwen3_moe_equivalence():
+    cfg, model = hf_tiny(
+        "Qwen3MoeForCausalLM", "Qwen3MoeConfig",
+        **{**COMMON, "head_dim": 16, "num_experts": 4,
+           "num_experts_per_tok": 2, "moe_intermediate_size": 32,
+           "norm_topk_prob": True},
+    )
+    config = check(cfg, model)
+    assert config.num_experts == 4 and config.norm_topk_prob
+
+
+def test_phi_equivalence():
+    cfg, model = hf_tiny(
+        "PhiForCausalLM", "PhiConfig",
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=64,
+    )
+    config = check(cfg, model)
+    assert config.parallel_residual and config.lm_head_bias
+    assert config.partial_rotary_factor == 0.5
+
+
+def test_cohere_equivalence():
+    cfg, model = hf_tiny(
+        "CohereForCausalLM", "CohereConfig",
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        logit_scale=0.25, use_qk_norm=False, max_position_embeddings=64,
+    )
+    config = check(cfg, model)
+    assert config.parallel_residual and config.rope_interleaved
+    assert config.logit_scale == 0.25 and config.tie_word_embeddings
+
+
+def test_phi_shards_with_lm_head_bias():
+    """phi's lm_head_b must survive to_mesh (sharding specs cover it)."""
+    import jax as _jax
+
+    from bigdl_tpu.api import TpuModel, optimize_model
+    from bigdl_tpu.models import llama as _llama
+
+    config = ModelConfig(
+        model_type="phi", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, norm_type="layernorm", norm_bias=True,
+        parallel_residual=True, gated_mlp=False, mlp_bias=True,
+        attention_bias=True, attention_out_bias=True, lm_head_bias=True,
+        partial_rotary_factor=0.5, hidden_act="gelu_new",
+    )
+    params = _llama.init_params(config, _jax.random.PRNGKey(0))
+    assert "lm_head_b" in params
+    m = TpuModel(config, optimize_model(params, config), "sym_int4")
+    single = m.generate([[1, 2, 3, 4]], max_new_tokens=6)
+    sharded = m.to_mesh(tp=2)
+    np.testing.assert_array_equal(
+        single, sharded.generate([[1, 2, 3, 4]], max_new_tokens=6)
+    )

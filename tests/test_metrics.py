@@ -162,3 +162,18 @@ def test_invalid_input_error_helper(caplog):
         with pytest.raises(InvalidInputError, match="bad thing"):
             invalid_input_error(False, "bad thing")
     assert any("bad thing" in r.getMessage() for r in caplog.records)
+
+
+def test_diffusers_integration_gated():
+    """Without the diffusers package the module imports cleanly and its
+    entry points raise a clear ImportError (the environment gates it)."""
+    import pytest
+
+    from bigdl_tpu.integrations import diffusers as d
+
+    if d.HAVE_DIFFUSERS:  # pragma: no cover - env with diffusers
+        pytest.skip("diffusers installed")
+    with pytest.raises(ImportError, match="diffusers"):
+        d.TpuAttnProcessor()
+    with pytest.raises(ImportError, match="diffusers"):
+        d.upcast_vae(None)
