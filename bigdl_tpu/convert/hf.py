@@ -106,6 +106,38 @@ def _gemma2_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray
     }
 
 
+def _gemma3_get(get: Get) -> Get:
+    """Multimodal gemma3 checkpoints (4B+) keep text weights under
+    `model.language_model.` (HF >= 4.52) or `language_model.model.`
+    (original releases); gemma3_text (1B) uses bare `model.` names."""
+
+    def g(name):
+        try:
+            return get(name)
+        except KeyError:
+            pass
+        try:
+            return get("model.language_" + name)
+        except KeyError:
+            return get("language_model." + name)
+
+    return g
+
+
+def _gemma3_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """gemma2 norm quartet + per-head q/k RMSNorm."""
+    g = _gemma3_get(get)
+    out = _gemma2_layer(config, i, g)
+    p = f"model.layers.{i}."
+    out["q_norm"] = g(p + "self_attn.q_norm.weight")
+    out["k_norm"] = g(p + "self_attn.k_norm.weight")
+    return out
+
+
+def _gemma3_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    return _llama_top(config, _gemma3_get(get))
+
+
 def _phi3_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     """phi3 ships fused qkv_proj [QD+2*KD, H] and gate_up_proj [2I, H]
     (reference models/phi3.py attention path); split for our layout."""
@@ -803,6 +835,8 @@ def _rwkv_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
 
 _FAMILY_LAYER = {
     "gemma2": _gemma2_layer,
+    "gemma3": _gemma3_layer,
+    "gemma3_text": _gemma3_layer,
     "phi3": _phi3_layer,
     "baichuan": _baichuan_layer,
     "internlm2": _internlm2_layer,
@@ -842,6 +876,8 @@ _FAMILY_TOP = {
     "rwkv5": _rwkv_top,
     "falcon": _falcon_top,
     "phi": _phi_top,
+    "gemma3": _gemma3_top,
+    "gemma3_text": _gemma3_top,
     "minicpmv": _minicpmv_top,
     "internvl": _internvl_top,
     "janus": _janus_top,

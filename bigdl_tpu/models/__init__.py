@@ -21,6 +21,8 @@ _FAMILIES = {
     "qwen2": llama,
     "gemma": llama,
     "gemma2": llama,
+    "gemma3": llama,  # dual rope via rope_local_theta + layer_types
+    "gemma3_text": llama,
     "phi3": llama,
     "baichuan": llama,
     "internlm2": llama,

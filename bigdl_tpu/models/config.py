@@ -38,6 +38,12 @@ class ModelConfig:
     # gemma2/gemma3: layer l uses sliding attention iff (l+1) % pattern != 0
     # (None = every layer sliding when sliding_window is set, like mistral)
     sliding_window_pattern: Optional[int] = None
+    # explicit per-layer sliding flags (gemma3 layer_types); overrides the
+    # pattern when set
+    sliding_layers: Optional[tuple] = None
+    # gemma3: sliding layers rope with this base instead of rope_theta
+    # (and without the global layers' rope_scaling)
+    rope_local_theta: Optional[float] = None
     attn_logit_softcap: Optional[float] = None  # gemma2
     final_logit_softcap: Optional[float] = None  # gemma2
     # attention scale override (gemma2 query_pre_attn_scalar**-0.5); None =
@@ -124,6 +130,14 @@ class ModelConfig:
         elif isinstance(rs, (list, tuple)):
             rs = tuple((k, _hashable(v)) for k, v in rs)
         object.__setattr__(self, "rope_scaling", rs)
+        # list-typed fields arrive as lists after a JSON round-trip
+        # (save_low_bit -> load_low_bit) and must re-become tuples or the
+        # config stops hashing as a static jit argument
+        for f in ("sliding_layers", "cross_attention_layers",
+                  "mrope_section"):
+            v = getattr(self, f)
+            if isinstance(v, list):
+                object.__setattr__(self, f, tuple(v))
 
     @property
     def rope_scaling_dict(self) -> Optional[dict]:
@@ -152,9 +166,12 @@ class ModelConfig:
         return self.num_experts > 0
 
     def layer_is_sliding(self, layer_idx: int) -> bool:
-        """Static per-layer attention kind (gemma2 alternation)."""
+        """Static per-layer attention kind (gemma2 alternation / gemma3
+        explicit layer_types)."""
         if self.sliding_window is None:
             return False
+        if self.sliding_layers is not None:
+            return bool(self.sliding_layers[layer_idx])
         if self.sliding_window_pattern is None:
             return True
         return (layer_idx + 1) % self.sliding_window_pattern != 0
@@ -235,6 +252,26 @@ def _hf_gemma2(hf, kw):
     kw["sliding_window_pattern"] = 2
     if "query_pre_attn_scalar" in hf:
         kw["attn_scale"] = hf["query_pre_attn_scalar"] ** -0.5
+
+
+def _hf_gemma3(hf, kw):
+    """Gemma3 text (HF Gemma3TextConfig): gemma2's norms/scales plus
+    per-head q/k RMSNorm and DUAL rope — full-attention layers use
+    rope_theta (+rope_scaling), sliding layers rope_local_base_freq
+    unscaled. layer_types lists the alternation explicitly."""
+    _hf_gemma(hf, kw)
+    kw["post_attn_norm"] = True
+    kw["qk_norm"] = True
+    kw.setdefault("head_dim", hf.get("head_dim", 256))
+    kw["rms_norm_eps"] = hf.get("rms_norm_eps", 1e-6)
+    if "query_pre_attn_scalar" in hf:
+        kw["attn_scale"] = hf["query_pre_attn_scalar"] ** -0.5
+    lt = hf.get("layer_types")
+    if lt:
+        kw["sliding_layers"] = tuple(t == "sliding_attention" for t in lt)
+    else:
+        kw["sliding_window_pattern"] = hf.get("sliding_window_pattern", 6)
+    kw["rope_local_theta"] = hf.get("rope_local_base_freq", 10000.0)
 
 
 def _hf_phi3(hf, kw):
@@ -479,7 +516,7 @@ def _hf_qwen3_moe(hf, kw):
     kw["num_experts"] = hf.get("num_experts", 128)
     kw["num_experts_per_tok"] = hf.get("num_experts_per_tok", 8)
     kw["moe_intermediate_size"] = hf.get("moe_intermediate_size", 768)
-    kw["norm_topk_prob"] = hf.get("norm_topk_prob", True)
+    kw["norm_topk_prob"] = hf.get("norm_topk_prob", False)  # HF default
     if hf.get("mlp_only_layers") or hf.get("decoder_sparse_step", 1) != 1:
         # mixed dense/MoE stacks would hit the translator with dense
         # layers lacking expert weights — fail with a clear message
@@ -650,6 +687,8 @@ _HF_BUILDERS = {
     "mpt": _hf_mpt,
     "gemma": _hf_gemma,
     "gemma2": _hf_gemma2,
+    "gemma3": _hf_gemma3,
+    "gemma3_text": _hf_gemma3,
     "phi3": _hf_phi3,
     "stablelm": _hf_stablelm,
     "starcoder2": _hf_starcoder2,

@@ -556,6 +556,7 @@ def forward(
             )
 
     use_rope = not (config.alibi or config.learned_positions)
+    cos_local = sin_local = None
     if use_rope:
         inv_freq, att_scale = make_inv_freq_scaled(
             config.rotary_dim, config.rope_theta, config.rope_scaling_dict,
@@ -573,6 +574,15 @@ def forward(
                 positions, inv_freq, interleaved=config.rope_interleaved,
                 scale=att_scale,
             )
+        if config.rope_local_theta is not None:
+            # gemma3 dual rope: sliding layers use the local base,
+            # UNscaled (HF applies rope_scaling to global layers only)
+            inv_local, _ = make_inv_freq_scaled(
+                config.rotary_dim, config.rope_local_theta, None
+            )
+            cos_local, sin_local = rope_cos_sin(
+                positions, inv_local, interleaved=config.rope_interleaved
+            )
     else:
         cos = sin = None
 
@@ -582,7 +592,8 @@ def forward(
     # sdp_causal vs sdp dispatch (models/common.py:222-258).
     from bigdl_tpu.ops.pallas import use_pallas
 
-    uniform_window = config.sliding_window_pattern is None
+    uniform_window = (config.sliding_window_pattern is None
+                      and config.sliding_layers is None)
     use_flash = (
         cache is not None and mode == "prefill" and T > 1 and use_pallas()
         and uniform_window and not config.alibi
@@ -676,7 +687,13 @@ def forward(
             q = rms_norm(q, p["q_norm"], eps, offset=config.rms_norm_offset)
             k = rms_norm(k, p["k_norm"], eps, offset=config.rms_norm_offset)
         if use_rope:
-            q, k = apply_rotary_emb(q, k, cos, sin, config.rope_interleaved)
+            if cos_local is not None:
+                is_sliding_l = sliding_flags[layer_offset + idx]
+                cos_l = jnp.where(is_sliding_l, cos_local, cos)
+                sin_l = jnp.where(is_sliding_l, sin_local, sin)
+            else:
+                cos_l, sin_l = cos, sin
+            q, k = apply_rotary_emb(q, k, cos_l, sin_l, config.rope_interleaved)
 
         if c is not None:
             c = kvcache.update_layer(c, idx, k, v)

@@ -464,3 +464,37 @@ def test_phi_shards_with_lm_head_bias():
     np.testing.assert_array_equal(
         single, sharded.generate([[1, 2, 3, 4]], max_new_tokens=6)
     )
+
+
+def test_gemma3_equivalence():
+    """gemma3: qk-norm + DUAL rope (sliding layers at the local base,
+    full layers at the scaled global base) + explicit layer_types."""
+    cfg, model = hf_tiny(
+        "Gemma3ForCausalLM", "Gemma3TextConfig",
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, query_pre_attn_scalar=16, sliding_window=4,
+        rope_theta=1000000.0, rope_local_base_freq=10000.0,
+        layer_types=["sliding_attention", "full_attention",
+                     "sliding_attention", "sliding_attention"],
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+        max_position_embeddings=64,
+    )
+    config = check(cfg, model)
+    assert config.qk_norm and config.rope_local_theta == 10000.0
+    assert config.sliding_layers == (True, False, True, True)
+    assert config.layer_is_sliding(0) and not config.layer_is_sliding(1)
+
+
+def test_gemma3_config_json_roundtrip_stays_hashable():
+    import dataclasses as _dc
+    import json as _json
+
+    config = ModelConfig(
+        model_type="gemma3_text", sliding_window=4,
+        sliding_layers=(True, False), rope_local_theta=10000.0,
+    )
+    blob = _json.loads(_json.dumps(_dc.asdict(config)))
+    rt = ModelConfig(**blob)
+    hash(rt)  # must stay a valid static jit argument
+    assert rt.sliding_layers == (True, False)
