@@ -11,13 +11,19 @@ the same protocol as the reference's perf CI
 
 Supported test_api values (reference config.yaml lists ~30; ours map the
 TPU-relevant subset):
-    transformer_int4   — sym_int4 weights, plain generate
-    transformer_bf16   — dense bf16
+    transformer_<qtype> — any registered qtype (sym_int4, nf4, q4_k_m,
+                          fp8_e4m3, bf16, ...), plain generate
     fp8_kv             — sym_int4 weights + FP8 KV cache
     compress_kv        — sym_int4 + SnapKV compression
     speculative        — bf16 target + int4 self-draft
     lookup             — prompt-lookup decoding
     serving_engine     — continuous-batching engine throughput
+    paged_serving      — engine with paged KV pool + prefix caching
+    tensor_parallel    — sym_int4 sharded over a tp mesh (cfg key `tp`,
+                          default all devices; reference Deepspeed-AutoTP
+                          mode)
+    pipeline_parallel  — sym_int4 over a pp mesh (cfg key `pp`; reference
+                          pipeline_parallel_gpu mode)
 """
 
 from __future__ import annotations
@@ -38,7 +44,18 @@ QTYPE_FOR_API = {
     "speculative": "bf16",
     "lookup": "sym_int4",
     "serving_engine": "sym_int4",
+    "paged_serving": "sym_int4",
+    "tensor_parallel": "sym_int4",
+    "pipeline_parallel": "sym_int4",
 }
+
+
+def qtype_for(api: str) -> str:
+    if api in QTYPE_FOR_API:
+        return QTYPE_FOR_API[api]
+    if api.startswith("transformer_"):  # transformer_nf4, transformer_q4_k_m…
+        return api[len("transformer_"):]
+    return "sym_int4"
 
 
 def load_model(path_or_preset: str, qtype: str):
@@ -60,7 +77,8 @@ def load_model(path_or_preset: str, qtype: str):
     return AutoModelForCausalLM.from_pretrained(path_or_preset, load_in_low_bit=qtype)
 
 
-def run_case(model, api: str, in_len: int, out_len: int, batch: int) -> dict:
+def run_case(model, api: str, in_len: int, out_len: int, batch: int,
+             tp: int = 0, pp: int = 0) -> dict:
     from bigdl_tpu.utils.benchmark import BenchmarkedModel
 
     rng = np.random.default_rng(0)
@@ -68,10 +86,25 @@ def run_case(model, api: str, in_len: int, out_len: int, batch: int) -> dict:
         list(rng.integers(1, model.config.vocab_size, in_len)) for _ in range(batch)
     ]
 
-    if api == "serving_engine":
+    if api in ("tensor_parallel", "pipeline_parallel"):
+        # the model arrives ALREADY sharded (main() calls shard_for_api
+        # once per model+api — re-sharding per case would recompile)
+        model.generate(prompts, max_new_tokens=out_len)  # compile
+        t0 = time.perf_counter()
+        model.generate(prompts, max_new_tokens=out_len)
+        dt = time.perf_counter() - t0
+        return {
+            "first_cost_ms": float("nan"),
+            "rest_cost_mean_ms": round(dt / out_len * 1000, 3),
+            "tokens_per_s": round(batch * out_len / dt, 2),
+            "peak_memory_bytes": None,
+        }
+
+    if api in ("serving_engine", "paged_serving"):
         from bigdl_tpu.serving.engine import InferenceEngine
 
-        eng = InferenceEngine(model, n_slots=batch, max_len=in_len + out_len + 64)
+        eng = InferenceEngine(model, n_slots=batch, max_len=in_len + out_len + 64,
+                              paged=(api == "paged_serving"))
         reqs = [eng.submit(p, max_new_tokens=out_len) for p in prompts]
         eng.step()  # includes prefill admission
         t0 = time.perf_counter()
@@ -132,6 +165,18 @@ def run_case(model, api: str, in_len: int, out_len: int, batch: int) -> dict:
     return bm.last.row()
 
 
+def shard_for_api(model, api: str, tp: int = 0, pp: int = 0):
+    """Shard once per model+api (tensor_parallel / pipeline_parallel)."""
+    if api not in ("tensor_parallel", "pipeline_parallel"):
+        return model
+    import jax
+
+    n = len(jax.devices())
+    if api == "tensor_parallel":
+        return model.to_mesh(tp=tp or n)
+    return model.to_mesh(pp=pp or min(2, n), tp=1)
+
+
 def main(config_path: str) -> None:
     import yaml
 
@@ -142,8 +187,11 @@ def main(config_path: str) -> None:
     rows = []
     for model_id in cfg["repo_id"]:
         for api in cfg.get("test_api", ["transformer_int4"]):
-            qtype = QTYPE_FOR_API.get(api, "sym_int4")
-            model = load_model(model_id, qtype)
+            qtype = qtype_for(api)
+            model = shard_for_api(
+                load_model(model_id, qtype), api,
+                tp=cfg.get("tp", 0), pp=cfg.get("pp", 0),
+            )
             for pair in cfg.get("in_out_pairs", ["32-32"]):
                 in_len, out_len = (int(x) for x in pair.split("-"))
                 for batch in cfg.get("batch_size", [1]):
@@ -155,8 +203,16 @@ def main(config_path: str) -> None:
                         f"{r['rest_cost_mean_ms']} ms/token"
                     )
     if rows:
+        # fieldname UNION across rows: api families report different
+        # column sets (engine modes lack p90/prompt columns) and
+        # DictWriter raises on unknown keys otherwise
+        fields: list[str] = []
+        for r in rows:
+            for k in r:
+                if k not in fields:
+                    fields.append(k)
         with open(out_csv, "w", newline="") as f:
-            w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+            w = csv.DictWriter(f, fieldnames=fields, restval="")
             w.writeheader()
             w.writerows(rows)
         print(f"wrote {out_csv} ({len(rows)} rows)")

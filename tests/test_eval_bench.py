@@ -84,3 +84,20 @@ def test_runner_case(qmodel):
     assert r["rest_cost_mean_ms"] > 0
     r = run_case(qmodel, "serving_engine", in_len=8, out_len=4, batch=2)
     assert r["tokens_per_s"] > 0
+
+
+def test_run_case_new_modes(qmodel):
+    import sys
+
+    sys.path.insert(0, "benchmark")
+    from benchmark.run import qtype_for, run_case
+
+    assert qtype_for("transformer_nf4") == "nf4"
+    assert qtype_for("transformer_q4_k_m") == "q4_k_m"
+    r = run_case(qmodel, "paged_serving", in_len=8, out_len=4, batch=2)
+    assert r["tokens_per_s"] > 0
+    from benchmark.run import shard_for_api
+
+    tp_model = shard_for_api(qmodel, "tensor_parallel", tp=2)
+    r = run_case(tp_model, "tensor_parallel", in_len=8, out_len=4, batch=1)
+    assert r["rest_cost_mean_ms"] > 0
