@@ -54,7 +54,7 @@ def remaining() -> float:
 
 def _child_env() -> dict:
     env = dict(os.environ)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_tpu")
     return env
 
 
@@ -68,7 +68,7 @@ def _child_setup():
     # timeout-terminate leaves a stale tunnel lease that wedges every
     # subsequent claim for minutes (observed r03).
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_tpu")
     import jax
 
     jax.config.update("jax_compilation_cache_dir",

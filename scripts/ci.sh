@@ -8,10 +8,15 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
-export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+# NOTE: no persistent compilation cache — the XLA:CPU AOT loader can
+# reject (and segfault on) cache entries whose recorded machine features
+# mismatch the executing host (tests/conftest.py has the full story)
 
 echo "== unit + distributed tests (8-device CPU mesh)"
-python -m pytest tests/ -x -q
+# -n 2: two worker processes halve per-process native-state accumulation
+# (intermittent XLA:CPU compiler segfaults in very long single processes;
+# tests/conftest.py documents the full story)
+python -m pytest tests/ -q -n 2
 
 echo "== driver contract: single-chip entry + multi-chip dryrun"
 python -c "

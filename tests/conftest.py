@@ -26,9 +26,18 @@ import jax  # noqa: E402
 # not win. Tests must never claim the (single, serialized) tunnel chip:
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
-# This host compiles XLA on one core; cache compiled programs across runs.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# The persistent compilation cache is DISABLED for the test suite: this
+# host's XLA:CPU AOT loader rejects its own cache entries ("Target
+# machine feature +prefer-no-gather is not supported on the host
+# machine") and intermittently SEGFAULTS inside
+# compilation_cache.get_executable_and_time on deserialize (observed
+# 2026-07-30, reproducible with a fresh cache dir — so not stale-entry
+# poisoning). Fresh compiles cost ~1 extra minute per full run; a
+# segfaulted suite costs everything. BIGDL_TPU_TEST_CACHE=1 re-enables
+# for local iteration at your own risk.
+if os.environ.get("BIGDL_TPU_TEST_CACHE") == "1":
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_tests")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -37,3 +46,15 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Bound native-state growth across the 300+ test suite: one long
+    process accumulating every compiled executable has produced
+    intermittent XLA:CPU compiler segfaults near the end of the run
+    (2026-07-30, crash inside backend_compile_and_load with 120 GB
+    free — not OOM). Dropping compiled-computation caches between
+    modules keeps the process young at a modest recompile cost."""
+    yield
+    jax.clear_caches()
