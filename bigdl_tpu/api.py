@@ -165,7 +165,14 @@ class TpuModel:
                 "shard weights over a 'tp' axis (use make_mesh(..., "
                 "axes=('dp','sp','tp')))"
             )
-        if self.config.num_key_value_heads % (tp_size := mesh.shape["tp"]):
+        if (
+            self.config.num_key_value_heads % (tp_size := mesh.shape["tp"])
+            and not hasattr(self.family, "init_cache")
+        ):
+            # families with their own cache (rwkv's recurrent state,
+            # MLA's latent) don't shard a KV pool over kv heads — the
+            # divisibility requirement applies to the standard KVCache
+            # layout only
             raise ValueError(
                 f"num_key_value_heads={self.config.num_key_value_heads} "
                 f"not divisible by tp={tp_size}"

@@ -81,13 +81,93 @@ def layer_specs(config: ModelConfig) -> dict:
     return specs
 
 
+def _mla_attn_specs() -> dict:
+    """MLA (models/deepseek.py): heads shard over tp on the q side and
+    the absorbed per-head factors; the kv LATENT is shared across heads
+    (MQA-like) so its down-projection replicates."""
+    return {
+        "attn_norm": _REP, "mlp_norm": _REP,
+        "wq": _COL, "w_uq": _COL,  # [L, H*(dn+dr), ·] — heads on tp
+        "w_dq": _REP, "q_norm": _REP,
+        "w_dkv": _REP, "kv_norm": _REP,
+        "w_uk": P(None, "tp", None, None),  # [L, H, dn, r]
+        "w_uv": P(None, "tp", None, None),
+        "wo": _ROW,  # [L, hid, H*dv]
+    }
+
+
+def _deepseek_specs(config: ModelConfig) -> dict:
+    dense = dict(_mla_attn_specs())
+    dense.update({"w_gate": _COL, "w_up": _COL, "w_down": _ROW})
+    specs = {"layers": dense}
+    if config.is_moe:
+        moe = dict(_mla_attn_specs())
+        moe.update({
+            "router": _REP, "e_bias": _REP,
+            "w_gate_e": P(None, "tp", None, None),
+            "w_up_e": P(None, "tp", None, None),
+            "w_down_e": P(None, "tp", None, None),
+            "w_gate_s": _COL, "w_up_s": _COL, "w_down_s": _ROW,
+        })
+        specs["moe_layers"] = moe
+    return specs
+
+
+def _rwkv_specs(config: ModelConfig) -> dict:
+    """RWKV (models/rwkv.py): the channel axis A shards over tp — the
+    WKV recurrence is elementwise over A, so decay/first shard with it;
+    mix vectors and norms (over the residual C) replicate."""
+    v5 = config.rwkv_head_size is not None
+    layers = {
+        "ln1_w": _REP, "ln1_b": _REP, "ln2_w": _REP, "ln2_b": _REP,
+        "att_mix_k": _REP, "att_mix_v": _REP, "att_mix_r": _REP,
+        "att_k": _COL, "att_v": _COL, "att_r": _COL,
+        "att_o": _ROW,
+        "att_decay": P(None, "tp", None) if v5 else P(None, "tp"),
+        "att_first": P(None, "tp", None) if v5 else P(None, "tp"),
+        "ffn_mix_k": _REP, "ffn_mix_r": _REP,
+        "ffn_k": _COL, "ffn_r": _COL, "ffn_v": _ROW,
+    }
+    if v5:
+        layers.update({"att_mix_g": _REP, "att_g": _COL,
+                       "ln_x_w": _REP, "ln_x_b": _REP})
+    return {"layers": layers}
+
+
+def _yuan_extra_specs() -> dict:
+    """Yuan LFA filter (models/yuan.py): conv stage 1 column-parallel,
+    stage 2 row-parallel; the filter norm replicates."""
+    return {
+        "lf_w1a": _COL, "lf_w1b": _COL, "lf_b1": P(None, "tp"),
+        "lf_w2a": _ROW, "lf_w2b": _ROW, "lf_b2": _REP,
+        "lf_norm": _REP,
+    }
+
+
 def param_specs(config: ModelConfig, tie_word_embeddings: bool | None = None) -> dict:
     tie = config.tie_word_embeddings if tie_word_embeddings is None else tie_word_embeddings
     specs = {
         "embed": P("tp", None),
-        "layers": layer_specs(config),
         "final_norm": _REP,
     }
+    mt = config.model_type
+    if mt in ("deepseek_v2", "deepseek_v3", "minicpm3"):
+        specs.update(_deepseek_specs(config))
+    elif mt in ("rwkv", "rwkv5"):
+        specs.update(_rwkv_specs(config))
+        specs.update({"ln0_w": _REP, "ln0_b": _REP, "final_norm_b": _REP})
+    else:
+        specs["layers"] = layer_specs(config)
+        if mt == "yuan":
+            specs["layers"].update(_yuan_extra_specs())
+        if mt in ("mllama", "mllama_text_model"):
+            specs["cross"] = {
+                "attn_norm": _REP, "mlp_norm": _REP,
+                "wq": _COL, "wk": _COL, "wv": _COL, "wo": _ROW,
+                "q_norm": _REP, "k_norm": _REP,
+                "attn_gate": _REP, "mlp_gate": _REP,
+                "w_gate": _COL, "w_up": _COL, "w_down": _ROW,
+            }
     if config.norm_bias:
         specs["final_norm_b"] = _REP
     if config.learned_positions:
@@ -125,9 +205,20 @@ def expand_specs_for_params(specs, params, wrap=lambda spec: spec):
     QTensor trick lives — used by sharding_tree and both pipeline spec
     builders."""
 
+    def replicate_like(p):
+        if isinstance(p, dict):
+            return {k: replicate_like(v) for k, v in p.items()}
+        return _REP
+
     def prune(s, p):
         if isinstance(s, dict) and isinstance(p, dict):
-            return {k: prune(s[k], p[k]) for k in p.keys()}
+            # params keys without a spec REPLICATE (correct for any
+            # family; a dedicated spec is a performance upgrade, its
+            # absence must never be a crash)
+            return {
+                k: prune(s[k], p[k]) if k in s else replicate_like(p[k])
+                for k in p.keys()
+            }
         return s
 
     specs = prune(specs, params)

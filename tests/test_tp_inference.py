@@ -112,3 +112,49 @@ def test_tp_rejects_indivisible_heads():
     mesh = make_mesh((1, 1, 4), devices=jax.devices()[:4])
     with pytest.raises(ValueError, match="not divisible"):
         _model(cfg).to_mesh(mesh)
+
+
+@pytest.mark.parametrize("family_case", ["deepseek", "rwkv", "yuan", "mllama"])
+def test_tp_new_families_bit_identical(family_case):
+    """Every family with a custom tree/cache must shard through to_mesh
+    and emit byte-identical greedy tokens (dedicated specs for
+    deepseek/rwkv/yuan/mllama; unknown leaves replicate)."""
+    from bigdl_tpu.models import deepseek, get_family, mllama, rwkv, yuan
+
+    if family_case == "deepseek":
+        cfg = ModelConfig.from_hf_config(dict(
+            model_type="deepseek_v2", vocab_size=96, hidden_size=64,
+            intermediate_size=128, num_hidden_layers=3,
+            num_attention_heads=4, q_lora_rank=32, kv_lora_rank=32,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            n_routed_experts=4, num_experts_per_tok=2,
+            first_k_dense_replace=1, moe_intermediate_size=32,
+            n_shared_experts=1))
+        params = deepseek.init_params(cfg, jax.random.PRNGKey(0))
+    elif family_case == "rwkv":
+        cfg = ModelConfig(
+            model_type="rwkv", vocab_size=96, hidden_size=64,
+            num_hidden_layers=2, num_attention_heads=1,
+            num_key_value_heads=1, intermediate_size=128,
+            norm_type="layernorm")
+        params = rwkv.init_params(cfg, jax.random.PRNGKey(0))
+    elif family_case == "yuan":
+        cfg = ModelConfig(
+            model_type="yuan", vocab_size=96, hidden_size=64,
+            intermediate_size=128, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4)
+        params = yuan.init_params(cfg, jax.random.PRNGKey(0))
+    else:
+        cfg = ModelConfig(
+            model_type="mllama", vocab_size=96, hidden_size=64,
+            intermediate_size=128, num_hidden_layers=3,
+            num_attention_heads=4, num_key_value_heads=2,
+            cross_attention_layers=(1,))
+        params = mllama.init_params(cfg, jax.random.PRNGKey(0))
+
+    m = TpuModel(cfg, params, "bf16")
+    single = m.generate([[1, 2, 3, 4, 5]], max_new_tokens=8)
+    tp = m.to_mesh(make_mesh((1, 1, 2), jax.devices()[:2]))
+    np.testing.assert_array_equal(
+        single, tp.generate([[1, 2, 3, 4, 5]], max_new_tokens=8)
+    )
