@@ -26,6 +26,8 @@ _FAMILIES = {
     "phi3": llama,
     "baichuan": llama,
     "internlm2": llama,
+    "internlm": llama,  # v1: biased qkv+o
+    "aquila": llama,  # llama-shaped (BAAI Aquila/Aquila2)
     "starcoder2": llama,
     "stablelm": llama,
     "minicpm": llama,
@@ -66,10 +68,12 @@ _FAMILIES["mllama_text_model"] = mllama  # nested text_config model_type
 from bigdl_tpu.models import internvl  # noqa: E402  (delegates text to llama)
 
 _FAMILIES["internvl"] = internvl
+_FAMILIES["internvl_chat"] = internvl  # trust_remote_code model_type
 
 from bigdl_tpu.models import janus  # noqa: E402  (delegates text to llama)
 
 _FAMILIES["janus"] = janus
+_FAMILIES["multi_modality"] = janus  # original janus checkpoints
 
 from bigdl_tpu.models import deepseek  # noqa: E402  (MLA latent-KV cache)
 

@@ -313,6 +313,12 @@ def _hf_internlm2(hf, kw):
     kw.setdefault("attention_bias", hf.get("bias", False))
 
 
+def _hf_internlm(hf, kw):
+    """internlm v1: llama layout with biased qkv AND o projections."""
+    kw["attention_bias"] = bool(hf.get("bias", True))
+    kw["attention_out_bias"] = bool(hf.get("bias", True))
+
+
 def _hf_minicpm(hf, kw):
     L = kw.get("num_hidden_layers", 32)
     kw["residual_scale"] = hf.get("scale_depth", 1.0) / (L ** 0.5)
@@ -694,6 +700,7 @@ _HF_BUILDERS = {
     "starcoder2": _hf_starcoder2,
     "baichuan": _hf_baichuan,
     "internlm2": _hf_internlm2,
+    "internlm": _hf_internlm,
     "minicpm": _hf_minicpm,
     "glm": _hf_glm,
     "gpt2": _hf_gpt2,
@@ -712,7 +719,9 @@ _HF_BUILDERS = {
     "deepseek_v3": _hf_deepseek_v3,
     "minicpm3": _hf_minicpm3,
     "internvl": _hf_internvl,
+    "internvl_chat": _hf_internvl,
     "janus": _hf_janus,
+    "multi_modality": _hf_janus,  # janus checkpoints' original model_type
     "qwen3": _hf_qwen3,
     "qwen3_moe": _hf_qwen3_moe,
     "phi": _hf_phi,

@@ -498,3 +498,17 @@ def test_gemma3_config_json_roundtrip_stays_hashable():
     rt = ModelConfig(**blob)
     hash(rt)  # must stay a valid static jit argument
     assert rt.sliding_layers == (True, False)
+
+
+def test_alias_model_types_registered():
+    from bigdl_tpu.models import get_family, internvl, janus, llama
+
+    assert get_family("aquila") is llama
+    assert get_family("internlm") is llama
+    assert get_family("internvl_chat") is internvl
+    assert get_family("multi_modality") is janus
+    cfg = ModelConfig.from_hf_config(
+        {"model_type": "internlm", "hidden_size": 64, "num_hidden_layers": 2,
+         "num_attention_heads": 4, "bias": True}
+    )
+    assert cfg.attention_bias and cfg.attention_out_bias
