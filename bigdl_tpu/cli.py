@@ -44,7 +44,25 @@ def _tokenizer(path: str):
 
 
 def cmd_convert(args):
-    model = _load(args.model, args.qtype)
+    # gguf export re-encodes weights into the gguf payload type: load at
+    # bf16 unless the user explicitly asked for a low-bit intermediate,
+    # or the file would claim q8_0 precision with sym_int4 accuracy
+    load_q = args.qtype if args.format != "gguf" else (args.qtype or "bf16")
+    model = _load(args.model, load_q)
+    if args.format == "gguf":
+        from bigdl_tpu.convert.gguf_export import export_gguf
+        from bigdl_tpu.models import get_family
+
+        params = model.params
+        fam = get_family(model.config.model_type)
+        if hasattr(fam, "unmerge_fused_params"):
+            params = fam.unmerge_fused_params(params, model.config)
+        out = args.output if args.output.endswith(".gguf") \
+            else args.output + ".gguf"
+        export_gguf(model.config, params, out,
+                    qtype=args.gguf_qtype)
+        print(f"exported {args.gguf_qtype} gguf to {out}")
+        return
     model.save_low_bit(args.output)
     print(f"saved {args.qtype} model to {args.output}")
 
@@ -130,9 +148,18 @@ def main(argv=None):
                     help=argparse.SUPPRESS)
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    c = sub.add_parser("convert", help="quantize + save_low_bit", parents=[qp])
+    c = sub.add_parser("convert", help="quantize + save_low_bit / gguf export",
+                       parents=[qp])
     c.add_argument("model")
     c.add_argument("-o", "--output", required=True)
+    c.add_argument("-f", "--format", choices=("low_bit", "gguf"),
+                   default="low_bit",
+                   help="low_bit: our reload format; gguf: llama.cpp file")
+    from bigdl_tpu.convert.gguf_export import _GGML_FOR_QTYPE
+
+    c.add_argument("--gguf-qtype", default="q8_0",
+                   choices=sorted(_GGML_FOR_QTYPE),
+                   help="gguf payload type")
     c.set_defaults(fn=cmd_convert)
 
     g = sub.add_parser("generate", help="one-shot generation", parents=[qp])

@@ -448,8 +448,9 @@ def config_from_gguf(reader: GGUFReader) -> ModelConfig:
         if orig:
             rs["original_max_position_embeddings"] = int(orig)
         kwargs["rope_scaling"] = rs
-    if arch == "qwen2":
-        kwargs["attention_bias"] = "blk.0.attn_q.bias" in reader.tensors
+    # bias presence is detectable for ANY arch from the tensor directory
+    # (qwen2 ships them; llama-arch exports of biased variants too)
+    kwargs["attention_bias"] = "blk.0.attn_q.bias" in reader.tensors
     return ModelConfig(**kwargs)
 
 

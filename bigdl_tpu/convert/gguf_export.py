@@ -1,0 +1,365 @@
+"""GGUF export — write llama.cpp-compatible model files.
+
+Output-side counterpart of the reference's `llm_convert`
+(/root/reference/python/llm/src/ipex_llm/convert_model.py:31 →
+ggml/convert_model.py: HF checkpoint -> native ggml/gguf file) and the
+inverse of convert/gguf.py's importer. The writer emits GGUF v3 with the
+llama.cpp metadata keys our own `config_from_gguf` reads, so
+export -> `from_gguf` round-trips bit-exactly for the quantized types;
+llama.cpp itself additionally needs `tokenizer.ggml.*` metadata, which
+the caller supplies via `extra_metadata` (we have no tokenizer model —
+the reference reads it from the source checkpoint the same way).
+
+Block encoders mirror the importer's dequant layouts exactly (q8_0 =
+[d f16][32 i8]; q4_0 = [d f16][16 bytes, element j in the low nibble and
+j+16 in the high]); k-quants reuse quant/kquants.py's llama.cpp-layout
+encoders. The llama/mistral rope row-permute (LlamaModel.permute in
+llama.cpp's converter) is applied on export and undone by the importer.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+from bigdl_tpu.convert.gguf import (
+    GGML_BF16, GGML_F16, GGML_F32, GGML_Q4_0, GGML_Q8_0,
+    GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K,
+    GGUF_MAGIC, _V_ARR, _V_BOOL, _V_F32, _V_I32, _V_STR, _V_U32, _V_U64,
+)
+from bigdl_tpu.models.config import ModelConfig
+
+ALIGN = 32
+
+_KQ_EXPORT = {"q2_k": GGML_Q2_K, "q3_k": GGML_Q3_K, "q4_k": GGML_Q4_K,
+              "q5_k": GGML_Q5_K, "q6_k": GGML_Q6_K}
+
+
+# ---------------------------------------------------------------------------
+# block encoders (exact inverses of convert/gguf.py's dequants)
+# ---------------------------------------------------------------------------
+
+def encode_q8_0(x: np.ndarray) -> np.ndarray:
+    """[..., K] f32 -> [..., K/32, 34] uint8."""
+    xb = np.asarray(x, np.float32).reshape(*x.shape[:-1], -1, 32)
+    absmax = np.abs(xb).max(axis=-1)
+    d = (absmax / 127.0).astype(np.float32)
+    inv = np.where(d > 0, 1.0 / np.where(d == 0, 1, d), 0.0)
+    q = np.clip(np.round(xb * inv[..., None]), -127, 127).astype(np.int8)
+    out = np.empty((*q.shape[:-1], 34), np.uint8)
+    out[..., 0:2] = d.astype(np.float16)[..., None].view(np.uint8)
+    out[..., 2:34] = q.view(np.uint8)
+    return out
+
+
+def encode_q4_0(x: np.ndarray) -> np.ndarray:
+    """[..., K] f32 -> [..., K/32, 18] uint8 (llama.cpp q4_0: the scale
+    divides by the SIGNED max-magnitude element over -8)."""
+    xb = np.asarray(x, np.float32).reshape(*x.shape[:-1], -1, 32)
+    amax_idx = np.abs(xb).argmax(axis=-1)
+    signed_max = np.take_along_axis(xb, amax_idx[..., None], axis=-1)[..., 0]
+    d = (signed_max / -8.0).astype(np.float32)
+    inv = np.where(d != 0, 1.0 / np.where(d == 0, 1, d), 0.0)
+    q = np.clip(np.round(xb * inv[..., None]) + 8, 0, 15).astype(np.uint8)
+    out = np.empty((*q.shape[:-1], 18), np.uint8)
+    out[..., 0:2] = d.astype(np.float16)[..., None].view(np.uint8)
+    out[..., 2:18] = q[..., :16] | (q[..., 16:] << 4)
+    return out
+
+
+def encode_tensor(x: np.ndarray, ggml_type: int) -> bytes:
+    if ggml_type == GGML_F32:
+        return np.asarray(x, np.float32).tobytes()
+    if ggml_type == GGML_F16:
+        return np.asarray(x, np.float16).tobytes()
+    if ggml_type == GGML_BF16:
+        import jax.numpy as jnp
+
+        return np.asarray(jnp.asarray(x, jnp.bfloat16)).tobytes()
+    if ggml_type == GGML_Q8_0:
+        return encode_q8_0(x).tobytes()
+    if ggml_type == GGML_Q4_0:
+        return encode_q4_0(x).tobytes()
+    for name, t in _KQ_EXPORT.items():
+        if t == ggml_type:
+            if x.shape[-1] % 256:
+                raise ValueError(
+                    f"k-quant export needs the last dim divisible by 256; "
+                    f"got {x.shape} — use q8_0/q4_0 for this tensor"
+                )
+            from bigdl_tpu.quant import kquants
+
+            enc = getattr(kquants, f"quantize_{name}")
+            return enc(np.asarray(x, np.float32)).tobytes()
+    raise NotImplementedError(f"gguf export for ggml type {ggml_type}")
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+def _w_str(f, s: str) -> None:
+    b = s.encode()
+    f.write(struct.pack("<Q", len(b)))
+    f.write(b)
+
+
+def _w_value(f, v: Any) -> None:
+    if isinstance(v, bool):
+        f.write(struct.pack("<I", _V_BOOL))
+        f.write(struct.pack("<?", v))
+    elif isinstance(v, int):
+        if 0 <= v < 2 ** 32:
+            f.write(struct.pack("<II", _V_U32, v))
+        elif v >= 0:
+            f.write(struct.pack("<I", _V_U64))
+            f.write(struct.pack("<Q", v))
+        else:
+            f.write(struct.pack("<Ii", _V_I32, v))
+    elif isinstance(v, float):
+        f.write(struct.pack("<If", _V_F32, v))
+    elif isinstance(v, str):
+        f.write(struct.pack("<I", _V_STR))
+        _w_str(f, v)
+    elif isinstance(v, (list, tuple)):
+        f.write(struct.pack("<I", _V_ARR))
+        if all(isinstance(e, str) for e in v):
+            f.write(struct.pack("<IQ", _V_STR, len(v)))
+            for e in v:
+                _w_str(f, e)
+        elif all(isinstance(e, int) for e in v):
+            f.write(struct.pack("<IQ", _V_I32, len(v)))
+            for e in v:
+                f.write(struct.pack("<i", e))
+        else:
+            f.write(struct.pack("<IQ", _V_F32, len(v)))
+            for e in v:
+                f.write(struct.pack("<f", float(e)))
+    else:
+        raise TypeError(f"gguf metadata value {v!r}")
+
+
+def _payload_size(shape: tuple, ggml_type: int) -> int:
+    from bigdl_tpu.convert.gguf import _BLOCK
+
+    elems, nbytes = _BLOCK[ggml_type]
+    n = 1
+    for d in shape:
+        n *= d
+    assert n % elems == 0, (shape, ggml_type)
+    return n // elems * nbytes
+
+
+def write_gguf(
+    path: str,
+    metadata: dict[str, Any],
+    tensors: dict[str, tuple[tuple, int, Any]],  # name -> (shape, type, get)
+) -> None:
+    """Write a GGUF v3 file STREAMING: payload sizes are computed from
+    (shape, ggml_type) alone, the directory is written first, and each
+    tensor is materialized (get() -> f32 array), encoded, written, and
+    dropped — peak host memory stays ~one tensor, not the model
+    (a 7B export would otherwise hold ~35 GB of f32 + blocks)."""
+    metadata = dict(metadata)
+    metadata["general.alignment"] = ALIGN
+
+    with open(path, "wb") as f:
+        f.write(struct.pack("<IIQQ", GGUF_MAGIC, 3, len(tensors), len(metadata)))
+        for k, v in metadata.items():
+            _w_str(f, k)
+            _w_value(f, v)
+        offset = 0
+        for name, (shape, t, _get) in tensors.items():
+            _w_str(f, name)
+            dims = tuple(reversed(shape))  # innermost-first on disk
+            f.write(struct.pack("<I", len(dims)))
+            f.write(struct.pack(f"<{len(dims)}Q", *dims))
+            f.write(struct.pack("<IQ", t, offset))
+            size = _payload_size(shape, t)
+            offset += (size + ALIGN - 1) // ALIGN * ALIGN
+        pos = f.tell()
+        f.write(b"\x00" * ((pos + ALIGN - 1) // ALIGN * ALIGN - pos))
+        for name, (shape, t, get) in tensors.items():
+            arr = get()
+            assert tuple(arr.shape) == tuple(shape), (name, arr.shape, shape)
+            data = encode_tensor(arr, t)
+            assert len(data) == _payload_size(shape, t), name
+            f.write(data)
+            pad = (len(data) + ALIGN - 1) // ALIGN * ALIGN - len(data)
+            f.write(b"\x00" * pad)
+
+
+# ---------------------------------------------------------------------------
+# model export (llama-family)
+# ---------------------------------------------------------------------------
+
+def _permute_rows(n_heads: int, n_rows: int) -> np.ndarray:
+    """llama.cpp's HF->gguf rope row permute (exact inverse of the
+    importer's _unpermute_rows)."""
+    d = n_rows // n_heads
+    idx = np.arange(n_rows).reshape(n_heads, 2, d // 2)
+    return idx.transpose(0, 2, 1).reshape(-1)
+
+
+_GGML_FOR_QTYPE = {
+    "q8_0": GGML_Q8_0, "q4_0": GGML_Q4_0, "f16": GGML_F16,
+    "f32": GGML_F32, "bf16": GGML_BF16,
+    **_KQ_EXPORT,
+}
+
+
+def export_gguf(
+    config: ModelConfig,
+    params: dict,
+    path: str,
+    qtype: str = "q8_0",
+    name: str = "bigdl-tpu-export",
+    extra_metadata: Optional[dict] = None,
+) -> None:
+    """Export a llama-family param tree to GGUF (weights quantize to
+    `qtype`; norms stay f32). QTensor leaves dequantize first — GGUF
+    block layouts don't match our packed layout except for k-quants,
+    and requantizing through the encoder keeps the file self-contained."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.quant import QTensor
+
+    arch = {"qwen2": "qwen2", "mistral": "mistral"}.get(
+        config.model_type, "llama"
+    )
+    # GGUF's llama/qwen2 tensor sets carry exactly the vanilla layout —
+    # refuse configs whose weights would be silently dropped or whose
+    # layout the name map can't express (the reference's llm_convert is
+    # likewise per-architecture)
+    unsupported = [
+        flag for flag, on in (
+            ("qk_norm", config.qk_norm),
+            ("attention_out_bias", config.attention_out_bias),
+            ("post_attn_norm", config.post_attn_norm),
+            ("mlp_bias", config.mlp_bias),
+            ("norm_bias", config.norm_bias),
+            ("moe", config.is_moe),
+            ("non-gated mlp", not config.gated_mlp),
+            ("alibi", config.alibi),
+            ("learned_positions", config.learned_positions),
+            ("mla", config.kv_lora_rank is not None),
+        ) if on
+    ]
+    if unsupported:
+        raise NotImplementedError(
+            f"gguf export covers vanilla llama/mistral/qwen2 layouts; "
+            f"this config needs: {', '.join(unsupported)}"
+        )
+    t = _GGML_FOR_QTYPE[qtype]
+
+    def dense(w):
+        def get() -> np.ndarray:
+            if isinstance(w, QTensor):
+                return np.asarray(w.dequantize(jnp.float32))
+            return np.asarray(jnp.asarray(w, jnp.float32))
+
+        return get
+
+    def leaf_shape(w) -> tuple:
+        return tuple(w.shape)
+
+    # lazy getters: write_gguf materializes one tensor at a time
+    tensors: dict[str, tuple[tuple, int, Any]] = {}
+
+    permute = arch in ("llama", "mistral")  # qwen2 stays in HF row order
+    Hq, Hkv = config.num_attention_heads, config.num_key_value_heads
+    lay = params["layers"]
+
+    def layer_leaf(key: str, i: int, permute_heads=None):
+        def get() -> np.ndarray:
+            w = lay[key]
+            if isinstance(w, QTensor):
+                arr = np.asarray(
+                    QTensor(
+                        data=w.data[i], scales=w.scales[i],
+                        mins=None if w.mins is None else w.mins[i],
+                        qtype=w.qtype,
+                    ).dequantize(jnp.float32)
+                )
+            else:
+                arr = np.asarray(jnp.asarray(w[i], jnp.float32))
+            if permute_heads is not None:
+                arr = arr[_permute_rows(permute_heads, arr.shape[0])]
+            return arr
+
+        return get
+
+    def layer_shape(key: str) -> tuple:
+        w = lay[key]
+        shape = tuple(w.shape[1:])
+        return shape
+
+    if "wqkv" in lay or "w_gateup" in lay:
+        raise ValueError(
+            "export needs the unmerged layout; call "
+            "family.unmerge_fused_params(params, config) first"
+        )
+
+    def put(gname, key, i, ggml_type, permute_heads=None):
+        tensors[gname] = (
+            layer_shape(key), ggml_type, layer_leaf(key, i, permute_heads)
+        )
+
+    for i in range(config.num_hidden_layers):
+        p = f"blk.{i}."
+        put(p + "attn_norm.weight", "attn_norm", i, GGML_F32)
+        put(p + "ffn_norm.weight", "mlp_norm", i, GGML_F32)
+        put(p + "attn_q.weight", "wq", i, t, Hq if permute else None)
+        put(p + "attn_k.weight", "wk", i, t, Hkv if permute else None)
+        put(p + "attn_v.weight", "wv", i, t)
+        put(p + "attn_output.weight", "wo", i, t)
+        put(p + "ffn_gate.weight", "w_gate", i, t)
+        put(p + "ffn_up.weight", "w_up", i, t)
+        put(p + "ffn_down.weight", "w_down", i, t)
+        if config.attention_bias:
+            put(p + "attn_q.bias", "bq", i, GGML_F32, Hq if permute else None)
+            put(p + "attn_k.bias", "bk", i, GGML_F32, Hkv if permute else None)
+            put(p + "attn_v.bias", "bv", i, GGML_F32)
+
+    tensors["token_embd.weight"] = (
+        leaf_shape(params["embed"]), t, dense(params["embed"])
+    )
+    tensors["output_norm.weight"] = (
+        leaf_shape(params["final_norm"]), GGML_F32, dense(params["final_norm"])
+    )
+    if "lm_head" in params:
+        tensors["output.weight"] = (
+            leaf_shape(params["lm_head"]), t, dense(params["lm_head"])
+        )
+
+    md: dict[str, Any] = {
+        "general.architecture": arch,
+        "general.name": name,
+        f"{arch}.embedding_length": config.hidden_size,
+        f"{arch}.feed_forward_length": config.intermediate_size,
+        f"{arch}.block_count": config.num_hidden_layers,
+        f"{arch}.attention.head_count": Hq,
+        f"{arch}.attention.head_count_kv": Hkv,
+        f"{arch}.attention.layer_norm_rms_epsilon": float(config.rms_norm_eps),
+        f"{arch}.rope.freq_base": float(config.rope_theta),
+        f"{arch}.context_length": config.max_position_embeddings,
+    }
+    if config.head_dim is not None:
+        md[f"{arch}.attention.key_length"] = config.head_dim
+        md[f"{arch}.attention.value_length"] = config.head_dim
+    rs = config.rope_scaling_dict
+    if rs:
+        md[f"{arch}.rope.scaling.type"] = str(
+            rs.get("rope_type", rs.get("type", "linear"))
+        )
+        if rs.get("factor"):
+            md[f"{arch}.rope.scaling.factor"] = float(rs["factor"])
+        if rs.get("original_max_position_embeddings"):
+            md[f"{arch}.rope.scaling.original_context_length"] = int(
+                rs["original_max_position_embeddings"]
+            )
+    if extra_metadata:
+        md.update(extra_metadata)
+    write_gguf(path, md, tensors)

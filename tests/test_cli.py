@@ -47,3 +47,13 @@ def test_cli_bench_protocol(saved_model, capsys):
     assert res["metric"] == "decode_latency" and res["value"] > 0
     assert res["protocol"] == "in16-out8"
     assert "first_token_ms" in res
+
+
+def test_cli_convert_gguf(saved_model, tmp_path):
+    from bigdl_tpu.api import AutoModelForCausalLM
+
+    out = tmp_path / "model.gguf"
+    cli.main(["convert", saved_model, "-o", str(out), "-f", "gguf",
+              "--gguf-qtype", "q8_0"])
+    m = AutoModelForCausalLM.from_gguf(str(out))
+    assert m.generate([[1, 2, 3]], max_new_tokens=4).shape == (1, 4)
