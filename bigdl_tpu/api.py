@@ -405,11 +405,13 @@ class TpuModel:
         )
 
 
-def _merged_model(config, params, qtype) -> TpuModel:
+def _merged_model(config, params, qtype, merge_fused: bool = True) -> TpuModel:
     """Shared loader tail: fuse qkv/gate-up when the family supports it
-    (lossless, reference merge_qkv) before wrapping."""
+    (lossless, reference merge_qkv) before wrapping. merge_fused=False
+    keeps the split layout — the gguf export path consumes it directly
+    and would otherwise pay a full merge+unmerge round trip."""
     family = get_family(config.model_type)
-    if hasattr(family, "merge_fused_params"):
+    if merge_fused and hasattr(family, "merge_fused_params"):
         params = family.merge_fused_params(params, config)
     return TpuModel(config=config, params=params, qtype=qtype)
 
@@ -424,13 +426,14 @@ class AutoModelForCausalLM:
         model_path: str,
         load_in_low_bit: str = "sym_int4",
         load_in_4bit: bool = False,
+        merge_fused: bool = True,
         **_ignored,
     ) -> TpuModel:
         from bigdl_tpu.convert import load_hf_checkpoint
 
         qtype = "sym_int4" if load_in_4bit else load_in_low_bit
         config, params, qtype = load_hf_checkpoint(model_path, qtype=qtype)
-        return _merged_model(config, params, qtype)
+        return _merged_model(config, params, qtype, merge_fused)
 
     @classmethod
     def load_low_bit(cls, path: str) -> TpuModel:

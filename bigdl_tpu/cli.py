@@ -44,15 +44,20 @@ def _tokenizer(path: str):
 
 
 def cmd_convert(args):
-    # gguf export re-encodes weights into the gguf payload type: load at
-    # bf16 unless the user explicitly asked for a low-bit intermediate,
-    # or the file would claim q8_0 precision with sym_int4 accuracy
-    load_q = args.qtype if args.format != "gguf" else (args.qtype or "bf16")
+    # gguf export re-encodes weights into the gguf payload type: HF dirs
+    # load at bf16 unless the user asked for a low-bit intermediate (or
+    # the file would claim q8_0 precision with sym_int4 accuracy);
+    # .gguf inputs keep their native per-tensor formats (qtype=None)
+    load_q = args.qtype
+    if args.format == "gguf" and not args.model.endswith(".gguf"):
+        load_q = args.qtype or "bf16"
     model = _load(args.model, load_q)
     if args.format == "gguf":
         from bigdl_tpu.convert.gguf_export import export_gguf
         from bigdl_tpu.models import get_family
 
+        # loaders merge qkv/gate-up by default; split back for export
+        # (layouts loaded via from_gguf/low_bit arrive merged too)
         params = model.params
         fam = get_family(model.config.model_type)
         if hasattr(fam, "unmerge_fused_params"):
@@ -155,10 +160,11 @@ def main(argv=None):
     c.add_argument("-f", "--format", choices=("low_bit", "gguf"),
                    default="low_bit",
                    help="low_bit: our reload format; gguf: llama.cpp file")
-    from bigdl_tpu.convert.gguf_export import _GGML_FOR_QTYPE
-
     c.add_argument("--gguf-qtype", default="q8_0",
-                   choices=sorted(_GGML_FOR_QTYPE),
+                   # literal: keep CLI startup free of convert imports
+                   # (must mirror gguf_export._GGML_FOR_QTYPE)
+                   choices=("bf16", "f16", "f32", "q2_k", "q3_k", "q4_0",
+                            "q4_k", "q5_k", "q6_k", "q8_0"),
                    help="gguf payload type")
     c.set_defaults(fn=cmd_convert)
 

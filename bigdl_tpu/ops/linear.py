@@ -34,7 +34,7 @@ def _rows(shape) -> int:
 # nibble formats the fused GEMV kernel decodes in-kernel: sym_int4
 # arithmetically, nf4/fp4 via their static codebooks (asym_int4 has
 # per-block mins — an extra rank-1 term the kernel doesn't carry yet)
-_QGEMV_QTYPES = ("sym_int4", "nf4", "fp4")
+_QGEMV_QTYPES = ("sym_int4", "nf4", "fp4", "sym_int8")
 
 
 def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
@@ -42,10 +42,13 @@ def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
 
     if w.qtype not in _QGEMV_QTYPES or w.data.ndim != 2:
         return False
-    out, kh = w.data.shape
+    out, kw_ = w.data.shape
     block = w.spec.block_size
+    if w.qtype == "sym_int8":  # unpacked: K = data's last dim directly
+        if out % 128 != 0 or kw_ % block != 0:
+            return False
     # each half-split nibble plane must cover whole quant blocks
-    if out % 128 != 0 or (kh * 2) % (2 * block) != 0:
+    elif out % 128 != 0 or (kw_ * 2) % (2 * block) != 0:
         return False
     return _rows(x.shape) <= _GEMV_MAX_ROWS and use_pallas()
 
@@ -69,6 +72,13 @@ def linear(
             block_o = 256 if w.data.shape[0] % 256 == 0 else 128
             if w.qtype == "sym_int4":
                 y = qmatmul_int4(
+                    x.astype(compute_dtype), w.data, w.scales,
+                    out_dtype=compute_dtype, block_o=block_o,
+                )
+            elif w.qtype == "sym_int8":
+                from bigdl_tpu.ops.pallas import qmatmul_int8
+
+                y = qmatmul_int8(
                     x.astype(compute_dtype), w.data, w.scales,
                     out_dtype=compute_dtype, block_o=block_o,
                 )

@@ -39,7 +39,9 @@ def interpret_mode() -> bool:
 
 
 from bigdl_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
-from bigdl_tpu.ops.pallas.qmatmul import qmatmul_codebook, qmatmul_int4  # noqa: E402
+from bigdl_tpu.ops.pallas.qmatmul import (  # noqa: E402
+    qmatmul_codebook, qmatmul_int4, qmatmul_int8,
+)
 
 __all__ = ["use_pallas", "interpret_mode", "flash_attention", "qmatmul_int4",
-           "qmatmul_codebook"]
+           "qmatmul_codebook", "qmatmul_int8"]

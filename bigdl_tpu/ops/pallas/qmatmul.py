@@ -108,6 +108,114 @@ def _kernel(xl_ref, xh_ref, w_ref, s_ref, o_ref, *, kh: int,
     o_ref[:] = acc.astype(o_ref.dtype)
 
 
+def _kernel_i8(x_ref, w_ref, s_ref, o_ref, *, block: int):
+    """One (O, K) tile of the int8 GEMV, accumulating over the K grid
+    axis: o += x_k @ (w_k * scale_k)^T. Unlike the nibble kernel there
+    is no packing — w is [block_o, block_k] int8; the per-block scales
+    expand with the same one-hot matmul, whose sel matrix is
+    [block_k/32, block_k] and thus bounded by the K tile (a full-K sel
+    at llama3's K=14336 would alone be ~26 MB — over the scoped-VMEM
+    limit the int4 path already hit on real v5e)."""
+    w = w_ref[:].astype(jnp.float32)  # [block_o, block_k]
+    s = _f16_bits_to_f32(s_ref[:])  # [block_o, nb_k]
+    wd = (w * _expand_scales(s, w.shape[-1], 0, block)).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        x_ref[:].astype(jnp.bfloat16), wd, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += acc.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "block_k", "interpret",
+                              "block")
+)
+def _qmm_i8(x2, w, s_bits, out_dtype, block_o: int, block_k: int,
+            interpret: bool, block: int):
+    M, K = x2.shape
+    O = w.shape[0]
+    return pl.pallas_call(
+        functools.partial(_kernel_i8, block=block),
+        grid=(O // block_o, K // block_k),
+        in_specs=[
+            pl.BlockSpec((M, block_k), lambda o, k: (0, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, block_k), lambda o, k: (o, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, block_k // block), lambda o, k: (o, k),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (M, block_o), lambda o, k: (0, o), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, O), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x2, w, s_bits).astype(out_dtype)
+
+
+def qmatmul_int8(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K] int8 (sym_int8 / imported q8_0)
+    scales: jax.Array,  # [O, K // 32] f16 (or bf16)
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """y[..., O] = x @ dequant(W)^T for a sym_int8 QTensor's fields:
+    weights cross HBM as int8 — half the traffic of bf16, which is the
+    whole cost of a decode GEMV."""
+    from bigdl_tpu.ops.pallas import interpret_mode
+
+    if interpret is None:
+        interpret = interpret_mode()
+    *lead, K = x.shape
+    O, Kw = data.shape
+    assert Kw == K and K % BLOCK == 0
+
+    M = 1
+    for d in lead:
+        M *= d
+    Mp = round_up(max(M, 1), 8)
+    x2 = x.reshape(M, K)
+    if Mp != M:
+        x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
+
+    block_o = min(block_o, O)
+    # K tile: sel matrix (block_k/32 x block_k f32) + w expansion fit
+    # comfortably at 4096
+    block_k = K
+    while block_k > 4096 and K % (block_k // 2) == 0 and block_k % 2 == 0:
+        block_k //= 2
+    # VMEM model: w i8 + f32 expansion + bf16 copy ≈ 7 B per element,
+    # plus the one-hot sel at ~block_k^2/8 B
+    VMEM_BUDGET = 10 * 1024 * 1024
+    while block_o > 8 and (
+        block_o * block_k * 7 + block_k * block_k // 8 > VMEM_BUDGET
+        or O % block_o
+    ):
+        block_o //= 2
+    assert O % block_o == 0, f"O={O} not divisible by block_o={block_o}"
+    assert K % block_k == 0
+
+    if scales.dtype == jnp.float16:
+        s_bits = jax.lax.bitcast_convert_type(scales, jnp.uint16)
+    else:
+        s_bits = jax.lax.bitcast_convert_type(
+            scales.astype(jnp.float16), jnp.uint16
+        )
+    y = _qmm_i8(x2, data, s_bits, jnp.dtype(out_dtype), block_o, block_k,
+                interpret, BLOCK)
+    return y[:M].reshape(*lead, O)
+
+
 @functools.partial(
     jax.jit, static_argnames=("out_dtype", "block_o", "interpret", "two_view",
                               "block", "codebook")
@@ -223,7 +331,14 @@ def _qmatmul_nibble(x, data, scales, out_dtype, block_o, interpret,
     # CPU tests cannot see. Shrink the O tile until the model fits in
     # ~10 MiB, leaving headroom for x views and the scale one-hot.
     VMEM_BUDGET = 10 * 1024 * 1024
-    while block_o > 8 and (block_o * kh * 12 > VMEM_BUDGET or O % block_o):
+    # block_o-dependent tile (~12 B/packed element) + the block_o-
+    # INDEPENDENT one-hot sel matrix ((kh/32) x kh f32 = kh^2/8 B);
+    # shrinking the O tile cannot shrink the sel — if a future shape
+    # overflows even at block_o=8, the fix is K-tiling like _qmm_i8
+    sel_bytes = kh * kh // 8
+    while block_o > 8 and (
+        block_o * kh * 12 + sel_bytes > VMEM_BUDGET or O % block_o
+    ):
         block_o //= 2
     assert O % block_o == 0, f"O={O} not divisible by block_o={block_o}"
 

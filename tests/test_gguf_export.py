@@ -136,3 +136,16 @@ def test_llama_arch_bias_roundtrip(tmp_path):
         np.asarray(p2["layers"]["bq"], np.float32),
         np.asarray(params["layers"]["bq"], np.float32), atol=1e-2,
     )
+
+
+def test_gguf_qtype_choices_mirror_export_table():
+    """The CLI's literal choices tuple must stay in sync with the
+    exporter's type map (the CLI avoids importing it at parse time)."""
+    import inspect
+
+    from bigdl_tpu import cli
+    from bigdl_tpu.convert.gguf_export import _GGML_FOR_QTYPE
+
+    src = inspect.getsource(cli.main)
+    for q in _GGML_FOR_QTYPE:
+        assert f'"{q}"' in src, f"CLI choices missing gguf qtype {q}"

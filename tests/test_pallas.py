@@ -193,3 +193,36 @@ def test_linear_dispatch_nf4_uses_codebook_kernel(rng, monkeypatch):
     # asym_int4 (per-block mins) must NOT take the kernel path
     qa = quantize(w, "asym_int4")
     assert not _use_qgemv(x, qa)
+
+
+@pytest.mark.parametrize("m", [1, 4])
+def test_qmatmul_int8_matches_dequant(rng, m):
+    from bigdl_tpu.ops.pallas.qmatmul import qmatmul_int8
+
+    K, O = 128, 256
+    x = jnp.asarray(rng.normal(size=(m, K)), jnp.float32).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(O, K)) * 0.1, jnp.float32)
+    qt = quantize(w, "sym_int8")
+    y = qmatmul_int8(x, qt.data, qt.scales, block_o=128, interpret=True)
+    ref = jnp.einsum(
+        "mk,ok->mo", x.astype(jnp.bfloat16), qt.dequantize(jnp.bfloat16),
+        preferred_element_type=jnp.bfloat16,
+    )
+    np.testing.assert_allclose(
+        np.asarray(y, jnp.float32), np.asarray(ref, jnp.float32),
+        atol=0.1, rtol=0.05,
+    )
+
+
+def test_linear_dispatch_int8_uses_kernel(rng, monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_PALLAS", "interpret")
+    from bigdl_tpu.ops.linear import _use_qgemv, linear
+
+    K, O = 64, 128
+    x = jnp.asarray(rng.normal(size=(1, 1, K)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(O, K)) * 0.1, jnp.float32)
+    qt = quantize(w, "sym_int8")
+    assert _use_qgemv(x, qt)
+    y = linear(x, qt, None, jnp.float32)
+    ref = jnp.einsum("btk,ok->bto", x, qt.dequantize(jnp.float32))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=0.05)
