@@ -68,14 +68,13 @@ def _sampling_kwargs(payload: dict) -> dict:
         # explicit value wins over implied sampling (the t<=0 contradiction
         # was already rejected above)
         kw["do_sample"] = bool(payload["do_sample"])
-    if float(payload.get("repetition_penalty", 1.0)) != 1.0:
-        # the engine's shared decode step has no per-slot seen-token
-        # masks yet; silently ignoring the knob would misreport outputs
+    if "repetition_penalty" in payload:
+        p = float(payload["repetition_penalty"])
+        # HF/TGI contract: penalty > 0 (0 divides logits to inf/NaN)
         invalid_input_error(
-            False,
-            "per-request repetition_penalty is not supported by the "
-            "serving engine yet; use TpuModel.generate(repetition_penalty=)",
+            p > 0, f"repetition_penalty must be > 0, got {p}"
         )
+        kw["repetition_penalty"] = p
     if "eos_token_id" in payload:
         kw["eos_token_id"] = int(payload["eos_token_id"])
     return kw

@@ -52,6 +52,7 @@ class Request:
     temperature: Optional[float] = None
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    repetition_penalty: Optional[float] = None
     eos_token_id: Optional[int] = None
     # filled by the engine
     out_tokens: list[int] = dataclasses.field(default_factory=list)
@@ -91,13 +92,6 @@ class InferenceEngine:
         self.n_slots = n_slots
         self.max_len = max_len
         self.gen = gen or GenerationConfig()
-        if self.gen.repetition_penalty != 1.0:
-            # the shared decode step has no per-slot seen-token masks;
-            # accepting the field and ignoring it would misreport outputs
-            raise NotImplementedError(
-                "the serving engine does not support repetition_penalty "
-                "yet; use TpuModel.generate(repetition_penalty=)"
-            )
         # paged KV (kvpaged.py): pages allocated on demand + refcounted
         # prefix cache, so the pool can be smaller than slots*max_len and
         # identical prompt prefixes share storage AND prefill compute
@@ -146,13 +140,19 @@ class InferenceEngine:
         self._topp = np.full((n_slots,), g.top_p if g.top_p is not None else 1.0,
                              np.float32)
         self._dosample = np.full((n_slots,), g.do_sample, bool)
+        self._penalty = np.full((n_slots,), 1.0, np.float32)
+        # per-slot seen-token masks for the HF repetition penalty
+        # (reference xe_addons.repetition_penalty_logits_process_inplaced);
+        # the all-1.0 common case skips the rewrite via a lax.cond in
+        # _decode_impl
+        self.seen = jnp.zeros((n_slots, self.config.vocab_size), jnp.bool_)
 
         # forward_fn: the family forward, or the pipeline step when the
         # mesh has a pp axis (api.TpuModel.forward_fn)
         fwd = getattr(model, "forward_fn", None) or model.family.forward
         self._decode = self._with_mesh(jax.jit(
             functools.partial(self._decode_impl, fwd),
-            donate_argnames=("cache",),
+            donate_argnames=("cache", "seen"),
         ))
         self._prefill = self._with_mesh(jax.jit(
             functools.partial(self._prefill_impl, fwd),
@@ -264,14 +264,23 @@ class InferenceEngine:
         return logits[0, last_idx], cache.k, cache.v
 
     def _decode_impl(self, forward, params, cur, cache, key,
-                     temp, topk, topp, dosample):
+                     temp, topk, topp, dosample, seen, penalty):
+        from bigdl_tpu.generate import apply_repetition_penalty
+
         logits, cache = forward(
             self.config, params, cur[:, None], cache, mode="decode"
         )
-        nxt = sample_token_per_row(
-            logits[:, -1], key, temp, topk, topp, dosample
+        last = logits[:, -1]
+        # all-default batches (every penalty 1.0) skip the O(slots x V)
+        # rewrite, mirroring sample_token_per_row's all-greedy guard
+        step = jax.lax.cond(
+            jnp.any(penalty != 1.0),
+            lambda: apply_repetition_penalty(last, seen, penalty),
+            lambda: last,
         )
-        return nxt, cache
+        nxt = sample_token_per_row(step, key, temp, topk, topp, dosample)
+        seen = seen.at[jnp.arange(seen.shape[0]), nxt].set(True)
+        return nxt, cache, seen
 
     # ---- host API ---------------------------------------------------------
 
@@ -284,8 +293,13 @@ class InferenceEngine:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        repetition_penalty: Optional[float] = None,
         eos_token_id: Optional[int] = None,
     ) -> Request:
+        if repetition_penalty is not None and repetition_penalty <= 0:
+            raise ValueError(
+                f"repetition_penalty must be > 0, got {repetition_penalty}"
+            )
         # the decode window must fit the cache alongside a minimal prompt
         # bucket; clamp instead of letting _admit derive a zero/negative
         # bucket (which would crash the engine thread)
@@ -294,7 +308,9 @@ class InferenceEngine:
             rid=next(self._rid), prompt=list(prompt),
             max_new_tokens=max_new_tokens, stream=stream,
             do_sample=do_sample, temperature=temperature,
-            top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+            top_k=top_k, top_p=top_p,
+            repetition_penalty=repetition_penalty,
+            eos_token_id=eos_token_id,
         )
         self._queue.put(req)
         return req
@@ -484,6 +500,23 @@ class InferenceEngine:
         """Shared post-prefill bookkeeping: sample the first token, arm
         the slot's sampling params, emit."""
         temp, topk, topp, dosample = self._slot_sampling(req)
+        penalty = (req.repetition_penalty
+                   if req.repetition_penalty is not None
+                   else self.gen.repetition_penalty)
+        if penalty != 1.0:
+            from bigdl_tpu.generate import apply_repetition_penalty, \
+                seen_from_prompt
+
+            prompt_arr = np.asarray([req.prompt], np.int32)
+            row = seen_from_prompt(
+                jnp.asarray(prompt_arr), jnp.zeros((1,), jnp.int32),
+                self.config.vocab_size,
+            )[0]
+            logits_last = apply_repetition_penalty(
+                logits_last, row[None], jnp.asarray(penalty, jnp.float32)
+            )
+        else:
+            row = jnp.zeros((self.config.vocab_size,), jnp.bool_)
         self._rng, k = jax.random.split(self._rng)
         first = int(sample_token_per_row(
             logits_last, k,
@@ -500,6 +533,8 @@ class InferenceEngine:
         )
         self._temp[slot], self._topk[slot] = temp, topk
         self._topp[slot], self._dosample[slot] = topp, dosample
+        self._penalty[slot] = penalty
+        self.seen = self.seen.at[slot].set(row).at[slot, first].set(True)
         self.active[slot] = True
         self._emit(slot, first)
 
@@ -559,6 +594,8 @@ class InferenceEngine:
         self._slots[slot] = _Slot()
         self.active[slot] = False
         self._dosample[slot] = False  # idle rows decode deterministic garbage
+        self._penalty[slot] = 1.0
+        self.seen = self.seen.at[slot].set(False)
         if self.paged:
             self._release_slot_pages(slot)
 
@@ -567,6 +604,10 @@ class InferenceEngine:
         so the engine can keep serving new requests."""
         self.cache = self._make_pool()
         self.cur = jnp.zeros((self.n_slots,), jnp.int32)
+        self.seen = jnp.zeros(
+            (self.n_slots, self.config.vocab_size), jnp.bool_
+        )
+        self._penalty[:] = 1.0
         self.active[:] = False
         if self.paged:
             self._free_pages = list(range(1, self.n_pages))  # 0 = scratch
@@ -608,10 +649,11 @@ class InferenceEngine:
             return not self._queue.empty() or self._waiting is not None
         self._rng, k = jax.random.split(self._rng)
         try:
-            nxt, self.cache = self._decode(
+            nxt, self.cache, self.seen = self._decode(
                 self.model.params, self.cur, self.cache, k,
                 jnp.asarray(self._temp), jnp.asarray(self._topk),
                 jnp.asarray(self._topp), jnp.asarray(self._dosample),
+                self.seen, jnp.asarray(self._penalty),
             )
         except Exception:
             # the donated cache buffer is gone — rebuild before re-raising

@@ -232,3 +232,20 @@ def test_server_sampling_passthrough(model):
         assert json.loads(r.read())["tokens"] == ref
     finally:
         srv.shutdown()
+
+
+def test_engine_repetition_penalty_matches_generate(model):
+    """A greedy request with repetition_penalty must emit exactly what
+    TpuModel.generate(repetition_penalty=) emits (same per-step seen
+    semantics), and concurrent no-penalty requests stay unaffected."""
+    prompt = [5, 6, 7, 8, 5, 6]
+    ref = model.generate([prompt], max_new_tokens=8, repetition_penalty=1.5)
+
+    eng = InferenceEngine(model, n_slots=4, max_len=128)
+    r_pen = eng.submit(prompt, max_new_tokens=8, repetition_penalty=1.5)
+    r_plain = eng.submit(prompt, max_new_tokens=8)
+    eng.run_until_idle()
+    assert r_pen.out_tokens == ref[0].tolist()
+    assert r_plain.out_tokens == model.generate(
+        [prompt], max_new_tokens=8
+    )[0].tolist()
