@@ -90,9 +90,18 @@ def test_error_counter_on_500(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req, timeout=60)
     assert e.value.code == 404
-    text = urllib.request.urlopen(
-        f"http://127.0.0.1:{server.port}/metrics", timeout=60
-    ).read().decode()
+    # the timer records AFTER the response is sent (request_timer exits
+    # once the handler returns), so a prompt scrape can race it — retry
+    import time as _time
+
+    text = ""
+    for _ in range(50):
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=60
+        ).read().decode()
+        if 'endpoint="other",status="404"' in text:
+            break
+        _time.sleep(0.1)
     # unknown paths collapse into one label (bounded cardinality)
     assert 'endpoint="other",status="404"' in text
     assert "/nope" not in text
