@@ -9,6 +9,7 @@ binary; here every path is the same XLA program) plus `llm_convert`
     python -m bigdl_tpu.cli generate <model_dir> -p "..." -n 64
     python -m bigdl_tpu.cli serve    <model_dir> --port 8000
     python -m bigdl_tpu.cli bench    <model_dir>
+    python -m bigdl_tpu.cli chat     <model_dir>
 """
 
 from __future__ import annotations
@@ -41,6 +42,26 @@ def _tokenizer(path: str):
         return AutoTokenizer.from_pretrained(path)
     except Exception:
         return None
+
+
+def _gen_text(model, tok, ids, max_new_tokens, temperature):
+    """Shared generate path for the one-shot and chat commands: greedy
+    or sampled, EOS/pad TRIMMED before decode (generate_tokens pads the
+    fixed [B, max_new] output after EOS — leaking pads corrupts decoded
+    text and, in chat mode, every later turn's history)."""
+    eos = tok.eos_token_id if tok else None
+    out = model.generate(
+        [ids], max_new_tokens=max_new_tokens,
+        do_sample=temperature > 0, temperature=max(temperature, 1e-5),
+        eos_token_id=eos,
+    )
+    toks = out[0].tolist()
+    if eos is not None:
+        pad = 0  # generate()'s default pad_token_id
+        while toks and toks[-1] == pad:
+            toks.pop()
+    return toks, (tok.decode(toks, skip_special_tokens=True)
+                  if tok else str(toks))
 
 
 def cmd_convert(args):
@@ -80,19 +101,52 @@ def cmd_generate(args):
     else:
         ids = list(tok(args.prompt)["input_ids"])
     t0 = time.time()
-    out = model.generate(
-        [ids], max_new_tokens=args.max_new_tokens,
-        do_sample=args.temperature > 0, temperature=max(args.temperature, 1e-5),
-        eos_token_id=(tok.eos_token_id if tok else None),
-    )
+    toks, text = _gen_text(model, tok, ids, args.max_new_tokens,
+                           args.temperature)
     dt = time.time() - t0
-    toks = out[0].tolist()
-    text = tok.decode(toks, skip_special_tokens=True) if tok else str(toks)
     print(text)
     print(
         f"[{len(toks)} tokens in {dt:.2f}s — {1000 * dt / max(len(toks), 1):.1f} ms/token]",
         file=sys.stderr,
     )
+
+
+def cmd_chat(args):
+    """Interactive chat REPL — the reference's `llm-chat` wrapper
+    (cli/llm-chat dispatches to main-<family> binaries; here the same
+    jitted decode drives a tokenizer chat template when available)."""
+    model = _load(args.model, args.qtype)
+    tok = _tokenizer(args.model)
+    history: list[dict] = []
+    templated = tok is not None and getattr(tok, "chat_template", None)
+    if args.system:
+        if not templated:
+            print("warning: --system needs a tokenizer chat template; "
+                  "ignored for this model", file=sys.stderr)
+        else:
+            history.append({"role": "system", "content": args.system})
+    print("bigdl-tpu chat — empty line or /exit quits", file=sys.stderr)
+    while True:
+        try:
+            line = input("you> ")
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not line.strip() or line.strip() == "/exit":
+            break
+        if templated:
+            history.append({"role": "user", "content": line})
+            ids = list(tok.apply_chat_template(
+                history, add_generation_prompt=True
+            ))
+        elif tok is not None:
+            ids = list(tok(line)["input_ids"])
+        else:  # no tokenizer: whitespace token ids (testing)
+            ids = [int(t) for t in line.split()]
+        _, text = _gen_text(model, tok, ids, args.max_new_tokens,
+                            args.temperature)
+        print(f"bot> {text}")
+        if templated:
+            history.append({"role": "assistant", "content": text})
 
 
 def cmd_serve(args):
@@ -184,6 +238,13 @@ def main(argv=None):
     s.add_argument("--paged", action="store_true",
                    help="paged KV pool + prefix caching")
     s.set_defaults(fn=cmd_serve)
+
+    ch = sub.add_parser("chat", help="interactive chat REPL", parents=[qp])
+    ch.add_argument("model")
+    ch.add_argument("-n", "--max-new-tokens", type=int, default=256)
+    ch.add_argument("-t", "--temperature", type=float, default=0.7)
+    ch.add_argument("--system", default=None, help="system prompt")
+    ch.set_defaults(fn=cmd_chat)
 
     b = sub.add_parser("bench", help="quick decode-latency check", parents=[qp])
     b.add_argument("model")

@@ -57,3 +57,13 @@ def test_cli_convert_gguf(saved_model, tmp_path):
               "--gguf-qtype", "q8_0"])
     m = AutoModelForCausalLM.from_gguf(str(out))
     assert m.generate([[1, 2, 3]], max_new_tokens=4).shape == (1, 4)
+
+
+def test_cli_chat_scripted(saved_model, capsys, monkeypatch):
+    """chat REPL end-to-end with scripted stdin (no tokenizer: token-id
+    mode)."""
+    lines = iter(["3 1 4 1 5", "/exit"])
+    monkeypatch.setattr("builtins.input", lambda *a: next(lines))
+    cli.main(["chat", saved_model, "-n", "6", "-t", "0"])
+    out = capsys.readouterr().out
+    assert "bot> [" in out
