@@ -56,10 +56,11 @@ def _gen_text(model, tok, ids, max_new_tokens, temperature):
         eos_token_id=eos,
     )
     toks = out[0].tolist()
-    if eos is not None:
-        pad = 0  # generate()'s default pad_token_id
-        while toks and toks[-1] == pad:
-            toks.pop()
+    if eos is not None and eos in toks:
+        # cut at EOS: everything after is pad fill (generate_tokens pads
+        # the fixed output window) — stripping pad VALUES instead would
+        # eat legitimate id-0 tokens when EOS never fired
+        toks = toks[: toks.index(eos) + 1]
     return toks, (tok.decode(toks, skip_special_tokens=True)
                   if tok else str(toks))
 

@@ -100,6 +100,13 @@ def init_cache(
     )
 
 
+# the serving engine's generic dataclass insert/pool path supports this
+# family's cache (flat [L, B, S, ...] array fields + real pos/start
+# fields) — see serving/engine.py; rwkv/yuan/mllama caches need
+# dedicated handling and must NOT set this
+SERVABLE_CACHE = True
+
+
 def _layer_is_moe(config: ModelConfig, idx: int) -> bool:
     return config.is_moe and idx >= config.first_k_dense_replace
 

@@ -98,6 +98,26 @@ class InferenceEngine:
         # (the reference's paged attention + prefix caching live in its
         # vLLM fork, vllm/xpu/)
         self.paged = paged
+        # families with their own cache serve through the generic
+        # dataclass insert path ONLY when they declare SERVABLE_CACHE
+        # (MLA's latent — flat [L, B, S, ...] fields with real pos/start;
+        # models/deepseek.py). rwkv/yuan/mllama caches have properties or
+        # nested pools the generic path would silently corrupt.
+        fam = model.family
+        self._family_cache = None
+        if hasattr(fam, "init_cache"):
+            if not getattr(fam, "SERVABLE_CACHE", False):
+                raise NotImplementedError(
+                    f"the serving engine does not support "
+                    f"{model.config.model_type}'s cache layout yet; use "
+                    "TpuModel.generate()"
+                )
+            self._family_cache = fam.init_cache
+        if paged and self._family_cache is not None:
+            raise NotImplementedError(
+                f"paged serving is not available for "
+                f"{model.config.model_type}: its cache is not a KV pool"
+            )
         self.page_size = page_size
         self.max_pages_per_row = -(-max_len // page_size)
         # +1: physical page 0 is the reserved scratch sink, so the default
@@ -185,6 +205,11 @@ class InferenceEngine:
         """The shared KV pool, per-row positions from the start (idle rows
         park at 0); sharded over kv heads when the model is on a mesh."""
         cfg = self.config
+        if self._family_cache is not None:
+            cache = self._family_cache(cfg, self.n_slots, self.max_len)
+            return dataclasses.replace(
+                cache, pos=jnp.zeros((self.n_slots,), jnp.int32)
+            )
         if self.paged:
             from bigdl_tpu import kvpaged
 
@@ -221,10 +246,13 @@ class InferenceEngine:
     def _prefill_impl(self, forward, params, tokens, start, bucket):
         """Single-request prefill on its own scalar-pos cache."""
         cfg = self.config
-        cache = kvcache.init_cache(
-            cfg.num_hidden_layers, 1, bucket, cfg.num_key_value_heads,
-            cfg.head_dim_,
-        )
+        if self._family_cache is not None:
+            cache = self._family_cache(cfg, 1, bucket)
+        else:
+            cache = kvcache.init_cache(
+                cfg.num_hidden_layers, 1, bucket, cfg.num_key_value_heads,
+                cfg.head_dim_,
+            )
         cache = dataclasses.replace(cache, start=start)
         logits, cache = forward(
             cfg, params, tokens, cache, mode="prefill", last_logits_only=True
@@ -233,7 +261,27 @@ class InferenceEngine:
 
     def _insert_impl(self, cache, pcache, slot, pad):
         """Copy a prefilled request's KV (length `bucket`) into slot row at
-        slots [0, bucket); per-row pos/start updated."""
+        slots [0, bucket); per-row pos/start updated. Family caches (MLA
+        latents) insert generically: every [L, B, ...] array field of the
+        dataclass takes the prefill cache's row at the slot index."""
+        if self._family_cache is not None:
+            bucket = None
+            upd = {}
+            for f in dataclasses.fields(cache):
+                v = getattr(cache, f.name)
+                pv = getattr(pcache, f.name)
+                if f.name in ("pos", "start"):
+                    continue
+                if isinstance(v, jax.Array) and v.ndim >= 2:
+                    if bucket is None and v.ndim >= 3:
+                        bucket = pv.shape[2]
+                    idx = (0, slot) + (0,) * (v.ndim - 2)
+                    upd[f.name] = jax.lax.dynamic_update_slice(
+                        v, pv.astype(v.dtype), idx
+                    )
+            upd["pos"] = cache.pos.at[slot].set(bucket)
+            upd["start"] = cache.start.at[slot].set(pad)
+            return dataclasses.replace(cache, **upd)
         bucket = pcache.k.shape[2]
         k = jax.lax.dynamic_update_slice(
             cache.k, pcache.k, (0, slot, 0, 0, 0)

@@ -249,3 +249,50 @@ def test_engine_repetition_penalty_matches_generate(model):
     assert r_plain.out_tokens == model.generate(
         [prompt], max_new_tokens=8
     )[0].tolist()
+
+
+def test_engine_serves_mla_family():
+    """DeepSeek (MLA latent cache) through the continuous-batching
+    engine: concurrent greedy requests must match TpuModel.generate
+    per prompt, and admission works mid-flight."""
+    from bigdl_tpu.models import deepseek
+    from bigdl_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.from_hf_config(dict(
+        model_type="deepseek_v2", vocab_size=96, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        q_lora_rank=32, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, n_routed_experts=0,
+        first_k_dense_replace=2,
+    ))
+    params = deepseek.quantize_params(
+        deepseek.init_params(cfg, jax.random.PRNGKey(0)), "sym_int4"
+    )
+    m = TpuModel(cfg, params, "sym_int4")
+
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8]]
+    refs = [m.generate([p], max_new_tokens=6)[0].tolist() for p in prompts]
+
+    eng = InferenceEngine(m, n_slots=2, max_len=128)  # < len(prompts): requeue
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle()
+    for r, ref in zip(reqs, refs):
+        assert r.out_tokens == ref, (r.out_tokens, ref)
+
+    # paged mode is a KV-pool concept — family caches refuse clearly
+    with pytest.raises(NotImplementedError, match="paged"):
+        InferenceEngine(m, n_slots=2, max_len=64, paged=True)
+
+
+def test_engine_rejects_unsupported_family_caches():
+    from bigdl_tpu.models import rwkv
+    from bigdl_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig(
+        model_type="rwkv", vocab_size=64, hidden_size=64,
+        num_hidden_layers=1, num_attention_heads=1, num_key_value_heads=1,
+        intermediate_size=128, norm_type="layernorm",
+    )
+    m = TpuModel(cfg, rwkv.init_params(cfg, jax.random.PRNGKey(0)), "bf16")
+    with pytest.raises(NotImplementedError, match="cache layout"):
+        InferenceEngine(m, n_slots=2, max_len=64)
