@@ -19,6 +19,7 @@ llama.cpp's converter) is applied on export and undone by the importer.
 
 from __future__ import annotations
 
+import io
 import struct
 from typing import Any, Optional
 
@@ -27,7 +28,8 @@ import numpy as np
 from bigdl_tpu.convert.gguf import (
     GGML_BF16, GGML_F16, GGML_F32, GGML_Q4_0, GGML_Q8_0,
     GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K,
-    GGUF_MAGIC, _V_ARR, _V_BOOL, _V_F32, _V_I32, _V_STR, _V_U32, _V_U64,
+    GGUF_MAGIC, _V_ARR, _V_BOOL, _V_F32, _V_I32, _V_I64, _V_STR, _V_U32,
+    _V_U64,
 )
 from bigdl_tpu.models.config import ModelConfig
 
@@ -130,9 +132,23 @@ def _w_value(f, v: Any) -> None:
             for e in v:
                 _w_str(f, e)
         elif all(isinstance(e, int) for e in v):
-            f.write(struct.pack("<IQ", _V_I32, len(v)))
+            # element type from the value range, validated BEFORE any header
+            # bytes hit the disk (a mid-write struct.error would leave a
+            # truncated file): i32 when everything fits, else i64 when any
+            # element is negative, else u64.
+            if all(-2 ** 31 <= e < 2 ** 31 for e in v):
+                etype, fmt = _V_I32, "<i"
+            elif any(e < 0 for e in v):
+                if not all(-2 ** 63 <= e < 2 ** 63 for e in v):
+                    raise ValueError(f"int list out of i64 range: {v!r}")
+                etype, fmt = _V_I64, "<q"
+            else:
+                if not all(e < 2 ** 64 for e in v):
+                    raise ValueError(f"int list out of u64 range: {v!r}")
+                etype, fmt = _V_U64, "<Q"
+            f.write(struct.pack("<IQ", etype, len(v)))
             for e in v:
-                f.write(struct.pack("<i", e))
+                f.write(struct.pack(fmt, e))
         else:
             f.write(struct.pack("<IQ", _V_F32, len(v)))
             for e in v:
@@ -165,11 +181,17 @@ def write_gguf(
     metadata = dict(metadata)
     metadata["general.alignment"] = ALIGN
 
+    # serialize metadata in memory first: a bad value (out-of-range int,
+    # unsupported type) raises before the output file is even created,
+    # never leaving a truncated GGUF on disk
+    meta_buf = io.BytesIO()
+    for k, v in metadata.items():
+        _w_str(meta_buf, k)
+        _w_value(meta_buf, v)
+
     with open(path, "wb") as f:
         f.write(struct.pack("<IIQQ", GGUF_MAGIC, 3, len(tensors), len(metadata)))
-        for k, v in metadata.items():
-            _w_str(f, k)
-            _w_value(f, v)
+        f.write(meta_buf.getvalue())
         offset = 0
         for name, (shape, t, _get) in tensors.items():
             _w_str(f, name)

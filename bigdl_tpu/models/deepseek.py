@@ -63,6 +63,29 @@ def _dims(config: ModelConfig):
     return H, dn, dr, dv, r
 
 
+def mla_softmax_scale(config: ModelConfig) -> float:
+    """(dn+dr)^-0.5, times the yarn temperature mscale^2 when the checkpoint
+    ships `rope_scaling.mscale_all_dim` (all real DeepSeek-V2/V3 and MiniCPM3
+    configs do). Official DeepSeek modeling and HF DeepseekV3Attention
+    (modeling_deepseek_v3.py:373-377, transformers 4.57) fold
+    yarn_get_mscale(factor, mscale_all_dim)^2 into the softmax scale; the
+    rope-level attention_factor on cos/sin is the mscale/mscale_all_dim
+    ratio (1.0 for these checkpoints), so without this term the attention
+    temperature would be dropped entirely (~1.6-1.9x under-scaled scores).
+    Note transformers 4.57's *integrated* DeepseekV2Attention omits the
+    term — a known fidelity gap vs the official remote code; we follow the
+    official checkpoints (and HF V3)."""
+    from bigdl_tpu.ops.rope import get_mscale
+
+    _, dn, dr, _, _ = _dims(config)
+    scale = (dn + dr) ** -0.5
+    rs = config.rope_scaling_dict
+    if rs and rs.get("mscale_all_dim"):
+        m = get_mscale(rs.get("factor", 1.0), rs["mscale_all_dim"])
+        scale = scale * m * m
+    return scale
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class MLACache:
@@ -314,7 +337,7 @@ def forward(
     B, T = tokens.shape
     H, dn, dr, dv, r = _dims(config)
     eps = config.rms_norm_eps
-    scale = (dn + dr) ** -0.5
+    scale = mla_softmax_scale(config)
 
     fresh = cache is None
     if fresh:

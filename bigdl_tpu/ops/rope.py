@@ -21,6 +21,15 @@ import jax
 import jax.numpy as jnp
 
 
+def get_mscale(scale: float, m: float = 1.0) -> float:
+    """HF yarn_get_mscale: the yarn attention temperature. Used both for
+    the rope-level attention_factor (as a ratio) and, squared, for the
+    MLA softmax scale (models.deepseek.mla_softmax_scale)."""
+    if scale <= 1.0 or m == 0:
+        return 1.0
+    return 0.1 * m * math.log(scale) + 1.0
+
+
 def default_inv_freq(head_dim: int, theta: float) -> jax.Array:
     return 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
@@ -67,11 +76,12 @@ def yarn_scaled_inv_freq(
     The temperature follows HF _compute_yarn_parameters exactly:
     explicit `attention_factor` wins; else deepseek-style
     mscale/mscale_all_dim give get_mscale(f, m)/get_mscale(f, m_all);
-    else the standard 0.1*ln(f)+1. (DeepSeek checkpoints ship
-    mscale == mscale_all_dim, so their ratio is 1.0 — the official
-    remote code instead folds mscale^2 into softmax_scale over ALL
-    channels, a known divergence from HF; we match HF, our test
-    oracle.)"""
+    else the standard 0.1*ln(f)+1. DeepSeek checkpoints ship
+    mscale == mscale_all_dim, so their ratio (applied to cos/sin) is
+    1.0 — HF splits the yarn temperature between this rope-level
+    attention_factor and the attention module's own mscale^2 softmax
+    scaling (DeepseekV3Attention); both are needed for parity. The
+    mscale^2 half lives in models.deepseek.mla_softmax_scale."""
 
     def find_dim(num_rot):
         return (
@@ -88,11 +98,6 @@ def yarn_scaled_inv_freq(
     )
     interp = inv_freq / factor  # fully interpolated (long range)
     inv = interp * ramp + inv_freq * (1 - ramp)
-
-    def get_mscale(scale, m=1.0):
-        if scale <= 1.0 or m == 0:
-            return 1.0
-        return 0.1 * m * math.log(scale) + 1.0
 
     if attention_factor is not None:
         att = float(attention_factor)

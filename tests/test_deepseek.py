@@ -171,15 +171,18 @@ def test_minicpm3_config_and_generate():
 
 def test_deepseek_yarn_mscale_equivalence():
     """Real DeepSeek checkpoints ship yarn rope with
-    mscale == mscale_all_dim: the HF attention factor is their ratio
-    (= 1.0), NOT the standard 0.1*ln(f)+1 — logits must still match."""
+    mscale == mscale_all_dim: the rope attention factor is their ratio
+    (= 1.0), and the yarn temperature instead enters as mscale^2 on the
+    softmax scale. Oracle: HF DeepseekV3Attention (transformers 4.57),
+    which implements the official behavior; integrated DeepseekV2 in
+    4.57 drops the term (known fidelity gap) so V3 is the pin."""
     rope_scaling = {
         "rope_type": "yarn", "factor": 4.0, "mscale": 0.707,
         "mscale_all_dim": 0.707, "beta_fast": 32, "beta_slow": 1,
         "original_max_position_embeddings": 16,
     }
     cfg, model = hf_model(
-        "DeepseekV2ForCausalLM", "DeepseekV2Config",
+        "DeepseekV3ForCausalLM", "DeepseekV3Config",
         n_routed_experts=4, first_k_dense_replace=3,
         moe_intermediate_size=32, n_shared_experts=1,
         rope_scaling=rope_scaling,
@@ -196,6 +199,33 @@ def test_deepseek_yarn_mscale_equivalence():
                      "original_max_position_embeddings": 16}, seq_len=64,
     )
     assert att_std == pytest.approx(0.1 * np.log(4.0) + 1.0)
+
+
+def test_mla_softmax_scale_yarn_mscale():
+    """Pin the mscale^2 softmax-scale factor against the HF formula
+    (DeepseekV3Attention: yarn_get_mscale(factor, mscale_all_dim)^2)."""
+    from bigdl_tpu.models.config import ModelConfig
+    from bigdl_tpu.models.deepseek import mla_softmax_scale
+
+    base = dict(
+        model_type="deepseek_v2", vocab_size=32, hidden_size=64,
+        num_hidden_layers=1, num_attention_heads=2,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        kv_lora_rank=32,
+    )
+    cfg = ModelConfig(**base)
+    assert mla_softmax_scale(cfg) == pytest.approx((16 + 8) ** -0.5)
+    cfg_yarn = ModelConfig(**base, rope_scaling={
+        "rope_type": "yarn", "factor": 40.0, "mscale": 0.707,
+        "mscale_all_dim": 0.707,
+        "original_max_position_embeddings": 16,
+    })
+    from bigdl_tpu.ops.rope import get_mscale
+
+    m = get_mscale(40.0, 0.707)
+    assert m == pytest.approx(0.1 * 0.707 * np.log(40.0) + 1.0)
+    assert mla_softmax_scale(cfg_yarn) == pytest.approx(
+        (16 + 8) ** -0.5 * m * m)
 
 
 def test_deepseek_ragged_dispatch_matches_hf():
