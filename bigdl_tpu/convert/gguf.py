@@ -359,21 +359,25 @@ def _nibbles_to_ours(qs: np.ndarray) -> np.ndarray:
 
 
 def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
-    """Returns (data, scales, mins, our_qtype_name) for directly-mappable
-    types; data layouts match bigdl_tpu.quant.numerics exactly."""
+    """Returns (fields, our_qtype_name) for directly-mappable types —
+    `fields` is a dict of QTensor array fields whose layouts match
+    bigdl_tpu.quant.numerics exactly. Pure integer/f16-view repack, no
+    dequantization round trip."""
     if ggml_type == GGML_Q4_0:
         d = _f16(blocks, 0).astype(np.float16)
         data = _nibbles_to_ours(blocks[..., 2:18])  # [..., K//2] row layout
-        return data, d, None, "sym_int4"
+        return dict(data=data, scales=d), "sym_int4"
     if ggml_type == GGML_Q4_1:
         d = _f16(blocks, 0).astype(np.float16)
         m = _f16(blocks, 2).astype(np.float16)
         data = _nibbles_to_ours(blocks[..., 4:20])
-        return data, d, m, "asym_int4"
+        return dict(data=data, scales=d, mins=m), "asym_int4"
     if ggml_type == GGML_Q8_0:
         d = _f16(blocks, 0).astype(np.float16)
         data = blocks[..., 2:34].copy().view(np.int8)
-        return data.reshape(*data.shape[:-2], -1), d, None, "sym_int8"
+        return dict(
+            data=data.reshape(*data.shape[:-2], -1), scales=d
+        ), "sym_int8"
     if ggml_type == GGML_Q5_0:
         d = _f16(blocks, 0).astype(np.float16)
         h = _q5_high_bits(blocks, 2)
@@ -382,7 +386,9 @@ def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
             [(qs & 0xF) | (h[..., :16] << 4), (qs >> 4) | (h[..., 16:] << 4)],
             axis=-1,
         ).astype(np.int8)
-        return codes.reshape(*codes.shape[:-2], -1), d, None, "sym_int5"
+        return dict(
+            data=codes.reshape(*codes.shape[:-2], -1), scales=d
+        ), "sym_int5"
     if ggml_type == GGML_Q5_1:
         d = _f16(blocks, 0).astype(np.float16)
         m = _f16(blocks, 2).astype(np.float16)
@@ -392,14 +398,25 @@ def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
             [(qs & 0xF) | (h[..., :16] << 4), (qs >> 4) | (h[..., 16:] << 4)],
             axis=-1,
         ).astype(np.int8)
-        return codes.reshape(*codes.shape[:-2], -1), d, m, "asym_int5"
+        return dict(
+            data=codes.reshape(*codes.shape[:-2], -1), scales=d, mins=m
+        ), "asym_int5"
+    if ggml_type == GGML_Q4_K:
+        # planar repack (quant/kq_planar.py): codes + factored two-level
+        # scales — the byte-exact TPU layout the fused GEMV kernel reads
+        from bigdl_tpu.quant import kq_planar
+
+        return kq_planar.from_q4k_blocks(blocks), "q4_k"
+    if ggml_type == GGML_Q6_K:
+        from bigdl_tpu.quant import kq_planar
+
+        return kq_planar.from_q6k_blocks(blocks), "q6_k"
     if ggml_type in _KQUANT_TYPES:
-        # our k-quant QTensor storage IS the ggml super-block byte layout
-        # — carry the blocks verbatim (quant/kquants.py decodes in-graph;
-        # d offsets live in KQUANT_LAYOUT, the single layout table)
+        # q2/q3/q5_k: super-block bytes carried verbatim, decoded
+        # in-graph (quant/kquants.py); d offsets live in KQUANT_LAYOUT
         name = _KQUANT_TYPES[ggml_type]
         d = _f16(blocks, KQUANT_LAYOUT[name][1]).astype(np.float16)
-        return blocks, d, None, name
+        return dict(data=blocks, scales=d), name
     raise KeyError(ggml_type)
 
 
@@ -512,14 +529,13 @@ def load_gguf(
         info = reader.tensors[name]
         if info.ggml_type in _REPACKABLE and qtype is None:
             blocks = reader.raw_blocks(name)
-            data, scales, mins, our_q = repack_to_qtensor(blocks, info.ggml_type)
+            fields, our_q = repack_to_qtensor(blocks, info.ggml_type)
             if permute is not None:
                 p = permute(info.shape[0])
-                data, scales = data[p], scales[p]
-                mins = mins[p] if mins is not None else None
+                fields = {k: v[p] for k, v in fields.items()}
             return QTensor(
-                data=jnp.asarray(data), scales=jnp.asarray(scales),
-                mins=None if mins is None else jnp.asarray(mins), qtype=our_q,
+                qtype=our_q,
+                **{k: jnp.asarray(v) for k, v in fields.items()},
             )
         w = reader.dequantize(name)
         if permute is not None:

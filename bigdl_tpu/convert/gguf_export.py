@@ -299,11 +299,7 @@ def export_gguf(
             w = lay[key]
             if isinstance(w, QTensor):
                 arr = np.asarray(
-                    QTensor(
-                        data=w.data[i], scales=w.scales[i],
-                        mins=None if w.mins is None else w.mins[i],
-                        qtype=w.qtype,
-                    ).dequantize(jnp.float32)
+                    w.map_arrays(lambda a: a[i]).dequantize(jnp.float32)
                 )
             else:
                 arr = np.asarray(jnp.asarray(w[i], jnp.float32))

@@ -1018,14 +1018,9 @@ def top_tensors(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
 # ---------------------------------------------------------------------------
 
 def _stack_qtensors(qs: list[QTensor]) -> QTensor:
-    return QTensor(
-        data=jnp.stack([q.data for q in qs]),
-        scales=jnp.stack([q.scales for q in qs]),
-        mins=(
-            jnp.stack([q.mins for q in qs]) if qs[0].mins is not None else None
-        ),
-        qtype=qs[0].qtype,
-    )
+    from bigdl_tpu.quant.qtensor import map_arrays_multi
+
+    return map_arrays_multi(qs, jnp.stack)
 
 
 def params_from_state_dict(

@@ -30,7 +30,9 @@ from bigdl_tpu.quant import QTensor
 # interleaved (2i, 2i+1 per byte) to half-split (j, j+K/2 per byte) —
 # see quant/numerics.pack_nibbles. v1 checkpoints would silently
 # dequantize scrambled, so the version gate must reject them.
-FORMAT_VERSION = 2
+# v3: q4_k/q6_k storage moved from ggml super-block bytes to the planar
+# layout (quant/kq_planar.py) with sub_scales/sub_mins fields.
+FORMAT_VERSION = 3
 
 _VIEW_DTYPES = {
     "bfloat16": np.uint16,
@@ -55,8 +57,10 @@ def _decode(a: np.ndarray, dtype_name: str) -> jnp.ndarray:
 
 def _flatten(tree: Any, prefix: str, arrays: dict, manifest: dict) -> None:
     if isinstance(tree, QTensor):
+        from bigdl_tpu.quant.qtensor import ARRAY_FIELDS
+
         manifest[prefix] = {"kind": "qtensor", "qtype": tree.qtype}
-        for field in ("data", "scales", "mins"):
+        for field in ARRAY_FIELDS:
             val = getattr(tree, field)
             if val is not None:
                 arr, dt = _encode(val)
@@ -92,8 +96,16 @@ def load_low_bit(path: str) -> tuple[ModelConfig, dict, str]:
     """Returns (config, params, qtype)."""
     with open(os.path.join(path, "bigdl_tpu_config.json")) as f:
         meta = json.load(f)
-    if meta["format_version"] != FORMAT_VERSION:
-        raise ValueError(f"unsupported format_version {meta['format_version']}")
+    ver = meta["format_version"]
+    if ver != FORMAT_VERSION:
+        # v2 checkpoints are still bit-compatible unless they contain
+        # q4_k/q6_k tensors (whose storage moved to the planar layout)
+        v2_ok = ver == 2 and not any(
+            info.get("qtype") in ("q4_k", "q6_k")
+            for info in meta["manifest"].values()
+        )
+        if not v2_ok:
+            raise ValueError(f"unsupported format_version {ver}")
     config = ModelConfig(**meta["model_config"])
     manifest = meta["manifest"]
     npz = np.load(os.path.join(path, "weights.npz"))
@@ -107,10 +119,12 @@ def load_low_bit(path: str) -> tuple[ModelConfig, dict, str]:
             node = node.setdefault(p, {})
         node[parts[-1]] = value
 
+    from bigdl_tpu.quant.qtensor import ARRAY_FIELDS
+
     for key, info in manifest.items():
         if info["kind"] == "qtensor":
             fields = {}
-            for field in ("data", "scales", "mins"):
+            for field in ARRAY_FIELDS:
                 fkey = f"{key}@{field}"
                 if fkey in manifest:
                     fields[field] = _decode(npz[fkey], manifest[fkey]["dtype"])

@@ -80,8 +80,5 @@ def embed_lookup(embed: Any, tokens: jax.Array, compute_dtype=jnp.bfloat16) -> j
         return embed.lookup(tokens).astype(compute_dtype)
     if isinstance(embed, QTensor):
         # gather packed rows + their scales, then dequantize just those rows
-        data = embed.data[tokens]
-        scales = embed.scales[tokens]
-        mins = embed.mins[tokens] if embed.mins is not None else None
-        return dequantize_blockwise(data, scales, mins, embed.spec, compute_dtype)
+        return embed.map_arrays(lambda a: a[tokens]).dequantize(compute_dtype)
     return embed.astype(compute_dtype)[tokens]

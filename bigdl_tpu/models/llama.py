@@ -182,15 +182,11 @@ def _concat_weights(ws, axis=-2):
         return None
     spec = q0.spec
     if spec.storage not in ("packed_u8", "int8", "fp8_e4m3", "fp8_e5m2"):
-        return None  # k-quant blocks keep an extra trailing axis
-    return QTensor(
-        data=jnp.concatenate([w.data for w in ws], axis=axis),
-        scales=jnp.concatenate([w.scales for w in ws], axis=axis),
-        mins=(
-            jnp.concatenate([w.mins for w in ws], axis=axis)
-            if q0.mins is not None else None
-        ),
-        qtype=q0.qtype,
+        return None  # raw ggml super-blocks keep an extra trailing axis
+    from bigdl_tpu.quant.qtensor import map_arrays_multi
+
+    return map_arrays_multi(
+        list(ws), lambda arrs: jnp.concatenate(arrs, axis=axis)
     )
 
 
@@ -208,11 +204,7 @@ def unmerge_fused_params(params: Params, config: ModelConfig) -> Params:
 
     def rows(w, a, b):
         if isinstance(w, QTensor):
-            return QTensor(
-                data=w.data[..., a:b, :], scales=w.scales[..., a:b, :],
-                mins=None if w.mins is None else w.mins[..., a:b, :],
-                qtype=w.qtype,
-            )
+            return w.map_arrays(lambda arr: arr[..., a:b, :])
         return w[..., a:b, :]
 
     if "wqkv" in lay:

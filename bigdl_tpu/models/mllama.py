@@ -192,10 +192,7 @@ def _slice(w, s):
     from bigdl_tpu.quant import QTensor
 
     if isinstance(w, QTensor):
-        return QTensor(
-            data=w.data[s], scales=w.scales[s],
-            mins=None if w.mins is None else w.mins[s], qtype=w.qtype,
-        )
+        return w.map_arrays(lambda a: a[s])
     return w[s]
 
 

@@ -248,13 +248,9 @@ def params_from_hf(config: WhisperConfig, get, qtype: str = "bf16",
         for k in per[0]:
             vals = [layer[k] for layer in per]
             if isinstance(vals[0], QTensor):
-                out[k] = QTensor(
-                    data=jnp.stack([v.data for v in vals]),
-                    scales=jnp.stack([v.scales for v in vals]),
-                    mins=(jnp.stack([v.mins for v in vals])
-                          if vals[0].mins is not None else None),
-                    qtype=vals[0].qtype,
-                )
+                from bigdl_tpu.quant.qtensor import map_arrays_multi
+
+                out[k] = map_arrays_multi(vals, jnp.stack)
             else:
                 out[k] = jnp.stack(vals)
         return out

@@ -31,10 +31,11 @@ def _rows(shape) -> int:
     return n
 
 
-# nibble formats the fused GEMV kernel decodes in-kernel: sym_int4
-# arithmetically, nf4/fp4 via their static codebooks (asym_int4 has
-# per-block mins — an extra rank-1 term the kernel doesn't carry yet)
-_QGEMV_QTYPES = ("sym_int4", "nf4", "fp4", "sym_int8")
+# formats the fused GEMV kernel decodes in-kernel: sym/asym_int4
+# arithmetically, nf4/fp4 via their static codebooks, q4_k/q6_k via
+# factored two-level scales (planar layout, quant/kq_planar.py)
+_QGEMV_QTYPES = ("sym_int4", "asym_int4", "nf4", "fp4", "sym_int8",
+                 "q4_k", "q6_k")
 
 
 def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
@@ -44,11 +45,22 @@ def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
         return False
     out, kw_ = w.data.shape
     block = w.spec.block_size
+    if out % 128 != 0:
+        return False
     if w.qtype == "sym_int8":  # unpacked: K = data's last dim directly
-        if out % 128 != 0 or kw_ % block != 0:
+        if kw_ % block != 0:
             return False
-    # each half-split nibble plane must cover whole quant blocks
-    elif out % 128 != 0 or (kw_ * 2) % (2 * block) != 0:
+    elif w.qtype == "q6_k":  # unpacked; K tiles align to super-blocks
+        if kw_ % 256 != 0:
+            return False
+    elif w.qtype == "q4_k":
+        if (kw_ * 2) % 256 != 0:  # whole super-blocks per row
+            return False
+    # each half-split nibble plane must cover whole quant blocks; asym
+    # additionally needs an even per-plane block count for the scale views
+    elif (kw_ * 2) % (2 * block) != 0 or (
+        w.qtype == "asym_int4" and (kw_ * 2 // block) % 2 != 0
+    ):
         return False
     return _rows(x.shape) <= _GEMV_MAX_ROWS and use_pallas()
 
@@ -73,6 +85,28 @@ def linear(
             if w.qtype == "sym_int4":
                 y = qmatmul_int4(
                     x.astype(compute_dtype), w.data, w.scales,
+                    out_dtype=compute_dtype, block_o=block_o,
+                )
+            elif w.qtype == "asym_int4":
+                from bigdl_tpu.ops.pallas import qmatmul_asym_int4
+
+                y = qmatmul_asym_int4(
+                    x.astype(compute_dtype), w.data, w.scales, w.mins,
+                    out_dtype=compute_dtype, block_o=block_o,
+                )
+            elif w.qtype == "q4_k":
+                from bigdl_tpu.ops.pallas import qmatmul_q4k
+
+                y = qmatmul_q4k(
+                    x.astype(compute_dtype), w.data, w.scales, w.mins,
+                    w.sub_scales, w.sub_mins,
+                    out_dtype=compute_dtype, block_o=block_o,
+                )
+            elif w.qtype == "q6_k":
+                from bigdl_tpu.ops.pallas import qmatmul_q6k
+
+                y = qmatmul_q6k(
+                    x.astype(compute_dtype), w.data, w.scales, w.sub_scales,
                     out_dtype=compute_dtype, block_o=block_o,
                 )
             elif w.qtype == "sym_int8":

@@ -42,15 +42,20 @@ BLOCK = 32  # quant block (elements per scale) for sym_int4; nf4/fp4 use 64
 
 def _f16_bits_to_f32(bits):
     """uint16 float16 bit pattern -> f32, integer ops only (Mosaic has no
-    f16 vectors). Subnormal f16 scales flush to zero — a scale below
-    6.1e-5 only occurs for an all-zero weight block."""
+    f16 vectors). Subnormal f16 decodes exactly as sign * mant * 2^-24 —
+    NOT flushed: k-quant super-scales d = max|sub_scale|/127 routinely
+    land below 6.1e-5 for real checkpoint magnitudes (caught by the q6_k
+    kernel equivalence test: flushing zeroed whole super-blocks)."""
     b = bits.astype(jnp.int32)
     sign = (b >> 15) & 1
     exp = (b >> 10) & 0x1F
     mant = b & 0x3FF
     f32_bits = (sign << 31) | ((exp + 127 - 15) << 23) | (mant << 13)
     val = jax.lax.bitcast_convert_type(f32_bits, jnp.float32)
-    return jnp.where(exp == 0, 0.0, val)
+    sub = (1.0 - 2.0 * sign.astype(jnp.float32)) * (
+        mant.astype(jnp.float32) * jnp.float32(2.0 ** -24)
+    )
+    return jnp.where(exp == 0, sub, val)
 
 
 def _expand_scales(s, kh: int, base_block: int, block: int = BLOCK):
@@ -278,6 +283,122 @@ def qmatmul_int4(
                            block=BLOCK, codebook=None)
 
 
+def _expand_super(d, n_sub: int, offset_sub: int, per_super: int):
+    """[bo, nb_super] f32 super-scales -> [bo, n_sub] per-sub-block:
+    sub-block s (global index s + offset_sub) belongs to super-block
+    (s + offset_sub) // per_super. One-hot matmul (iota/compare/dot),
+    same Mosaic-safe expansion idiom as _expand_scales; the offset form
+    handles nibble planes that start mid-super-block (odd super-block
+    counts, e.g. llama2's K=11008 -> 43 blocks per row)."""
+    nb = d.shape[-1]
+    sel = (
+        (jax.lax.broadcasted_iota(jnp.int32, (nb, n_sub), 1) + offset_sub)
+        // per_super
+        == jax.lax.broadcasted_iota(jnp.int32, (nb, n_sub), 0)
+    ).astype(jnp.float32)
+    return jax.lax.dot_general(
+        d, sel, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _kernel_asym(xl_ref, xh_ref, w_ref, sl_ref, sh_ref, ml_ref, mh_ref,
+                 o_ref, *, kh: int, block: int):
+    """asym_int4 O-tile: w = q*d + m (q in 0..15, per-block f16 d/m,
+    mins stored as the raw block minimum — the `+ m` convention of
+    quant/numerics). Scales arrive pre-sliced per nibble plane, so the
+    one-hot expansion sel is (kh/block, kh) — half the full-row sel.
+    The four expansions (s/m x lo/hi) share that one sel via a single
+    stacked dot, keeping one sel materialization live."""
+    w = w_ref[:].astype(jnp.int32)
+    lo = (w & 0xF).astype(jnp.float32)
+    hi = (w >> 4).astype(jnp.float32)
+
+    stacked = jnp.concatenate(
+        [_f16_bits_to_f32(r[:]) for r in (sl_ref, ml_ref, sh_ref, mh_ref)],
+        axis=0,
+    )  # [4*bo, kh/block]
+    exp = _expand_scales(stacked, kh, 0, block)  # [4*bo, kh]
+    bo = w.shape[0]
+    s_lo, m_lo = exp[:bo], exp[bo:2 * bo]
+    s_hi, m_hi = exp[2 * bo:3 * bo], exp[3 * bo:]
+
+    wl = (lo * s_lo + m_lo).astype(jnp.bfloat16)
+    wh = (hi * s_hi + m_hi).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        xl_ref[:].astype(jnp.bfloat16), wl, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc += jax.lax.dot_general(
+        xh_ref[:].astype(jnp.bfloat16), wh, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def _kernel_q4k(xl_ref, xh_ref, w_ref, d_ref, dmin_ref, scl_ref, sch_ref,
+                mnl_ref, mnh_ref, o_ref, *, kh: int):
+    """q4_k O-tile: w = (d*sc)*q - (dmin*mn) per 32-element sub-block.
+    d/dmin are FULL per-super-block rows [bo, nb] (f16 bits) expanded
+    in-kernel with an offset one-hot — BlockSpec slicing them per plane
+    would need fractional offsets when nb is odd. sc/mn arrive pre-
+    sliced per plane ([bo, kh/32] uint8). All four per-element
+    expansions share one (kh/32, kh) sel via a stacked dot."""
+    w = w_ref[:].astype(jnp.int32)
+    lo = (w & 0xF).astype(jnp.float32)
+    hi = (w >> 4).astype(jnp.float32)
+
+    d32 = _f16_bits_to_f32(d_ref[:])  # [bo, nb]
+    dmin32 = _f16_bits_to_f32(dmin_ref[:])
+    n_sub = kh // 32  # sub-blocks per plane
+    per_super = 8  # 256-element super-block = 8 sub-blocks of 32
+    s_lo = _expand_super(d32, n_sub, 0, per_super) * (
+        scl_ref[:].astype(jnp.float32))
+    s_hi = _expand_super(d32, n_sub, n_sub, per_super) * (
+        sch_ref[:].astype(jnp.float32))
+    m_lo = _expand_super(dmin32, n_sub, 0, per_super) * (
+        mnl_ref[:].astype(jnp.float32))
+    m_hi = _expand_super(dmin32, n_sub, n_sub, per_super) * (
+        mnh_ref[:].astype(jnp.float32))
+
+    stacked = jnp.concatenate([s_lo, m_lo, s_hi, m_hi], axis=0)
+    exp = _expand_scales(stacked, kh, 0, 32)  # [4*bo, kh]
+    bo = w.shape[0]
+
+    wl = (lo * exp[:bo] - exp[bo:2 * bo]).astype(jnp.bfloat16)
+    wh = (hi * exp[2 * bo:3 * bo] - exp[3 * bo:]).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        xl_ref[:].astype(jnp.bfloat16), wl, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc += jax.lax.dot_general(
+        xh_ref[:].astype(jnp.bfloat16), wh, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def _kernel_q6k(x_ref, w_ref, d_ref, sc_ref, o_ref, *, block_k: int):
+    """One (O, K) tile of the q6_k GEMV, accumulating over the K grid
+    axis: w = (d*sc)*q per 16-element sub-block, codes already centered
+    int8. K tiles align to 256-element super-blocks so d needs no
+    offset; sel is (block_k/16, block_k), bounded by the K tile."""
+    w = w_ref[:].astype(jnp.float32)  # [bo, bk] int8 codes
+    d32 = _f16_bits_to_f32(d_ref[:])  # [bo, bk/256]
+    n_sub = block_k // 16
+    s_sub = _expand_super(d32, n_sub, 0, 16) * sc_ref[:].astype(jnp.float32)
+    wd = (w * _expand_scales(s_sub, block_k, 0, 16)).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        x_ref[:].astype(jnp.bfloat16), wd, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += acc.astype(o_ref.dtype)
+
+
 def qmatmul_codebook(
     x: jax.Array,  # [..., K]
     data: jax.Array,  # [O, K // 2] packed uint8 (half-split nibbles)
@@ -352,4 +473,265 @@ def _qmatmul_nibble(x, data, scales, out_dtype, block_o, interpret,
     xa = x2 if two_view else (x2[:, :kh], x2[:, kh:])
     y = _qmm(xa, data, s_bits, jnp.dtype(out_dtype), block_o, interpret,
              two_view, block, codebook)
+    return y[:M].reshape(*lead, O)
+
+
+# ---------------------------------------------------------------------------
+# asym_int4 / q4_k / q6_k fused GEMV (two-level scales, min terms)
+# ---------------------------------------------------------------------------
+
+def _gemv_prep(x, block_o: int, O: int, interpret):
+    """Shared wrapper plumbing: flatten/pad x rows, resolve interpret."""
+    from bigdl_tpu.ops.pallas import interpret_mode
+
+    if interpret is None:
+        interpret = interpret_mode()
+    *lead, K = x.shape
+    M = 1
+    for d in lead:
+        M *= d
+    Mp = round_up(max(M, 1), 8)
+    x2 = x.reshape(M, K)
+    if Mp != M:
+        x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
+    return x2, lead, M, K, min(block_o, O), interpret
+
+
+def _shrink_block_o(block_o: int, O: int, bytes_per_row: int,
+                    fixed_bytes: int, budget: int = 10 * 1024 * 1024) -> int:
+    """Largest power-of-two O tile whose VMEM model fits the scoped
+    budget (round-3 lesson: model VMEM explicitly — Mosaic overflows at
+    shapes the CPU interpreter happily accepts)."""
+    while block_o > 8 and (
+        block_o * bytes_per_row + fixed_bytes > budget or O % block_o
+    ):
+        block_o //= 2
+    assert O % block_o == 0, f"O={O} not divisible by block_o={block_o}"
+    return block_o
+
+
+def _f16_bits(a: jax.Array) -> jax.Array:
+    if a.dtype != jnp.float16:
+        a = a.astype(jnp.float16)
+    return jax.lax.bitcast_convert_type(a, jnp.uint16)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "interpret",
+                              "two_view", "block")
+)
+def _qmm_asym(x2, w, s_bits, m_bits, out_dtype, block_o: int,
+              interpret: bool, two_view: bool, block: int):
+    if two_view:
+        M, K = x2.shape
+        kh = K // 2
+        x_args = (x2, x2)
+        x_specs = [
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, kh), lambda o: (0, 1), memory_space=pltpu.VMEM),
+        ]
+    else:
+        xl, xh = x2
+        M, kh = xl.shape
+        x_args = (xl, xh)
+        x_specs = [
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+        ]
+    O = w.shape[0]
+    nbp = kh // block  # scale blocks per nibble plane
+    sm_specs = [
+        pl.BlockSpec((block_o, nbp), lambda o: (o, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_o, nbp), lambda o: (o, 1), memory_space=pltpu.VMEM),
+    ]
+    return pl.pallas_call(
+        functools.partial(_kernel_asym, kh=kh, block=block),
+        grid=(O // block_o,),
+        in_specs=x_specs + [
+            pl.BlockSpec((block_o, kh), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),
+            sm_specs[0], sm_specs[1],  # s lo/hi plane
+            pl.BlockSpec((block_o, nbp), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nbp), lambda o: (o, 1),
+                         memory_space=pltpu.VMEM),  # m lo/hi plane
+        ],
+        out_specs=pl.BlockSpec(
+            (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(*x_args, w, s_bits, s_bits, m_bits, m_bits)
+
+
+def qmatmul_asym_int4(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K // 2] packed uint8 (half-split)
+    scales: jax.Array,  # [O, K // 32] f16
+    mins: jax.Array,  # [O, K // 32] f16 (raw block minimum; w = q*d + m)
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused dequant-GEMV for asym_int4: the per-block min adds one
+    rank-1-per-block term, folded into the bf16 weight expansion before
+    the dot (same HBM story as sym_int4 + 0.5 bit/weight for mins)."""
+    O, kh = data.shape
+    x2, lead, M, K, block_o, interpret = _gemv_prep(x, block_o, O, interpret)
+    assert kh * 2 == K and K % (2 * BLOCK) == 0 and (K // BLOCK) % 2 == 0
+    sel_bytes = kh * kh // 8
+    block_o = _shrink_block_o(block_o, O, kh * 30, sel_bytes)
+    two_view = kh % 128 == 0
+    xa = x2 if two_view else (x2[:, :kh], x2[:, kh:])
+    y = _qmm_asym(xa, data, _f16_bits(scales), _f16_bits(mins),
+                  jnp.dtype(out_dtype), block_o, interpret, two_view, BLOCK)
+    return y[:M].reshape(*lead, O)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "interpret", "two_view")
+)
+def _qmm_q4k(x2, w, d_bits, dmin_bits, sc, mn, out_dtype, block_o: int,
+             interpret: bool, two_view: bool):
+    if two_view:
+        M, K = x2.shape
+        kh = K // 2
+        x_args = (x2, x2)
+        x_specs = [
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, kh), lambda o: (0, 1), memory_space=pltpu.VMEM),
+        ]
+    else:
+        xl, xh = x2
+        M, kh = xl.shape
+        x_args = (xl, xh)
+        x_specs = [
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+        ]
+    O, nb = d_bits.shape  # nb = K/256 super-blocks
+    nsp = kh // 32  # sub-blocks per plane
+    return pl.pallas_call(
+        functools.partial(_kernel_q4k, kh=kh),
+        grid=(O // block_o,),
+        in_specs=x_specs + [
+            pl.BlockSpec((block_o, kh), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nb), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),  # d (full row)
+            pl.BlockSpec((block_o, nb), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),  # dmin
+            pl.BlockSpec((block_o, nsp), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),  # sc lo plane
+            pl.BlockSpec((block_o, nsp), lambda o: (o, 1),
+                         memory_space=pltpu.VMEM),  # sc hi plane
+            pl.BlockSpec((block_o, nsp), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),  # mn lo
+            pl.BlockSpec((block_o, nsp), lambda o: (o, 1),
+                         memory_space=pltpu.VMEM),  # mn hi
+        ],
+        out_specs=pl.BlockSpec(
+            (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(*x_args, w, d_bits, dmin_bits, sc, sc, mn, mn)
+
+
+def qmatmul_q4k(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K // 2] packed uint8 (half-split)
+    scales: jax.Array,  # [O, K // 256] f16 super-scale d
+    mins: jax.Array,  # [O, K // 256] f16 super-scale dmin
+    sub_scales: jax.Array,  # [O, K // 32] uint8 6-bit sc
+    sub_mins: jax.Array,  # [O, K // 32] uint8 6-bit mn
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused dequant-GEMV for planar q4_k (quant/kq_planar.py):
+    w = (d*sc)*q - (dmin*mn). Weights cross HBM at 4.625 bits/weight —
+    the reference's recommended quality format (README ppl table) served
+    at sym_int4-class bandwidth instead of the 2.7x dequant fallback."""
+    O, kh = data.shape
+    x2, lead, M, K, block_o, interpret = _gemv_prep(x, block_o, O, interpret)
+    # whole super-blocks per row and whole 32-element sub-blocks per
+    # nibble plane; odd super-block counts are fine (offset expansion)
+    assert kh * 2 == K and K % 256 == 0
+    sel_bytes = kh * kh // 8
+    block_o = _shrink_block_o(block_o, O, kh * 30, sel_bytes)
+    two_view = kh % 128 == 0
+    xa = x2 if two_view else (x2[:, :kh], x2[:, kh:])
+    y = _qmm_q4k(xa, data, _f16_bits(scales), _f16_bits(mins),
+                 sub_scales, sub_mins, jnp.dtype(out_dtype), block_o,
+                 interpret, two_view)
+    return y[:M].reshape(*lead, O)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "block_k", "interpret")
+)
+def _qmm_q6k(x2, w, d_bits, sc, out_dtype, block_o: int, block_k: int,
+             interpret: bool):
+    M, K = x2.shape
+    O = w.shape[0]
+    return pl.pallas_call(
+        functools.partial(_kernel_q6k, block_k=block_k),
+        grid=(O // block_o, K // block_k),
+        in_specs=[
+            pl.BlockSpec((M, block_k), lambda o, k: (0, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, block_k), lambda o, k: (o, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, block_k // 256), lambda o, k: (o, k),
+                         memory_space=pltpu.VMEM),  # d
+            pl.BlockSpec((block_o, block_k // 16), lambda o, k: (o, k),
+                         memory_space=pltpu.VMEM),  # sc
+        ],
+        out_specs=pl.BlockSpec(
+            (M, block_o), lambda o, k: (0, o), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, O), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x2, w, d_bits, sc).astype(out_dtype)
+
+
+def qmatmul_q6k(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K] int8 centered codes
+    scales: jax.Array,  # [O, K // 256] f16 super-scale d
+    sub_scales: jax.Array,  # [O, K // 16] int8 sc
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused GEMV for planar q6_k: w = (d*sc)*q per 16-element
+    sub-block, K-tiled accumulation (K tiles align to super-blocks so
+    the super-scale expansion needs no offset)."""
+    O, Kw = data.shape
+    x2, lead, M, K, block_o, interpret = _gemv_prep(x, block_o, O, interpret)
+    assert Kw == K and K % 256 == 0
+
+    # K tile: largest multiple-of-256 divisor of K that keeps the
+    # (bk/16, bk) one-hot sel within budget (<= 4096); prime super-block
+    # counts (llama2's 11008 = 43 blocks) degrade to 256-wide tiles
+    block_k = 256
+    nb = K // 256
+    for t in range(nb, 0, -1):
+        if nb % t == 0 and t * 256 <= 4096:
+            block_k = t * 256
+            break
+    sel_bytes = block_k * block_k // 4
+    block_o = _shrink_block_o(block_o, O, block_k * 11, sel_bytes)
+    y = _qmm_q6k(x2, data, _f16_bits(scales), sub_scales,
+                 jnp.dtype(out_dtype), block_o, block_k, interpret)
     return y[:M].reshape(*lead, O)

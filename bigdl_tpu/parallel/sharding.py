@@ -226,12 +226,7 @@ def expand_specs_for_params(specs, params, wrap=lambda spec: spec):
     def expand(spec, param):
         if isinstance(param, QTensor):
             w = wrap(spec)
-            return QTensor(
-                data=w,
-                scales=w,
-                mins=None if param.mins is None else w,
-                qtype=param.qtype,
-            )
+            return param.map_arrays(lambda _: w)
         return wrap(spec)
 
     return jax.tree.map(

@@ -1,0 +1,134 @@
+"""Exact repack between llama.cpp k-quant super-block bytes and the
+TPU planar layout (numpy, host-side).
+
+llama.cpp's q4_K/q6_K byte layouts interleave codes, packed 6-bit
+sub-scales and fp16 super-scales inside 144/210-byte super-blocks — a
+CPU-SIMD artifact. A Pallas kernel cannot slice those byte offsets
+(Mosaic lane alignment), and XLA's in-graph byte decode materializes
+bf16 weights in HBM, measured 2.7x slower end-to-end (BENCH_NOTES r03).
+So on TPU a k-quant QTensor stores PLANES:
+
+  q4_k: data      [.., K/2]   uint8  half-split packed 4-bit codes
+        scales    [.., K/256] f16    super-scale d
+        mins      [.., K/256] f16    super-scale dmin
+        sub_scales[.., K/32]  uint8  6-bit sc (element-order sub-blocks)
+        sub_mins  [.., K/32]  uint8  6-bit mn
+        w[e] = (d*sc[e/32]) * q[e] - (dmin*mn[e/32])
+  q6_k: data      [.., K]     int8   codes (q-32, element order)
+        scales    [.., K/256] f16    super-scale d
+        sub_scales[.., K/16]  int8   sc
+        w[e] = (d*sc[e/16]) * q[e]
+
+The repack is pure integer/f16-view work — bit-exact both ways — and
+runs once at the GGUF import / encoder boundary (reference counterpart:
+the verbatim ggml byte carry in transformers/gguf/models/*.py of
+/root/reference, which XPU kernels can consume directly; TPU cannot).
+Dequantized values are identical to quant/kquants.dequant_* because
+f32(d)*f32(sc) is exact (11-bit x 6-bit mantissa) and evaluation order
+matches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+QK_K = 256
+
+
+def _f16_at(blocks: np.ndarray, off: int) -> np.ndarray:
+    """fp16 scalar at byte offset `off` of each super-block."""
+    return (
+        blocks[..., off:off + 2].copy().view(np.float16)[..., 0]
+    )
+
+
+def _unpack_q4k_scales_np(sc_raw: np.ndarray):
+    """12 packed bytes -> (sc [., 8], mn [., 8]) uint8 6-bit values
+    (llama.cpp get_scale_min_k4; numpy mirror of kquants jnp version)."""
+    sc = np.empty((*sc_raw.shape[:-1], 8), np.uint8)
+    mn = np.empty_like(sc)
+    for j in range(8):
+        if j < 4:
+            sc[..., j] = sc_raw[..., j] & 63
+            mn[..., j] = sc_raw[..., j + 4] & 63
+        else:
+            sc[..., j] = (sc_raw[..., j + 4] & 0xF) | (
+                (sc_raw[..., j - 4] >> 6) << 4
+            )
+            mn[..., j] = (sc_raw[..., j + 4] >> 4) | (
+                (sc_raw[..., j] >> 6) << 4
+            )
+    return sc, mn
+
+
+def q4k_codes(blocks: np.ndarray) -> np.ndarray:
+    """[.., n_sb, 144] -> element-order codes [.., n_sb, 256] uint8."""
+    qs = blocks[..., 16:144]
+    out = np.empty((*blocks.shape[:-1], QK_K), np.uint8)
+    for pair in range(4):
+        grp = qs[..., 32 * pair:32 * (pair + 1)]
+        out[..., 64 * pair:64 * pair + 32] = grp & 0xF
+        out[..., 64 * pair + 32:64 * pair + 64] = grp >> 4
+    return out
+
+
+def from_q4k_blocks(blocks: np.ndarray) -> dict:
+    """[.., n_sb, 144] super-block bytes -> planar QTensor fields."""
+    d = _f16_at(blocks, 0)  # [.., n_sb]
+    dmin = _f16_at(blocks, 2)
+    sc, mn = _unpack_q4k_scales_np(blocks[..., 4:16])  # [.., n_sb, 8]
+    codes = q4k_codes(blocks)
+
+    lead = blocks.shape[:-2]
+    k = blocks.shape[-2] * QK_K
+    codes = codes.reshape(*lead, k)
+    half = k // 2
+    data = codes[..., :half] | (codes[..., half:] << 4)
+    return dict(
+        data=data,
+        scales=d,
+        mins=dmin,
+        sub_scales=sc.reshape(*lead, k // 32),
+        sub_mins=mn.reshape(*lead, k // 32),
+    )
+
+
+def q6k_codes(blocks: np.ndarray) -> np.ndarray:
+    """[.., n_sb, 210] -> element-order centered codes [.., n_sb, 256]
+    int8 (q - 32 in [-32, 31])."""
+    ql = blocks[..., 0:128]
+    qh = blocks[..., 128:192]
+    out = np.empty((*blocks.shape[:-1], QK_K), np.int8)
+    for half in range(2):
+        l1 = ql[..., 64 * half:64 * half + 32]
+        l2 = ql[..., 64 * half + 32:64 * half + 64]
+        h = qh[..., 32 * half:32 * half + 32]
+        base = 128 * half
+        out[..., base:base + 32] = (
+            ((l1 & 0xF) | ((h & 3) << 4)).astype(np.int8) - 32
+        )
+        out[..., base + 32:base + 64] = (
+            ((l2 & 0xF) | (((h >> 2) & 3) << 4)).astype(np.int8) - 32
+        )
+        out[..., base + 64:base + 96] = (
+            ((l1 >> 4) | (((h >> 4) & 3) << 4)).astype(np.int8) - 32
+        )
+        out[..., base + 96:base + 128] = (
+            ((l2 >> 4) | (((h >> 6) & 3) << 4)).astype(np.int8) - 32
+        )
+    return out
+
+
+def from_q6k_blocks(blocks: np.ndarray) -> dict:
+    """[.., n_sb, 210] super-block bytes -> planar QTensor fields."""
+    d = _f16_at(blocks, 208)
+    sc = blocks[..., 192:208].view(np.int8)  # [.., n_sb, 16]
+    codes = q6k_codes(blocks)
+
+    lead = blocks.shape[:-2]
+    k = blocks.shape[-2] * QK_K
+    return dict(
+        data=codes.reshape(*lead, k),
+        scales=d,
+        sub_scales=np.ascontiguousarray(sc).reshape(*lead, k // 16),
+    )

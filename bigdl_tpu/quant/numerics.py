@@ -83,17 +83,30 @@ def _codebook_tables(qtype_name: str):
     return cb, order.astype(np.int32), boundaries
 
 
-def quantize_blockwise(x: jax.Array, spec: QTypeSpec):
-    """Quantize x along its last axis. Returns (data, scales, mins|None).
+def quantize_blockwise(x: jax.Array, spec: QTypeSpec) -> dict:
+    """Quantize x along its last axis. Returns a dict of QTensor array
+    fields: always data/scales (+ mins for asymmetric types, +
+    sub_scales/sub_mins for two-level k-quants).
 
-    scales/mins are float16 with shape [..., K // block_size], matching the
-    reference's half-precision block headers. K-quants (ggml_block storage)
-    encode on host (numpy) into the llama.cpp super-block byte layout; the
-    returned scales are the extracted per-super-block d (informational —
-    dequant reads everything from the block bytes).
+    Single-level scales/mins are float16 with shape [..., K //
+    block_size], matching the reference's half-precision block headers.
+    K-quants encode on host (numpy) through the llama.cpp codec
+    (quant/kquants.py) — q4_k/q6_k then repack into the TPU planar
+    layout (quant/kq_planar.py); q2/q3/q5_k keep the super-block bytes
+    and decode in-graph.
     """
     x = x.astype(jnp.float32)
     name = spec.name
+
+    if name in ("q4_k", "q6_k"):
+        from bigdl_tpu.quant import kq_planar, kquants
+
+        xh = np.asarray(x)  # host-side encode (ingest path)
+        if name == "q4_k":
+            fields = kq_planar.from_q4k_blocks(kquants.quantize_q4_k(xh))
+        else:
+            fields = kq_planar.from_q6k_blocks(kquants.quantize_q6_k(xh))
+        return {k: jnp.asarray(v) for k, v in fields.items()}
 
     if spec.storage == "ggml_block":
         from bigdl_tpu.quant import kquants
@@ -101,22 +114,21 @@ def quantize_blockwise(x: jax.Array, spec: QTypeSpec):
         xh = np.asarray(x)  # host-side encode (ingest path)
         _ENC = {
             "q2_k": kquants.quantize_q2_k, "q3_k": kquants.quantize_q3_k,
-            "q4_k": kquants.quantize_q4_k, "q5_k": kquants.quantize_q5_k,
-            "q6_k": kquants.quantize_q6_k,
+            "q5_k": kquants.quantize_q5_k,
         }
         if name not in _ENC:
             raise NotImplementedError(name)
         blocks = _ENC[name](xh)
         d_off = kquants.KQUANT_LAYOUT[name][1]
         d = blocks[..., d_off:d_off + 2].copy().view(np.float16)[..., 0]
-        return jnp.asarray(blocks), jnp.asarray(d), None
+        return dict(data=jnp.asarray(blocks), scales=jnp.asarray(d))
 
     if spec.storage.startswith("fp8"):
         xb = _blocked(x, spec.block_size)
         absmax = jnp.max(jnp.abs(xb), axis=-1)
         scale = absmax / _FP8_MAX[name]
         q = (xb * _safe_inv(scale)[..., None]).astype(_FP8_DTYPE[name])
-        return q.reshape(x.shape), scale.astype(jnp.float16), None
+        return dict(data=q.reshape(x.shape), scales=scale.astype(jnp.float16))
 
     xb = _blocked(x, spec.block_size)
 
@@ -133,40 +145,57 @@ def quantize_blockwise(x: jax.Array, spec: QTypeSpec):
             data = pack_nibbles(codes.astype(jnp.uint8))
         else:
             data = codes.astype(jnp.int8)
-        return data, scale.astype(jnp.float16), None
+        return dict(data=data, scales=scale.astype(jnp.float16))
 
     if name == "sym_int4":
         smax = _signed_absmax(xb)
         d = smax / -8.0
         q = jnp.clip(jnp.round(xb * _safe_inv(d)[..., None]) + 8.0, 0, 15)
         data = pack_nibbles(q.reshape(x.shape).astype(jnp.uint8))
-        return data, d.astype(jnp.float16), None
+        return dict(data=data, scales=d.astype(jnp.float16))
 
     if name == "asym_int4":
         mins = jnp.min(xb, axis=-1)
         d = (jnp.max(xb, axis=-1) - mins) / 15.0
         q = jnp.clip(jnp.round((xb - mins[..., None]) * _safe_inv(d)[..., None]), 0, 15)
         data = pack_nibbles(q.reshape(x.shape).astype(jnp.uint8))
-        return data, d.astype(jnp.float16), mins.astype(jnp.float16)
+        return dict(data=data, scales=d.astype(jnp.float16),
+                    mins=mins.astype(jnp.float16))
 
     if name == "sym_int5":
         smax = _signed_absmax(xb)
         d = smax / -16.0
         q = jnp.clip(jnp.round(xb * _safe_inv(d)[..., None]) + 16.0, 0, 31)
-        return q.reshape(x.shape).astype(jnp.int8), d.astype(jnp.float16), None
+        return dict(data=q.reshape(x.shape).astype(jnp.int8),
+                    scales=d.astype(jnp.float16))
 
     if name == "asym_int5":
         mins = jnp.min(xb, axis=-1)
         d = (jnp.max(xb, axis=-1) - mins) / 31.0
         q = jnp.clip(jnp.round((xb - mins[..., None]) * _safe_inv(d)[..., None]), 0, 31)
-        return q.reshape(x.shape).astype(jnp.int8), d.astype(jnp.float16), mins.astype(jnp.float16)
+        return dict(data=q.reshape(x.shape).astype(jnp.int8),
+                    scales=d.astype(jnp.float16), mins=mins.astype(jnp.float16))
 
     if name == "sym_int8":
         d = jnp.max(jnp.abs(xb), axis=-1) / 127.0
         q = jnp.clip(jnp.round(xb * _safe_inv(d)[..., None]), -127, 127)
-        return q.reshape(x.shape).astype(jnp.int8), d.astype(jnp.float16), None
+        return dict(data=q.reshape(x.shape).astype(jnp.int8),
+                    scales=d.astype(jnp.float16))
 
     raise NotImplementedError(f"quantize: qtype {name}")
+
+
+def kq_effective_scales(
+    scales: jax.Array,  # f16 super-scales d [..., K/superblock]
+    sub_scales: jax.Array,  # integer sub-scales [..., K/block_size]
+) -> jax.Array:
+    """Per-sub-block f32 effective scale d*sc of a planar k-quant.
+    Exact: f16 (11-bit mantissa) x <=8-bit integer fits f32."""
+    reps = sub_scales.shape[-1] // scales.shape[-1]
+    return (
+        jnp.repeat(scales.astype(jnp.float32), reps, axis=-1)
+        * sub_scales.astype(jnp.float32)
+    )
 
 
 def dequantize_blockwise(
@@ -175,17 +204,35 @@ def dequantize_blockwise(
     mins: jax.Array | None,
     spec: QTypeSpec,
     dtype=jnp.float32,
+    sub_scales: jax.Array | None = None,
+    sub_mins: jax.Array | None = None,
 ) -> jax.Array:
     """Inverse of quantize_blockwise; returns [..., K] in `dtype`."""
     name = spec.name
+
+    if name == "q4_k":
+        # planar two-level asym: w = (d*sc)*q - (dmin*mn); matches
+        # kquants.dequant_q4_k bit-for-bit (f32, same grouping)
+        codes = unpack_nibbles(data).astype(jnp.float32)
+        s = kq_effective_scales(scales, sub_scales)
+        m = kq_effective_scales(mins, sub_mins)
+        vb = _blocked(codes, spec.block_size)
+        y = vb * s[..., None] - m[..., None]
+        return y.reshape(codes.shape).astype(dtype)
+
+    if name == "q6_k":
+        # planar two-level sym: w = (d*sc)*q, codes already centered
+        s = kq_effective_scales(scales, sub_scales)
+        vb = _blocked(data.astype(jnp.float32), spec.block_size)
+        y = vb * s[..., None]
+        return y.reshape(data.shape).astype(dtype)
 
     if spec.storage == "ggml_block":
         from bigdl_tpu.quant import kquants
 
         _DEC = {
             "q2_k": kquants.dequant_q2_k, "q3_k": kquants.dequant_q3_k,
-            "q4_k": kquants.dequant_q4_k, "q5_k": kquants.dequant_q5_k,
-            "q6_k": kquants.dequant_q6_k,
+            "q5_k": kquants.dequant_q5_k,
         }
         if name not in _DEC:
             raise NotImplementedError(name)

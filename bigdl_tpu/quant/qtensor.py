@@ -26,13 +26,20 @@ from bigdl_tpu.quant.numerics import dequantize_blockwise, quantize_blockwise
 from bigdl_tpu.quant.qtypes import QTypeSpec, resolve_qtype
 
 
+# array fields of a QTensor, in declaration order; sub_scales/sub_mins
+# carry the integer sub-block scales of two-level (k-quant) formats
+ARRAY_FIELDS = ("data", "scales", "mins", "sub_scales", "sub_mins")
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class QTensor:
     data: jax.Array
     scales: jax.Array
-    mins: Optional[jax.Array]
-    qtype: str = dataclasses.field(metadata=dict(static=True))
+    mins: Optional[jax.Array] = None
+    qtype: str = dataclasses.field(metadata=dict(static=True), kw_only=True)
+    sub_scales: Optional[jax.Array] = None
+    sub_mins: Optional[jax.Array] = None
 
     @property
     def spec(self) -> QTypeSpec:
@@ -45,7 +52,7 @@ class QTensor:
             return (*self.data.shape[:-1], self.data.shape[-1] * 2)
         if spec.storage == "ggml_block":
             # data [..., n_superblocks, block_bytes]
-            return (*self.data.shape[:-2], self.data.shape[-2] * spec.block_size)
+            return (*self.data.shape[:-2], self.data.shape[-2] * spec.superblock)
         return tuple(self.data.shape)
 
     @property
@@ -53,14 +60,39 @@ class QTensor:
         return self.data.ndim
 
     def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
-        return dequantize_blockwise(self.data, self.scales, self.mins, self.spec, dtype)
+        return dequantize_blockwise(
+            self.data, self.scales, self.mins, self.spec, dtype,
+            sub_scales=self.sub_scales, sub_mins=self.sub_mins,
+        )
+
+    def map_arrays(self, fn) -> "QTensor":
+        """New QTensor with `fn` applied to every non-None array field —
+        the one place slice/stack/concat/shard rebuilds go through, so
+        field additions don't scatter across call sites."""
+        kw = {
+            f: (None if getattr(self, f) is None else fn(getattr(self, f)))
+            for f in ARRAY_FIELDS
+        }
+        return QTensor(qtype=self.qtype, **kw)
 
     def nbytes(self) -> int:
-        n = self.data.size * self.data.dtype.itemsize
-        n += self.scales.size * self.scales.dtype.itemsize
-        if self.mins is not None:
-            n += self.mins.size * self.mins.dtype.itemsize
+        n = 0
+        for f in ARRAY_FIELDS:
+            v = getattr(self, f)
+            if v is not None:
+                n += v.size * v.dtype.itemsize
         return n
+
+
+def map_arrays_multi(ws: list["QTensor"], fn) -> "QTensor":
+    """Combine several same-qtype QTensors field-wise (stack/concat):
+    `fn` receives the list of arrays for each non-None field."""
+    kw = {
+        f: (None if getattr(ws[0], f) is None
+            else fn([getattr(w, f) for w in ws]))
+        for f in ARRAY_FIELDS
+    }
+    return QTensor(qtype=ws[0].qtype, **kw)
 
 
 # k-quant fallbacks for tensors whose contraction dim is not a multiple
@@ -81,11 +113,11 @@ def quantize(x: jax.Array, qtype: str) -> QTensor:
     spec = resolve_qtype(qtype)
     if spec.is_dense:
         raise ValueError(f"qtype {qtype} is dense; keep the array as-is")
-    if (spec.storage == "ggml_block" and x.shape[-1] % spec.block_size
+    if (spec.superblock and x.shape[-1] % spec.superblock
             and spec.name in _KQUANT_FALLBACK):
         spec = resolve_qtype(_KQUANT_FALLBACK[spec.name])
-    data, scales, mins = quantize_blockwise(x, spec)
-    return QTensor(data=data, scales=scales, mins=mins, qtype=spec.name)
+    fields = quantize_blockwise(x, spec)
+    return QTensor(qtype=spec.name, **fields)
 
 
 def dequantize(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:

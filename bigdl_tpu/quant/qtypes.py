@@ -90,6 +90,11 @@ class QTypeSpec:
     # llama.cpp byte layout (data [.., n_sb, block_bytes] uint8).
     # dense == not quantized (fp16/bf16 passthrough kept as plain arrays)
     block_bytes: int = 0  # ggml_block: bytes per super-block
+    # two-level (super-block) scale factorization: the contraction axis
+    # must be a multiple of this at encode time, and QTensor carries
+    # per-super-block f16 scales (d, dmin) in scales/mins plus integer
+    # sub-scales in sub_scales/sub_mins. 0 = single-level scales.
+    superblock: int = 0
 
     @property
     def is_dense(self) -> bool:
@@ -121,14 +126,18 @@ FP4 = _register(QTypeSpec("fp4", bits=4, block_size=64, codebook=FP4_CODEBOOK))
 FP6 = _register(QTypeSpec("fp6", bits=6, block_size=64, codebook=FP6_CODEBOOK, storage="int8"))
 FP8_E4M3 = _register(QTypeSpec("fp8_e4m3", bits=8, block_size=128, storage="fp8_e4m3"))
 FP8_E5M2 = _register(QTypeSpec("fp8_e5m2", bits=8, block_size=128, storage="fp8_e5m2"))
-# k-quants: 256-element super-blocks in the llama.cpp byte layout
-# (two-level scales; ggml q4_K = 4.5 bit/weight, q6_K = 6.5625), kept
-# byte-compatible so GGUF k-quant tensors repack without dequantization.
-# KQUANT_LAYOUT is the single source of truth for the byte layouts:
-# name -> (block_bytes, byte offset of the fp16 super-scale d). Consumed
-# by quant/kquants.py (codecs), quant/numerics.py (encode) and
-# convert/gguf.py (_BLOCK sizes + verbatim repack); the QTypeSpec
-# block_bytes below are checked against it at import.
+# k-quants: 256-element super-blocks with two-level scales (ggml q4_K =
+# 4.5 bit/weight, q6_K = 6.5625). llama.cpp's interleaved byte layout is
+# a CPU-SIMD artifact; on TPU, q4_k and q6_k live in a PLANAR layout the
+# Pallas fused GEMV can read (half-split nibble / int8 code planes +
+# factored super-scales — see quant/kq_planar.py), with the exact
+# byte-level repack done once at the GGUF / encoder boundary. q2/q3/q5_k
+# (rarely-deployed formats) still store raw super-block bytes
+# (storage="ggml_block") and decode in-graph.
+# KQUANT_LAYOUT is the single source of truth for the on-disk byte
+# layouts: name -> (block_bytes, byte offset of the fp16 super-scale d).
+# Consumed by quant/kquants.py (codecs), quant/kq_planar.py (repack),
+# quant/numerics.py (encode) and convert/gguf.py (_BLOCK sizes).
 KQUANT_LAYOUT = {
     "q2_k": (84, 80),
     "q3_k": (110, 108),
@@ -138,21 +147,32 @@ KQUANT_LAYOUT = {
 }
 Q2_K = _register(QTypeSpec(
     "q2_k", bits=2, block_size=256, storage="ggml_block", block_bytes=84,
-    asymmetric=True,
+    asymmetric=True, superblock=256,
 ))
 Q3_K = _register(QTypeSpec(
     "q3_k", bits=3, block_size=256, storage="ggml_block", block_bytes=110,
+    superblock=256,
 ))
+# q4_k planar: data = half-split packed nibbles [.., K/2] (codes 0..15),
+# scales = d f16 [.., K/256], mins = dmin f16 [.., K/256], sub_scales =
+# 6-bit sc u8 [.., K/32], sub_mins = 6-bit mn u8 [.., K/32];
+# w = (d*sc)*q - (dmin*mn), per 32-element sub-block. 4.625 bit/weight.
 Q4_K = _register(QTypeSpec(
-    "q4_k", bits=4, block_size=256, storage="ggml_block", block_bytes=144,
-    asymmetric=True,
+    "q4_k", bits=4, block_size=32, storage="packed_u8", block_bytes=144,
+    asymmetric=True, superblock=256,
 ))
 Q5_K = _register(QTypeSpec(
     "q5_k", bits=5, block_size=256, storage="ggml_block", block_bytes=176,
-    asymmetric=True,
+    asymmetric=True, superblock=256,
 ))
+# q6_k planar: data = int8 codes (q-32) [.., K], scales = d f16
+# [.., K/256], sub_scales = int8 sc [.., K/16]; w = (d*sc)*q per
+# 16-element sub-block. 8.56 bit/weight (vs ggml's packed 6.56 — int8
+# code planes keep Mosaic lane alignment for every K; a 4+2-bit packed
+# plane needs K%1024 alignment llama2's 11008 lacks).
 Q6_K = _register(QTypeSpec(
-    "q6_k", bits=6, block_size=256, storage="ggml_block", block_bytes=210,
+    "q6_k", bits=6, block_size=16, storage="int8", block_bytes=210,
+    superblock=256,
 ))
 FP16 = _register(QTypeSpec("fp16", bits=16, block_size=1, storage="dense"))
 BF16 = _register(QTypeSpec("bf16", bits=16, block_size=1, storage="dense"))

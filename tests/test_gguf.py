@@ -169,12 +169,11 @@ def test_repack_bit_exact(tmp_path, rng, ggml_type):
     p = str(tmp_path / "t.gguf")
     write_gguf(p, {"general.architecture": "llama"}, {"w": (x, ggml_type)})
     r = G.GGUFReader(p)
-    data, scales, mins, our_q = G.repack_to_qtensor(r.raw_blocks("w"), ggml_type)
+    fields, our_q = G.repack_to_qtensor(r.raw_blocks("w"), ggml_type)
     from bigdl_tpu.quant import QTensor
 
     qt = QTensor(
-        data=jnp.asarray(data), scales=jnp.asarray(scales),
-        mins=None if mins is None else jnp.asarray(mins), qtype=our_q,
+        qtype=our_q, **{k: jnp.asarray(v) for k, v in fields.items()}
     )
     np.testing.assert_allclose(
         np.asarray(qt.dequantize(jnp.float32)), r.dequantize("w"),

@@ -58,10 +58,12 @@ def test_merged_with_attention_bias():
     assert "bqkv" in m["layers"] and "bq" not in m["layers"]
 
 
-def test_kquant_formats_stay_split():
-    """ggml super-block storage can't concat on the O axis — merging must
-    be a silent no-op, not a crash. (Needs dims >= 256 so q4_k actually
-    applies instead of falling back to sym_int4.)"""
+def test_kquant_merge_behavior():
+    """Planar q4_k (codes + factored scales, all O-leading) merges into
+    fused qkv like sym_int4 — one of the planar layout's wins over raw
+    ggml super-blocks. q5_k still stores super-block bytes with a
+    trailing byte axis, so merging stays a silent no-op there. (Dims
+    >= 256 so the k-quants apply instead of falling back.)"""
     cfg = ModelConfig(
         vocab_size=64, hidden_size=256, intermediate_size=256,
         num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
@@ -71,10 +73,14 @@ def test_kquant_formats_stay_split():
     split = optimize_model(dense, cfg, "q4_k", merge_fused=False)
     merged = optimize_model(dense, cfg, "q4_k", merge_fused=True)
     assert split["layers"]["wq"].qtype == "q4_k"
-    assert "wq" in merged["layers"] and "wqkv" not in merged["layers"]
+    assert "wqkv" in merged["layers"] and "wq" not in merged["layers"]
+    assert merged["layers"]["wqkv"].qtype == "q4_k"
     a = TpuModel(cfg, split, "q4_k").generate(PROMPTS, max_new_tokens=8)
     b = TpuModel(cfg, merged, "q4_k").generate(PROMPTS, max_new_tokens=8)
     np.testing.assert_array_equal(a, b)
+
+    ggml = optimize_model(dense, cfg, "q5_k", merge_fused=True)
+    assert "wq" in ggml["layers"] and "wqkv" not in ggml["layers"]
 
 
 def test_merged_under_tp_mesh():
