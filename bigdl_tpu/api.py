@@ -356,6 +356,32 @@ class TpuModel:
             max_ngram=max_ngram, **kw,
         )
 
+    def self_draft_params(self):
+        """The sym_int4 self-draft of this model's weights (the
+        reference's self-speculative draft, model.py:366-379), built once
+        and cached. Only meaningful when the model holds higher-precision
+        weights — a draft equal to the target is all cost, no speedup."""
+        from bigdl_tpu.quant.qtypes import resolve_qtype
+
+        try:
+            is_dense = resolve_qtype(self.qtype).is_dense
+        except ValueError:  # e.g. "gguf_native" mixed trees
+            is_dense = False
+        if not is_dense:
+            # re-quantizing already-quantized weights is a no-op
+            # (quantize_params skips QTensor leaves) — the "draft" would
+            # be weight-identical to the target: all cost, no speedup.
+            raise ValueError(
+                f"model qtype {self.qtype!r} is already quantized; a "
+                "sym_int4 self-draft would equal the target. Pass "
+                "explicit draft_params or load the target as fp16/bf16."
+            )
+        draft_params = getattr(self, "_draft_params", None)
+        if draft_params is None:
+            draft_params = optimize_model(self.params, self.config, "sym_int4")
+            object.__setattr__(self, "_draft_params", draft_params)
+        return draft_params
+
     def generate_speculative(
         self,
         prompts,
@@ -379,25 +405,7 @@ class TpuModel:
             )
 
         if draft_params is None:
-            from bigdl_tpu.quant.qtypes import resolve_qtype
-
-            try:
-                is_dense = resolve_qtype(self.qtype).is_dense
-            except ValueError:  # e.g. "gguf_native" mixed trees
-                is_dense = False
-            if not is_dense:
-                # re-quantizing already-quantized weights is a no-op
-                # (quantize_params skips QTensor leaves) — the "draft" would
-                # be weight-identical to the target: all cost, no speedup.
-                raise ValueError(
-                    f"model qtype {self.qtype!r} is already quantized; a "
-                    "sym_int4 self-draft would equal the target. Pass "
-                    "explicit draft_params or load the target as fp16/bf16."
-                )
-            draft_params = getattr(self, "_draft_params", None)
-            if draft_params is None:
-                draft_params = optimize_model(self.params, self.config, "sym_int4")
-                object.__setattr__(self, "_draft_params", draft_params)
+            draft_params = self.self_draft_params()
         return speculative_generate(
             self.config, self.params, draft_params, prompts,
             self.family.forward, max_new_tokens=max_new_tokens,

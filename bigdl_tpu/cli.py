@@ -155,6 +155,26 @@ def cmd_serve(args):
 
     from bigdl_tpu.generate import GenerationConfig
 
+    if args.speculative:
+        # the sym_int4 self-draft needs a higher-precision target; fail
+        # fast BEFORE the (slow) model load, and default the target to
+        # bf16 when no qtype was asked for
+        if args.qtype is None:
+            print("--speculative: loading target as bf16 (self-draft is "
+                  "sym_int4); pass -q to override")
+            args.qtype = "bf16"
+        else:
+            from bigdl_tpu.quant.qtypes import resolve_qtype
+
+            try:
+                dense = resolve_qtype(args.qtype).is_dense
+            except ValueError:
+                dense = False
+            if not dense:
+                raise SystemExit(
+                    f"--speculative needs an unquantized target "
+                    f"(-q bf16/fp16); got -q {args.qtype}"
+                )
     model = _load(args.model, args.qtype)
     tok = _tokenizer(args.model)
     gen = GenerationConfig(
@@ -163,7 +183,8 @@ def cmd_serve(args):
     server = ApiServer(
         model, tokenizer=tok, host=args.host,
         port=args.port, n_slots=args.slots, max_len=args.max_len, gen=gen,
-        paged=args.paged,
+        paged=args.paged, speculative=args.speculative,
+        draft_k=args.draft_k,
     )
     server.start()
     print(f"bigdl-tpu serving {args.model} on {args.host}:{server.port}")
@@ -236,6 +257,10 @@ def main(argv=None):
     s.add_argument("--port", type=int, default=8000)
     s.add_argument("--slots", type=int, default=8)
     s.add_argument("--max-len", type=int, default=2048)
+    s.add_argument("--speculative", action="store_true",
+                   help="in-engine speculative decoding (sym_int4 "
+                        "self-draft; needs an unquantized model load)")
+    s.add_argument("--draft-k", type=int, default=4)
     s.add_argument("--paged", action="store_true",
                    help="paged KV pool + prefix caching")
     s.set_defaults(fn=cmd_serve)

@@ -114,12 +114,17 @@ class ApiServer:
         paged: bool = False,  # paged KV pool + prefix caching (kvpaged.py)
         page_size: int = 64,
         n_pages=None,
+        speculative: bool = False,  # in-engine draft-K-then-verify
+        draft_params=None,  # None = sym_int4 self-draft of the model
+        draft_k: int = 4,
     ):
         from bigdl_tpu.serving.metrics import Metrics
 
         self.engine = InferenceEngine(
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, page_size=page_size, n_pages=n_pages,
+            speculative=speculative, draft_params=draft_params,
+            draft_k=draft_k,
         )
         self.tokenizer = tokenizer
         self.whisper = whisper

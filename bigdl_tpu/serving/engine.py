@@ -86,6 +86,9 @@ class InferenceEngine:
         paged: bool = False,
         page_size: int = 64,
         n_pages: Optional[int] = None,
+        speculative: bool = False,
+        draft_params=None,
+        draft_k: int = 4,
     ):
         self.model = model
         self.config: ModelConfig = model.config
@@ -185,6 +188,51 @@ class InferenceEngine:
             functools.partial(self._paged_prefill_impl, fwd),
             donate_argnames=("k", "v"),
         ))
+        # --- in-engine speculative decoding (reference serves it through
+        # ipex_llm_worker.py:72-99; SURVEY §7 names "continuous batching +
+        # speculative interaction" a hard part). Slot-pool design: a
+        # SECOND KV pool for the draft model, a scan of per-row greedy
+        # draft steps, then ONE batched verify forward over the shared
+        # target pool; per-row `pos` makes per-slot acceptance rollback a
+        # vector subtraction. Greedy slots emit the target's greedy
+        # tokens — byte-identical to non-speculative serving; sampling /
+        # repetition-penalty slots ride along accepting 0 drafts (their
+        # position-0 token is the regular sampler's).
+        self.speculative = speculative
+        self.draft_k = draft_k
+        self.dcache = None
+        self._draft_params = draft_params
+        if speculative:
+            if draft_k < 2:
+                # K-1 draft tokens are verifiable; K=1 would pay a draft
+                # forward whose token can never be accepted
+                raise ValueError(f"draft_k must be >= 2, got {draft_k}")
+            if paged:
+                raise NotImplementedError(
+                    "speculative serving writes draft KV through a dense "
+                    "pool; use paged=False"
+                )
+            if self._family_cache is not None:
+                raise NotImplementedError(
+                    f"speculative serving needs the standard KV pool; "
+                    f"{model.config.model_type} has a family cache"
+                )
+            if self._mesh is not None and "pp" in self._mesh.axis_names and (
+                self._mesh.shape["pp"] > 1
+            ):
+                raise NotImplementedError(
+                    "speculative serving under pipeline parallelism is not "
+                    "wired; use a tp/dp mesh"
+                )
+            if draft_params is None:
+                self._draft_params = model.self_draft_params()
+            self.dcache = self._make_pool()
+            self._spec_decode = self._with_mesh(jax.jit(
+                functools.partial(self._spec_decode_impl, fwd),
+                donate_argnames=("cache", "dcache", "seen"),
+            ))
+            self.spec_rounds = 0  # verify rounds run
+            self.spec_emitted = 0  # tokens emitted by those rounds
         self._waiting: Optional[Request] = None  # paged OOM retry slot
         # rids whose client went away (stop-string hit, disconnect):
         # handler threads add, the engine thread frees the slot at the
@@ -329,6 +377,64 @@ class InferenceEngine:
         nxt = sample_token_per_row(step, key, temp, topk, topp, dosample)
         seen = seen.at[jnp.arange(seen.shape[0]), nxt].set(True)
         return nxt, cache, seen
+
+    def _spec_decode_impl(self, forward, params, dparams, cur, cache, dcache,
+                          key, temp, topk, topp, dosample, seen, penalty):
+        """One speculative round for the whole slot pool. Returns
+        (choice [B, K], n_acc [B], cur' [B], cache, dcache, seen):
+        slot b emits choice[b, :n_acc[b]+1].
+
+        Cache discipline (decode/speculative.py's crop, per-row): the
+        draft scan advances dcache.pos by K and the verify forward
+        advances cache.pos by K; both roll back to pos + n_acc + 1 — a
+        vector op thanks to per-row positions. Entries above pos hold
+        stale drafts that are masked out and overwritten next round.
+        Acceptance caps at K-1 because the draft pool only holds KV for
+        cur, d0..d_{K-2}."""
+        from bigdl_tpu.generate import apply_repetition_penalty
+
+        cfg = self.config
+        K = self.draft_k
+
+        def draft_step(carry, _):
+            tok, dc = carry
+            lg, dc = forward(cfg, dparams, tok[:, None], dc, mode="decode")
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            return (nxt, dc), nxt
+
+        (_, dcache), drafts = jax.lax.scan(
+            draft_step, (cur, dcache), None, length=K
+        )
+        drafts = jnp.swapaxes(drafts, 0, 1)  # [B, K]
+
+        verify_in = jnp.concatenate([cur[:, None], drafts[:, :K - 1]], axis=1)
+        tlogits, cache = forward(cfg, params, verify_in, cache, mode="prefill")
+        tlogits = tlogits.astype(jnp.float32)
+        greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # [B, K]
+
+        # sampling / penalty slots take the regular sampler's token at
+        # position 0 and accept nothing — output distribution unchanged
+        first = tlogits[:, 0]
+        step0 = jax.lax.cond(
+            jnp.any(penalty != 1.0),
+            lambda: apply_repetition_penalty(first, seen, penalty),
+            lambda: first,
+        )
+        samp0 = sample_token_per_row(step0, key, temp, topk, topp, dosample)
+        spec_row = ~dosample & (penalty == 1.0)
+        choice = greedy.at[:, 0].set(
+            jnp.where(spec_row, greedy[:, 0], samp0)
+        )
+        match = (drafts[:, :K - 1] == choice[:, :K - 1]) & spec_row[:, None]
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        cur2 = jnp.take_along_axis(choice, n_acc[:, None], axis=1)[:, 0]
+
+        cache = dataclasses.replace(cache, pos=cache.pos - K + n_acc + 1)
+        dcache = dataclasses.replace(dcache, pos=dcache.pos - K + n_acc + 1)
+        rows = jnp.arange(seen.shape[0])
+        # penalty rows emit exactly cur2; spec rows don't read `seen`
+        seen = seen.at[rows, cur2].set(True)
+        return choice, n_acc, cur2, cache, dcache, seen
 
     # ---- host API ---------------------------------------------------------
 
@@ -603,6 +709,14 @@ class InferenceEngine:
         self.cache = self._insert(
             self.cache, pcache, jnp.asarray(slot), jnp.asarray(pad)
         )
+        if self.speculative:
+            _, dpcache = self._prefill(
+                self._draft_params, jnp.asarray(tokens),
+                jnp.asarray([pad], jnp.int32), bucket=bucket,
+            )
+            self.dcache = self._insert(
+                self.dcache, dpcache, jnp.asarray(slot), jnp.asarray(pad)
+            )
         self._activate(slot, req, logits_last)
 
     def _admit(self) -> None:
@@ -651,6 +765,8 @@ class InferenceEngine:
         """Rebuild the (possibly donated-away) cache after a failed decode
         so the engine can keep serving new requests."""
         self.cache = self._make_pool()
+        if self.speculative:
+            self.dcache = self._make_pool()
         self.cur = jnp.zeros((self.n_slots,), jnp.int32)
         self.seen = jnp.zeros(
             (self.n_slots, self.config.vocab_size), jnp.bool_
@@ -696,6 +812,8 @@ class InferenceEngine:
         if not self.active.any():
             return not self._queue.empty() or self._waiting is not None
         self._rng, k = jax.random.split(self._rng)
+        if self.speculative:
+            return self._step_speculative(k)
         try:
             nxt, self.cache, self.seen = self._decode(
                 self.model.params, self.cur, self.cache, k,
@@ -717,6 +835,38 @@ class InferenceEngine:
             if self.paged:
                 self._slot_pos[int(i)] += 1
             self._emit(int(i), int(toks[i]))
+        return True
+
+    def _step_speculative(self, k) -> bool:
+        """Draft-K-then-verify round: each live slot emits 1..draft_k
+        tokens (its accepted prefix + the target's bonus token)."""
+        try:
+            choice, n_acc, cur2, self.cache, self.dcache, self.seen = (
+                self._spec_decode(
+                    self.model.params, self._draft_params, self.cur,
+                    self.cache, self.dcache, k,
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._dosample),
+                    self.seen, jnp.asarray(self._penalty),
+                )
+            )
+        except Exception:
+            self.fail_all("speculative decode step failed")
+            self._reset_state()
+            raise
+        self.cur = cur2
+        choice_h = np.asarray(choice)
+        n_acc_h = np.asarray(n_acc)
+        self.spec_rounds += 1
+        for i in np.nonzero(self.active)[0]:
+            i = int(i)
+            s = self._slots[i]
+            for t in range(int(n_acc_h[i]) + 1):
+                s.remaining -= 1
+                self.spec_emitted += 1
+                self._emit(i, int(choice_h[i, t]))
+                if not self.active[i]:  # EOS or budget hit mid-round
+                    break
         return True
 
     def _fail_request(self, req: Request, msg: str) -> None:
