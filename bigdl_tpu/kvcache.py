@@ -103,6 +103,30 @@ def init_cache(
     )
 
 
+def insert_row(cache: KVCache, pcache: KVCache, slot, pad) -> KVCache:
+    """Copy a 1-row prefill cache into row `slot` of a per-row-pos pool:
+    k/v (and fp8 scales when quantized) land at slots [0, bucket); the
+    row's pos/start become (bucket, pad). Shared by the serving engine's
+    dense insert and the family engine_insert adapters (yuan/mllama)."""
+    import dataclasses
+
+    bucket = pcache.k.shape[2]
+    upd = dict(
+        k=jax.lax.dynamic_update_slice(cache.k, pcache.k, (0, slot, 0, 0, 0)),
+        v=jax.lax.dynamic_update_slice(cache.v, pcache.v, (0, slot, 0, 0, 0)),
+        pos=cache.pos.at[slot].set(bucket),
+        start=cache.start.at[slot].set(pad),
+    )
+    if cache.k_scale is not None:
+        upd["k_scale"] = jax.lax.dynamic_update_slice(
+            cache.k_scale, pcache.k_scale, (0, slot, 0, 0)
+        )
+        upd["v_scale"] = jax.lax.dynamic_update_slice(
+            cache.v_scale, pcache.v_scale, (0, slot, 0, 0)
+        )
+    return dataclasses.replace(cache, **upd)
+
+
 def _quantize_heads(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """[B,T,H,D] -> (fp8 codes, [B,T,H] f16 scales); per-vector absmax."""
     absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)

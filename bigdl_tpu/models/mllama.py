@@ -100,6 +100,25 @@ def init_cache(
                        cross_live=None, start=kv.start)
 
 
+# --- serving-engine adapter (serving/engine.py custom-cache protocol):
+# text-only serving — the pool has no cross state (ck=None) and the
+# engine's prefill builds text-only caches, so cross layers skip and the
+# decoder is llama3. Image requests go through TpuModel.generate.
+
+def engine_pool(config: ModelConfig, n_slots: int, max_len: int):
+    cache = init_cache(config, n_slots, max_len)
+    kv = dataclasses.replace(cache.kv, pos=jnp.zeros((n_slots,), jnp.int32))
+    return dataclasses.replace(cache, kv=kv)
+
+
+def engine_insert(cache, pcache, slot, pad):
+    assert pcache.ck is None, (
+        "engine serving is text-only for mllama; use generate() for images"
+    )
+    kv = kvcache.insert_row(cache.kv, pcache.kv, slot, pad)
+    return dataclasses.replace(cache, kv=kv, start=kv.start)
+
+
 def init_params(
     config: ModelConfig,
     key: jax.Array,

@@ -103,6 +103,27 @@ def init_cache(
     )
 
 
+# --- serving-engine adapter (serving/engine.py custom-cache protocol) ---
+# RWKV state is sequence-independent: a "slot" is just a batch row of
+# each state tensor, so insert copies row 0 of the prefill state into
+# the slot row. pos becomes per-row (forward broadcasts either way).
+
+def engine_pool(config: ModelConfig, n_slots: int, max_len: int):
+    cache = init_cache(config, n_slots)
+    return dataclasses.replace(cache, pos=jnp.zeros((n_slots,), jnp.int32))
+
+
+def engine_insert(cache, pcache, slot, pad):
+    return dataclasses.replace(
+        cache,
+        shift_att=cache.shift_att.at[:, slot].set(pcache.shift_att[:, 0]),
+        shift_ffn=cache.shift_ffn.at[:, slot].set(pcache.shift_ffn[:, 0]),
+        wkv=cache.wkv.at[:, slot].set(pcache.wkv[:, 0]),
+        pos=cache.pos.at[slot].set(pcache.pos),
+        start=cache.start.at[slot].set(pad),
+    )
+
+
 # ---------------------------------------------------------------------------
 # init / quantize
 # ---------------------------------------------------------------------------
@@ -265,8 +286,11 @@ def forward(
     v5 = _is_v5(config)
 
     state = cache if cache is not None else init_cache(config, B)
-    slots = state.pos + jnp.arange(T)  # [T] global positions
-    real = (slots[None, :] >= state.start[:, None]).astype(jnp.float32)  # [B,T]
+    # pos may be scalar (generate path) or [B] (serving engine slots)
+    pos_col = state.pos[:, None] if state.pos.ndim == 1 else state.pos[None, None]
+    slots = pos_col + jnp.arange(T)[None, :]  # [B|1, T] global positions
+    # start is always [B], so >= broadcasts to [B, T] either way
+    real = (slots >= state.start[:, None]).astype(jnp.float32)  # [B,T]
     maskf = real[..., None]  # [B, T, 1]
     real_tm = jnp.transpose(real, (1, 0))[..., None]  # [T, B, 1]
 

@@ -72,6 +72,25 @@ def init_cache(
     return YuanCache(kv=kv, lf=lf, start=kv.start)
 
 
+# --- serving-engine adapter (serving/engine.py custom-cache protocol):
+# the nested KV pool inserts like the generic path; the localized-filter
+# hiddens lf are per-row state copied alongside.
+
+def engine_pool(config: ModelConfig, n_slots: int, max_len: int):
+    cache = init_cache(config, n_slots, max_len)
+    kv = dataclasses.replace(cache.kv, pos=jnp.zeros((n_slots,), jnp.int32))
+    return dataclasses.replace(cache, kv=kv)
+
+
+def engine_insert(cache, pcache, slot, pad):
+    kv = kvcache.insert_row(cache.kv, pcache.kv, slot, pad)
+    return dataclasses.replace(
+        cache, kv=kv,
+        lf=cache.lf.at[:, slot].set(pcache.lf[:, 0]),
+        start=kv.start,
+    )
+
+
 def init_params(
     config: ModelConfig,
     key: jax.Array,

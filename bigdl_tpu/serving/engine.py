@@ -101,15 +101,29 @@ class InferenceEngine:
         # (the reference's paged attention + prefix caching live in its
         # vLLM fork, vllm/xpu/)
         self.paged = paged
-        # families with their own cache serve through the generic
-        # dataclass insert path ONLY when they declare SERVABLE_CACHE
+        # families with their own cache serve through either (a) the
+        # generic dataclass insert path when they declare SERVABLE_CACHE
         # (MLA's latent — flat [L, B, S, ...] fields with real pos/start;
-        # models/deepseek.py). rwkv/yuan/mllama caches have properties or
-        # nested pools the generic path would silently corrupt.
+        # models/deepseek.py), or (b) their own engine_pool/engine_insert
+        # adapter when the cache has nested pools or property pos
+        # (rwkv recurrent state, yuan localized-filter hiddens, mllama
+        # cross-attention; the generic path would silently corrupt them).
         fam = model.family
         self._family_cache = None
+        self._family_pool = getattr(fam, "engine_pool", None)
+        self._family_insert = getattr(fam, "engine_insert", None)
+        if (self._family_pool is None) != (self._family_insert is None):
+            # half an adapter would silently mix the custom and generic
+            # cache paths (e.g. a pool without per-row pos fed through
+            # the generic dataclass insert)
+            raise TypeError(
+                f"{model.config.model_type}: engine_pool and engine_insert "
+                "must be defined together"
+            )
         if hasattr(fam, "init_cache"):
-            if not getattr(fam, "SERVABLE_CACHE", False):
+            custom = (self._family_pool is not None
+                      and self._family_insert is not None)
+            if not custom and not getattr(fam, "SERVABLE_CACHE", False):
                 raise NotImplementedError(
                     f"the serving engine does not support "
                     f"{model.config.model_type}'s cache layout yet; use "
@@ -253,6 +267,8 @@ class InferenceEngine:
         """The shared KV pool, per-row positions from the start (idle rows
         park at 0); sharded over kv heads when the model is on a mesh."""
         cfg = self.config
+        if self._family_pool is not None:
+            return self._family_pool(cfg, self.n_slots, self.max_len)
         if self._family_cache is not None:
             cache = self._family_cache(cfg, self.n_slots, self.max_len)
             return dataclasses.replace(
@@ -311,7 +327,10 @@ class InferenceEngine:
         """Copy a prefilled request's KV (length `bucket`) into slot row at
         slots [0, bucket); per-row pos/start updated. Family caches (MLA
         latents) insert generically: every [L, B, ...] array field of the
-        dataclass takes the prefill cache's row at the slot index."""
+        dataclass takes the prefill cache's row at the slot index.
+        Families with nested/recurrent caches provide engine_insert."""
+        if self._family_insert is not None:
+            return self._family_insert(cache, pcache, slot, pad)
         if self._family_cache is not None:
             bucket = None
             upd = {}
@@ -330,16 +349,7 @@ class InferenceEngine:
             upd["pos"] = cache.pos.at[slot].set(bucket)
             upd["start"] = cache.start.at[slot].set(pad)
             return dataclasses.replace(cache, **upd)
-        bucket = pcache.k.shape[2]
-        k = jax.lax.dynamic_update_slice(
-            cache.k, pcache.k, (0, slot, 0, 0, 0)
-        )
-        v = jax.lax.dynamic_update_slice(
-            cache.v, pcache.v, (0, slot, 0, 0, 0)
-        )
-        pos = cache.pos.at[slot].set(bucket)
-        start = cache.start.at[slot].set(pad)
-        return dataclasses.replace(cache, k=k, v=v, pos=pos, start=start)
+        return kvcache.insert_row(cache, pcache, slot, pad)
 
     def _paged_prefill_impl(self, forward, params, k, v, row_bt, pos0,
                             tokens, last_idx):
