@@ -127,13 +127,17 @@ def insert_row(cache: KVCache, pcache: KVCache, slot, pad) -> KVCache:
     return dataclasses.replace(cache, **upd)
 
 
-def _quantize_heads(x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """[B,T,H,D] -> (fp8 codes, [B,T,H] f16 scales); per-vector absmax."""
+def _quantize_heads(
+    x: jax.Array, scale_dtype=jnp.float16
+) -> tuple[jax.Array, jax.Array]:
+    """[B,T,H,D] -> (fp8 codes, [B,T,H] scales); per-vector absmax.
+    The paged pool stores f32 scales (its Pallas kernel has no f16
+    vectors), so it asks for scale_dtype=f32 to skip the f16 round-trip."""
     absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
     scale = absmax / _FP8_MAX
     inv = jnp.where(scale == 0, 0.0, 1.0 / jnp.where(scale == 0, 1.0, scale))
     codes = (x.astype(jnp.float32) * inv[..., None]).astype(jnp.float8_e5m2)
-    return codes, scale.astype(jnp.float16)
+    return codes, scale.astype(scale_dtype)
 
 
 def _scatter_rows(buf: jax.Array, layer: jax.Array, pos: jax.Array,

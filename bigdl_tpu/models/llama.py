@@ -617,7 +617,18 @@ def forward(
             sliding = base
         return base, sliding, k_slot, q_slot
 
-    if use_flash:
+    # Paged decode reads KV pages in place via the Pallas paged-attention
+    # kernel — the XLA path would gather every page into a dense [B, S]
+    # copy per step (3x the HBM traffic; kvpaged.py docstring).
+    from bigdl_tpu.kvpaged import PagedKVCache
+
+    use_paged_kernel = (
+        isinstance(cache, PagedKVCache) and mode == "decode" and T == 1
+        and use_pallas() and not config.alibi
+        and attention_override is None
+    )
+
+    if use_flash or use_paged_kernel:
         mask_global = mask_sliding = None
         alibi_bias = None
     else:
@@ -689,12 +700,29 @@ def forward(
 
         if c is not None:
             c = kvcache.update_layer(c, idx, k, v)
-            k_att, v_att = kvcache.read_layer(c, idx, compute_dtype)
+            if not use_paged_kernel:
+                k_att, v_att = kvcache.read_layer(c, idx, compute_dtype)
         else:
             k_att = k.astype(compute_dtype)
             v_att = v.astype(compute_dtype)
 
-        if attention_override is not None and c is None:
+        if use_paged_kernel:
+            from bigdl_tpu.ops.pallas import paged_decode_attention
+
+            if config.sliding_window is None:
+                win_l = None
+            else:  # traced: sliding layers alternate within the scan
+                win_l = jnp.where(
+                    sliding_flags[layer_offset + idx],
+                    config.sliding_window, 2 ** 30,
+                ).astype(jnp.int32)
+            attn = paged_decode_attention(
+                q[:, 0], c.k, c.v, c.block_tables, idx, c.pos, c.start,
+                k_scale=c.k_scale, v_scale=c.v_scale,
+                scale=config.attn_scale,
+                softcap=config.attn_logit_softcap, window=win_l,
+            )[:, None]
+        elif attention_override is not None and c is None:
             attn = attention_override(q, k_att, v_att, row_start)
         elif use_flash:
             from bigdl_tpu.ops.pallas import flash_attention

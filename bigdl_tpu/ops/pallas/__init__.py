@@ -39,11 +39,15 @@ def interpret_mode() -> bool:
 
 
 from bigdl_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
+from bigdl_tpu.ops.pallas.paged_attention import (  # noqa: E402
+    paged_decode_attention,
+)
 from bigdl_tpu.ops.pallas.qmatmul import (  # noqa: E402
     qmatmul_asym_int4, qmatmul_codebook, qmatmul_int4, qmatmul_int8,
     qmatmul_q4k, qmatmul_q6k,
 )
 
-__all__ = ["use_pallas", "interpret_mode", "flash_attention", "qmatmul_int4",
-           "qmatmul_codebook", "qmatmul_int8", "qmatmul_asym_int4",
-           "qmatmul_q4k", "qmatmul_q6k"]
+__all__ = ["use_pallas", "interpret_mode", "flash_attention",
+           "paged_decode_attention", "qmatmul_int4", "qmatmul_codebook",
+           "qmatmul_int8", "qmatmul_asym_int4", "qmatmul_q4k",
+           "qmatmul_q6k"]

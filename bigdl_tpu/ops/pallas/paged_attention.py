@@ -1,0 +1,180 @@
+"""Pallas paged-attention decode kernel: attention reads KV pages IN
+PLACE through the block table.
+
+Parity target: the reference's vLLM paged attention
+(/root/reference/python/llm/src/ipex_llm/vllm/xpu/model_convert.py:65-127,
+backed by its SYCL paged kernels). The XLA fallback (kvpaged.read_layer)
+gathers every allocated page back into a dense [B, S] view per decode
+step — the bytes paging saves are spent on the gather, tripling HBM
+traffic (page read + dense write + attention read). Here the kernel DMAs
+each row's pages straight from the pool:
+
+- grid (B, max_pages); the block table, per-row pos/start and the layer
+  index ride as SCALAR-PREFETCH operands so the KV BlockSpec index maps
+  can pick the physical page (and layer) per step — no dense copy, no
+  per-layer slice of the pool;
+- online softmax accumulates across the page axis in VMEM scratch
+  (m/l/acc), exactly the flash-attention recurrence with pages as the
+  K blocks;
+- GQA: q reshapes to [Hkv, G, D] and both dots batch over the kv head
+  axis, so all query heads of a row are served by one page DMA.
+
+Stale pages (entries past the row's allocation point at physical page 0,
+the engine's scratch sink) are read but fully masked; a fully-masked
+page contributes exp-weights of exactly 0, not a poisoned max.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, *refs,
+            n_kv: int, group: int, page: int,
+            n_batch: int, softcap: float | None, quantized: bool):
+    if quantized:  # fp8 pages: per-vector f32 scales ride alongside
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    mp = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].reshape(n_kv, group, -1).astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)  # [page, Hkv, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    if quantized:
+        k = k * ks_ref[0, 0][..., None]
+        v = v * vs_ref[0, 0][..., None]
+
+    # scores [Hkv, G, page], both dots batched over the kv-head axis
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )
+    if softcap is not None:
+        s = jnp.tanh(s / softcap) * softcap
+
+    # validity of this page's slots for row b: start <= slot <= pos
+    # (pos is the slot the current token was just written to)
+    pos_b = meta_ref[2 + b]
+    start_b = meta_ref[2 + n_batch + b]
+    win = meta_ref[1]  # traced per-layer sliding window (2**30 = none)
+    slot = p * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    valid = (slot >= start_b) & (slot <= pos_b) & (slot > pos_b - win)
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = m_ref[:]  # [Hkv, G, 1-padded lanes]
+    m_cur = jnp.max(s, axis=2, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # exp-weights of masked slots are exactly 0 (a fully-masked page
+    # must contribute nothing, even while m is still -inf)
+    pexp = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(pexp, axis=2, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        pexp, v, (((2,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = m_new
+
+    @pl.when(p == mp - 1)
+    def _finish():
+        l = l_ref[:]
+        out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = out.reshape(n_kv * group, -1).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "interpret"),
+)
+def paged_decode_attention(
+    q: jax.Array,  # [B, Hq, D] current-token queries
+    k_pages: jax.Array,  # [L, n_pages, page, Hkv, D] the FULL pool
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [B, max_pages] int32
+    layer: jax.Array,  # scalar int32
+    pos: jax.Array,  # [B] slot holding the current token
+    start: jax.Array,  # [B]
+    k_scale: jax.Array | None = None,  # [L, n_pages, page, Hkv] f32 (fp8)
+    v_scale: jax.Array | None = None,
+    scale: float | None = None,
+    softcap: float | None = None,
+    window=None,  # traced per-layer sliding window; None = unbounded
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Returns [B, Hq, D] attention over each row's pages, in place."""
+    from bigdl_tpu.ops.pallas import interpret_mode
+
+    if interpret is None:
+        interpret = interpret_mode()
+    B, Hq, D = q.shape
+    L, NP, page, Hkv, _ = k_pages.shape
+    G = Hq // Hkv
+    mp = block_tables.shape[1]
+
+    sc = scale if scale is not None else D ** -0.5
+    q = q.astype(jnp.float32) * sc  # q block is tiny; keep full precision
+
+    win = jnp.asarray(2 ** 30 if window is None else window, jnp.int32)
+    meta = jnp.concatenate([
+        jnp.reshape(layer, (1,)).astype(jnp.int32), win[None],
+        pos.astype(jnp.int32), start.astype(jnp.int32),
+    ])
+
+    quantized = k_scale is not None
+    kv_spec = pl.BlockSpec(
+        (1, 1, page, Hkv, D),
+        lambda b, p, bt, meta: (meta[0], bt[b, p], 0, 0, 0),
+    )
+    in_specs = [
+        pl.BlockSpec((1, Hq, D), lambda b, p, bt, meta: (b, 0, 0)),
+        kv_spec, kv_spec,
+    ]
+    args = [block_tables, meta, q, k_pages, v_pages]
+    if quantized:
+        sc_spec = pl.BlockSpec(
+            (1, 1, page, Hkv),
+            lambda b, p, bt, meta: (meta[0], bt[b, p], 0, 0),
+        )
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, mp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, p, bt, meta: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G, D), jnp.float32),
+            pltpu.VMEM((Hkv, G, 1), jnp.float32),
+            pltpu.VMEM((Hkv, G, 1), jnp.float32),
+        ],
+    )
+    out_dtype = jnp.bfloat16
+    return pl.pallas_call(
+        functools.partial(
+            _kernel, n_kv=Hkv, group=G, page=page, n_batch=B,
+            softcap=softcap, quantized=quantized,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)

@@ -89,6 +89,7 @@ class InferenceEngine:
         speculative: bool = False,
         draft_params=None,
         draft_k: int = 4,
+        quantize_kv: bool = False,
     ):
         self.model = model
         self.config: ModelConfig = model.config
@@ -101,6 +102,9 @@ class InferenceEngine:
         # (the reference's paged attention + prefix caching live in its
         # vLLM fork, vllm/xpu/)
         self.paged = paged
+        # fp8 KV storage for the shared pool (dense or paged): halves KV
+        # HBM capacity + traffic, the reference's fp8 kv-cache lever
+        self.quantize_kv = quantize_kv
         # families with their own cache serve through either (a) the
         # generic dataclass insert path when they declare SERVABLE_CACHE
         # (MLA's latent — flat [L, B, S, ...] fields with real pos/start;
@@ -200,7 +204,7 @@ class InferenceEngine:
         ))
         self._paged_prefill = self._with_mesh(jax.jit(
             functools.partial(self._paged_prefill_impl, fwd),
-            donate_argnames=("k", "v"),
+            donate_argnames=("k", "v", "ks", "vs"),
         ))
         # --- in-engine speculative decoding (reference serves it through
         # ipex_llm_worker.py:72-99; SURVEY §7 names "continuous batching +
@@ -280,11 +284,12 @@ class InferenceEngine:
             return kvpaged.init_paged(
                 cfg.num_hidden_layers, self.n_pages, self.page_size,
                 cfg.num_key_value_heads, cfg.head_dim_, self.n_slots,
-                self.max_pages_per_row,
+                self.max_pages_per_row, quantize_kv=self.quantize_kv,
             )
         cache = kvcache.init_cache(
             cfg.num_hidden_layers, self.n_slots, self.max_len,
             cfg.num_key_value_heads, cfg.head_dim_,
+            quantize_kv=self.quantize_kv,
         )
         cache = dataclasses.replace(
             cache, pos=jnp.zeros((self.n_slots,), jnp.int32)
@@ -315,7 +320,7 @@ class InferenceEngine:
         else:
             cache = kvcache.init_cache(
                 cfg.num_hidden_layers, 1, bucket, cfg.num_key_value_heads,
-                cfg.head_dim_,
+                cfg.head_dim_, quantize_kv=self.quantize_kv,
             )
         cache = dataclasses.replace(cache, start=start)
         logits, cache = forward(
@@ -351,8 +356,8 @@ class InferenceEngine:
             return dataclasses.replace(cache, **upd)
         return kvcache.insert_row(cache, pcache, slot, pad)
 
-    def _paged_prefill_impl(self, forward, params, k, v, row_bt, pos0,
-                            tokens, last_idx):
+    def _paged_prefill_impl(self, forward, params, k, v, ks, vs, row_bt,
+                            pos0, tokens, last_idx):
         """Tail prefill for ONE slot, writing straight into the shared
         page pool (donated k/v): no dense mini-cache, no insert copy.
         tokens are RIGHT-padded to a bucket; last_idx selects the real
@@ -361,13 +366,14 @@ class InferenceEngine:
         from bigdl_tpu import kvpaged
 
         cache = kvpaged.PagedKVCache(
-            k=k, v=v, block_tables=row_bt, pos=pos0,
+            k=k, v=v, k_scale=ks, v_scale=vs, block_tables=row_bt, pos=pos0,
             start=jnp.zeros((1,), jnp.int32),
         )
         logits, cache = forward(
             self.config, params, tokens, cache, mode="prefill"
         )
-        return logits[0, last_idx], cache.k, cache.v
+        return (logits[0, last_idx], cache.k, cache.v, cache.k_scale,
+                cache.v_scale)
 
     def _decode_impl(self, forward, params, cur, cache, key,
                      temp, topk, topp, dosample, seen, penalty):
@@ -605,13 +611,14 @@ class InferenceEngine:
         toks = np.full((1, bucket), self.gen.pad_token_id, np.int32)
         toks[0, : len(tail)] = tail  # RIGHT pad: writes past pos get
         # overwritten by decode and are masked meanwhile
-        logits_last, k, v = self._paged_prefill(
+        logits_last, k, v, ks, vs = self._paged_prefill(
             self.model.params, self.cache.k, self.cache.v,
+            self.cache.k_scale, self.cache.v_scale,
             jnp.asarray(row[None]), jnp.asarray([lp], jnp.int32),
             jnp.asarray(toks), jnp.asarray(len(tail) - 1),
         )
         self.cache = dataclasses.replace(
-            self.cache, k=k, v=v,
+            self.cache, k=k, v=v, k_scale=ks, v_scale=vs,
             pos=self.cache.pos.at[slot].set(len(prompt)),
             start=self.cache.start.at[slot].set(0),
         )
