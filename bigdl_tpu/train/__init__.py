@@ -15,6 +15,7 @@ from bigdl_tpu.train.recipes import (
     relora_reset,
     sample_lisa_mask,
 )
+from bigdl_tpu.train.checkpoint import load_train_state, save_train_state
 from bigdl_tpu.train.dpo import dpo_loss, make_dpo_step, sequence_logprob
 from bigdl_tpu.train.galore import GaLoreState, galore
 
@@ -34,4 +35,6 @@ __all__ = [
     "sequence_logprob",
     "GaLoreState",
     "galore",
+    "save_train_state",
+    "load_train_state",
 ]

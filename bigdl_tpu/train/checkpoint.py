@@ -1,0 +1,150 @@
+"""Atomic train-state checkpoint/resume for the jitted finetuning loops.
+
+The reference leans on HF Trainer/PEFT checkpointing (SURVEY.md §5;
+relora.py:64-150 merges adapters into saved checkpoints); our training
+loops are jitted steps with explicit state, so the checkpoint is the
+state itself: (lora tree, optax optimizer state, step counter, PRNG key,
+optionally the merged base params for mid-ReLoRA resume — the base
+mutates at every merge-and-reset, so a ReLoRA resume without it would
+continue from the wrong weights).
+
+Format: ONE .npz file (flattened pytree leaves as bit-views via
+convert/low_bit's codec, plus the JSON metadata as a zero-dim array),
+written to a temp name and os.replace()d into place — a kill at any
+instant leaves either the old or the new checkpoint, never a torn or
+missing one, for both first saves and overwrites.
+
+Pytree structure is NOT serialized: load takes "like" templates (the
+freshly-initialized lora/opt_state the caller already has) and unflattens
+onto their treedef, verifying leaf shapes and dtypes — the standard JAX
+restore pattern, which keeps optax's nested NamedTuples out of the file
+format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.convert.low_bit import _decode as _decode_bits
+from bigdl_tpu.convert.low_bit import _encode as _encode_bits
+
+
+def _encode(arr) -> tuple[np.ndarray, str]:
+    if jnp.issubdtype(jnp.asarray(arr).dtype, jax.dtypes.prng_key):
+        return np.asarray(jax.random.key_data(arr)), "prng_key"
+    return _encode_bits(arr)
+
+
+def _decode(a: np.ndarray, dtype_name: str):
+    if dtype_name == "prng_key":
+        return jax.random.wrap_key_data(jnp.asarray(a))
+    return _decode_bits(a, dtype_name)
+
+
+def save_train_state(
+    path: str,
+    *,
+    lora: dict,
+    opt_state: Any,
+    step: int,
+    rng: jax.Array,
+    params: Optional[dict] = None,
+    resets: int = 0,
+) -> None:
+    """Atomically write the full training state to `path` (one file).
+    Pass `params` when the base mutates (ReLoRA merges); plain QLoRA's
+    frozen base reloads from its own checkpoint and needs only the
+    adapter state here."""
+    state = {"lora": lora, "opt_state": opt_state, "rng": rng}
+    if params is not None:
+        state["params"] = params
+    leaves = jax.tree.leaves(state)
+
+    arrays, dtypes = {}, []
+    for i, leaf in enumerate(leaves):
+        a, dt = _encode(leaf)
+        arrays[f"leaf_{i:05d}"] = a
+        dtypes.append(dt)
+    arrays["meta"] = np.asarray(json.dumps({
+        "format_version": 2,
+        "step": int(step),
+        "resets": int(resets),
+        "n_leaves": len(leaves),
+        "dtypes": dtypes,
+        "has_params": params is not None,
+    }))
+
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_train_state(
+    path: str,
+    *,
+    like_lora: dict,
+    like_opt_state: Any,
+    like_params: Optional[dict] = None,
+) -> dict:
+    """Returns {lora, opt_state, rng, step, resets[, params]}; the
+    `like_*` templates (e.g. a freshly-initialized lora + optimizer.init)
+    provide the pytree structure to unflatten onto."""
+    npz = np.load(path, allow_pickle=False)
+    meta = json.loads(str(npz["meta"]))
+    if meta["format_version"] != 2:
+        raise ValueError(f"unsupported format_version {meta['format_version']}")
+    like = {
+        "lora": like_lora, "opt_state": like_opt_state,
+        "rng": jax.random.PRNGKey(0),
+    }
+    if meta["has_params"]:
+        if like_params is None:
+            raise ValueError(
+                "checkpoint carries base params (ReLoRA); pass like_params"
+            )
+        like["params"] = like_params
+    treedef = jax.tree.structure(like)
+    like_leaves = jax.tree.leaves(like)
+    if treedef.num_leaves != meta["n_leaves"]:
+        raise ValueError(
+            f"checkpoint has {meta['n_leaves']} leaves but the templates "
+            f"have {treedef.num_leaves} — optimizer or lora config changed"
+        )
+
+    leaves = []
+    for i, (dt, ref) in enumerate(zip(meta["dtypes"], like_leaves)):
+        leaf = _decode(npz[f"leaf_{i:05d}"], dt)
+        # typed-vs-raw PRNG keys have different logical shapes; the rng
+        # leaf's template is a placeholder, so skip its checks
+        if dt != "prng_key" and hasattr(ref, "shape"):
+            if tuple(leaf.shape) != tuple(ref.shape):
+                raise ValueError(
+                    f"leaf {i}: checkpoint shape {tuple(leaf.shape)} != "
+                    f"template {tuple(ref.shape)}"
+                )
+            if jnp.asarray(ref).dtype != leaf.dtype:
+                raise ValueError(
+                    f"leaf {i}: checkpoint dtype {leaf.dtype} != "
+                    f"template {jnp.asarray(ref).dtype} — a resumed run "
+                    "would not bit-reproduce the original curve"
+                )
+        leaves.append(leaf)
+    state = jax.tree.unflatten(treedef, leaves)
+    state["step"] = meta["step"]
+    state["resets"] = meta["resets"]
+    return state

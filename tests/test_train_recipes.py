@@ -153,3 +153,135 @@ def test_sequence_logprob_masking(rng, qbase):
     lp_full = sequence_logprob(CFG, llama.forward, qbase, None, toks, full)
     lp_half = sequence_logprob(CFG, llama.forward, qbase, None, toks, half)
     assert float(lp_half[0]) > float(lp_full[0])  # fewer (negative) terms
+
+
+def test_checkpoint_kill_and_resume(rng, qbase, tmp_path):
+    """Save at step 3 of 6, rebuild everything fresh, resume — the
+    resumed loss curve reproduces the uninterrupted run exactly
+    (VERDICT r03 missing #7: a crashed QLoRA run lost everything)."""
+    from bigdl_tpu.train import load_train_state, save_train_state
+
+    optimizer = optax.adamw(1e-2)
+    step_fn = jax.jit(make_train_step(CFG, llama.forward, optimizer))
+    toks = _tokens(rng)
+    mask = jnp.ones_like(toks, jnp.float32)
+
+    def fresh():
+        lora = init_lora(CFG, jax.random.PRNGKey(1), rank=4)
+        return lora, optimizer.init(lora["layers"])
+
+    # uninterrupted run: 6 steps
+    lora, opt = fresh()
+    key = jax.random.PRNGKey(7)
+    want = []
+    for i in range(6):
+        lora, opt, loss = step_fn(qbase, lora, opt, toks, mask)
+        want.append(float(loss))
+
+    # interrupted run: 3 steps, checkpoint, "crash"
+    lora, opt = fresh()
+    got = []
+    for i in range(3):
+        lora, opt, loss = step_fn(qbase, lora, opt, toks, mask)
+        got.append(float(loss))
+    ckpt = str(tmp_path / "ckpt")
+    save_train_state(ckpt, lora=lora, opt_state=opt, step=3, rng=key)
+
+    # resume in a "new process": fresh templates, loaded state
+    like_lora, like_opt = fresh()
+    st = load_train_state(ckpt, like_lora=like_lora, like_opt_state=like_opt)
+    assert st["step"] == 3
+    lora2, opt2 = st["lora"], st["opt_state"]
+    for i in range(st["step"], 6):
+        lora2, opt2, loss = step_fn(qbase, lora2, opt2, toks, mask)
+        got.append(float(loss))
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+    # overwrite is atomic-by-construction: saving again over the same
+    # path must succeed and load back
+    save_train_state(ckpt, lora=lora2, opt_state=opt2, step=6, rng=key)
+    st2 = load_train_state(ckpt, like_lora=like_lora, like_opt_state=like_opt)
+    assert st2["step"] == 6
+
+
+def test_checkpoint_relora_mid_phase(rng, qbase, tmp_path):
+    """ReLoRA's base mutates at each merge: the checkpoint carries the
+    merged params, and resuming mid-phase (after one reset) reproduces
+    the uninterrupted curve."""
+    from bigdl_tpu.train import load_train_state, save_train_state
+    from bigdl_tpu.train.recipes import ReLoRAState, relora_reset
+
+    optimizer = optax.sgd(5e-2)
+    step_fn = jax.jit(make_train_step(CFG, llama.forward, optimizer))
+    toks = _tokens(rng)
+    mask = jnp.ones_like(toks, jnp.float32)
+
+    def run(n_steps, ckpt_at=None, resume_from=None, path=None):
+        if resume_from is None:
+            lora = init_lora(CFG, jax.random.PRNGKey(1), rank=4)
+            state = ReLoRAState(
+                params=qbase, lora=lora,
+                opt_state=optimizer.init(lora["layers"]),
+            )
+            start = 0
+        else:
+            state, start = resume_from
+        losses = []
+        for i in range(start, n_steps):
+            if i == 2:  # merge-and-reset boundary
+                if i > start or resume_from is None:
+                    state = relora_reset(
+                        CFG, state, optimizer, jax.random.PRNGKey(9), rank=4
+                    )
+            lora, opt, loss = step_fn(
+                state.params, state.lora, state.opt_state, toks, mask
+            )
+            state = ReLoRAState(params=state.params, lora=lora,
+                                opt_state=opt, resets=state.resets)
+            losses.append(float(loss))
+            if ckpt_at is not None and i + 1 == ckpt_at:
+                save_train_state(
+                    path, lora=state.lora, opt_state=state.opt_state,
+                    step=i + 1, rng=jax.random.PRNGKey(0),
+                    params=state.params, resets=state.resets,
+                )
+        return losses, state
+
+    want, _ = run(5)
+    path = str(tmp_path / "relora")
+    got, _ = run(3, ckpt_at=3, path=path)  # crash after step 3 (mid phase 2)
+
+    like_lora = init_lora(CFG, jax.random.PRNGKey(1), rank=4)
+    st = load_train_state(
+        path, like_lora=like_lora,
+        like_opt_state=optimizer.init(like_lora["layers"]),
+        like_params=qbase,
+    )
+    state = ReLoRAState(params=st["params"], lora=st["lora"],
+                        opt_state=st["opt_state"], resets=st["resets"])
+    more, _ = run(5, resume_from=(state, st["step"]))
+    np.testing.assert_allclose(got + more, want, rtol=0, atol=0)
+
+
+def test_checkpoint_typed_prng_key_and_dtype_gate(rng, qbase, tmp_path):
+    """New-style typed PRNG keys round-trip, and a template whose dtype
+    diverges from the checkpoint is rejected (a silent mismatch would
+    break bit-reproducible resume)."""
+    from bigdl_tpu.train import load_train_state, save_train_state
+
+    optimizer = optax.sgd(1e-2)
+    lora = init_lora(CFG, jax.random.PRNGKey(1), rank=4)
+    opt = optimizer.init(lora["layers"])
+    path = str(tmp_path / "ck.npz")
+    key = jax.random.key(42)  # typed key
+    save_train_state(path, lora=lora, opt_state=opt, step=1, rng=key)
+    st = load_train_state(path, like_lora=lora, like_opt_state=opt)
+    assert jnp.issubdtype(st["rng"].dtype, jax.dtypes.prng_key)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(st["rng"])),
+        np.asarray(jax.random.key_data(key)),
+    )
+
+    bad = jax.tree.map(lambda a: a.astype(jnp.float16), lora)
+    with pytest.raises(ValueError, match="dtype"):
+        load_train_state(path, like_lora=bad, like_opt_state=opt)
