@@ -41,6 +41,8 @@ GGML_Q4_0, GGML_Q4_1 = 2, 3
 GGML_Q5_0, GGML_Q5_1 = 6, 7
 GGML_Q8_0 = 8
 GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 10, 11, 12, 13, 14
+GGML_IQ2_XXS, GGML_IQ2_XS = 16, 17
+GGML_IQ1_S, GGML_IQ1_M = 19, 29
 GGML_BF16 = 30
 
 _TYPE_NAMES = {
@@ -48,7 +50,8 @@ _TYPE_NAMES = {
     GGML_Q4_0: "q4_0", GGML_Q4_1: "q4_1", GGML_Q5_0: "q5_0",
     GGML_Q5_1: "q5_1", GGML_Q8_0: "q8_0", GGML_Q2_K: "q2_k",
     GGML_Q3_K: "q3_k", GGML_Q4_K: "q4_k", GGML_Q5_K: "q5_k",
-    GGML_Q6_K: "q6_k",
+    GGML_Q6_K: "q6_k", GGML_IQ2_XXS: "iq2_xxs", GGML_IQ2_XS: "iq2_xs",
+    GGML_IQ1_S: "iq1_s", GGML_IQ1_M: "iq1_m",
 }
 
 from bigdl_tpu.quant.qtypes import KQUANT_LAYOUT  # numpy-only module
@@ -64,6 +67,10 @@ _BLOCK = {
     GGML_Q5_0: (32, 22), GGML_Q5_1: (32, 24),
     GGML_Q8_0: (32, 34),
     **{t: (256, KQUANT_LAYOUT[n][0]) for t, n in _KQUANT_TYPES.items()},
+    # IQ formats (importance quants; decoded via quant/iq_quants.py and
+    # re-quantized on load — no native runtime layout)
+    GGML_IQ2_XXS: (256, 66), GGML_IQ2_XS: (256, 74),
+    GGML_IQ1_S: (256, 50), GGML_IQ1_M: (256, 56),
 }
 
 # metadata value types
@@ -324,12 +331,32 @@ def _deq_kquant_np(name: str) -> Callable[[np.ndarray], np.ndarray]:
     return deq
 
 
+def _deq_iq(name: str) -> Callable[[np.ndarray], np.ndarray]:
+    def deq(blocks: np.ndarray) -> np.ndarray:
+        from bigdl_tpu.quant import iq_quants
+
+        if name == "iq1_m":
+            raise NotImplementedError(
+                "iq1_m: the scale-word layout is pending validation "
+                "against a reference decoder; convert the checkpoint to "
+                "iq1_s/iq2_xxs or a k-quant"
+            )
+        fn = {"iq2_xxs": iq_quants.dequant_iq2_xxs,
+              "iq2_xs": iq_quants.dequant_iq2_xs,
+              "iq1_s": iq_quants.dequant_iq1_s}[name]
+        return fn(blocks)
+
+    return deq
+
+
 _DEQUANT: dict[int, Callable[[np.ndarray], np.ndarray]] = {
     GGML_Q4_0: _deq_q4_0, GGML_Q4_1: _deq_q4_1,
     GGML_Q5_0: _deq_q5_0, GGML_Q5_1: _deq_q5_1,
     GGML_Q8_0: _deq_q8_0, GGML_Q4_K: _deq_q4_k, GGML_Q6_K: _deq_q6_k,
     GGML_Q2_K: _deq_kquant_np("q2_k"), GGML_Q3_K: _deq_kquant_np("q3_k"),
     GGML_Q5_K: _deq_kquant_np("q5_k"),
+    GGML_IQ2_XXS: _deq_iq("iq2_xxs"), GGML_IQ2_XS: _deq_iq("iq2_xs"),
+    GGML_IQ1_S: _deq_iq("iq1_s"), GGML_IQ1_M: _deq_iq("iq1_m"),
 }
 
 
