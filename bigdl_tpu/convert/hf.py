@@ -833,6 +833,139 @@ def _rwkv_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
     }
 
 
+def _qwen_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Qwen v1 (Qwen-7B remote code; reference models/qwen.py): fused
+    biased c_attn [3H, H], bias-free c_proj, and an MLP computed as
+    c_proj(w1(x) * silu(w2(x))) — w2 is the gate, w1 the up."""
+    p = f"transformer.h.{i}."
+    H = config.hidden_size
+    c_attn = get(p + "attn.c_attn.weight")  # [3H, H] (nn.Linear rows)
+    b_attn = get(p + "attn.c_attn.bias")
+    return {
+        "attn_norm": get(p + "ln_1.weight"),
+        "mlp_norm": get(p + "ln_2.weight"),
+        "wq": c_attn[:H], "wk": c_attn[H:2 * H], "wv": c_attn[2 * H:],
+        "bq": b_attn[:H], "bk": b_attn[H:2 * H], "bv": b_attn[2 * H:],
+        "wo": get(p + "attn.c_proj.weight"),
+        "w_gate": get(p + "mlp.w2.weight"),
+        "w_up": get(p + "mlp.w1.weight"),
+        "w_down": get(p + "mlp.c_proj.weight"),
+    }
+
+
+def _qwen_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    return {
+        "embed": get("transformer.wte.weight"),
+        "final_norm": get("transformer.ln_f.weight"),
+        "lm_head": get("lm_head.weight"),
+    }
+
+
+def _deci_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """DeciLM: llama leaf names but VARIABLE GQA — each layer ships its
+    own kv head count. Scan-stacked layers need uniform shapes, so k/v
+    projections replicate head blocks up to the global max: exact,
+    because attention with kv head j repeated r times equals GQA mapping
+    q-head h -> head h // (Hq/Hkv_layer) (repeat_kv commutes with the
+    grouping)."""
+    out = _llama_layer(config, i, get)
+    D = config.head_dim_
+    target = config.num_key_value_heads * D
+    for name in ("wk", "wv"):
+        w = out[name]
+        if w.shape[0] != target:
+            hkv_l = w.shape[0] // D
+            reps = target // w.shape[0]
+            assert reps * w.shape[0] == target, (
+                f"layer {i}: kv heads {hkv_l} do not divide the max "
+                f"{config.num_key_value_heads}"
+            )
+            out[name] = np.repeat(
+                w.reshape(hkv_l, D, -1), reps, axis=0
+            ).reshape(target, -1)
+    return out
+
+
+def _gptbigcode_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """GPT-BigCode (starcoder v1): gpt2 naming but nn.Linear weights
+    (no Conv1D transpose) and multi-query attention — the fused c_attn
+    stacks [H query rows | head_dim k rows | head_dim v rows]."""
+    p = f"transformer.h.{i}."
+    H = config.hidden_size
+    KD = config.num_key_value_heads * config.head_dim_
+    c_attn = get(p + "attn.c_attn.weight")  # [H + 2*KD, H]
+    b_attn = get(p + "attn.c_attn.bias")
+    return {
+        "attn_norm": get(p + "ln_1.weight"),
+        "attn_norm_b": get(p + "ln_1.bias"),
+        "mlp_norm": get(p + "ln_2.weight"),
+        "mlp_norm_b": get(p + "ln_2.bias"),
+        "wq": c_attn[:H], "wk": c_attn[H:H + KD], "wv": c_attn[H + KD:],
+        "bq": b_attn[:H], "bk": b_attn[H:H + KD], "bv": b_attn[H + KD:],
+        "wo": get(p + "attn.c_proj.weight"),
+        "bo": get(p + "attn.c_proj.bias"),
+        "w_up": get(p + "mlp.c_fc.weight"),
+        "b_up": get(p + "mlp.c_fc.bias"),
+        "w_down": get(p + "mlp.c_proj.weight"),
+        "b_down": get(p + "mlp.c_proj.bias"),
+    }
+
+
+def _gptbigcode_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    out = {
+        "embed": get("transformer.wte.weight"),
+        "wpe": get("transformer.wpe.weight"),
+        "final_norm": get("transformer.ln_f.weight"),
+        "final_norm_b": get("transformer.ln_f.bias"),
+    }
+    if not config.tie_word_embeddings:
+        out["lm_head"] = get("lm_head.weight")
+    return out
+
+
+def _phixtral_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Phixtral (legacy mixformer naming): one shared biased layernorm,
+    fused mixer.Wqkv, and a router over phi-2 fc1/fc2 experts
+    (moe.mlp.{e}.*; reference models/phixtral.py)."""
+    p = f"transformer.h.{i}."
+    H = config.hidden_size
+    ln_w = get(p + "ln.weight")
+    ln_b = get(p + "ln.bias")
+    wqkv = get(p + "mixer.Wqkv.weight")  # [3H, H]
+    bqkv = get(p + "mixer.Wqkv.bias")
+    out = {
+        "attn_norm": ln_w, "attn_norm_b": ln_b,
+        "mlp_norm": ln_w, "mlp_norm_b": ln_b,
+        "wq": wqkv[:H], "wk": wqkv[H:2 * H], "wv": wqkv[2 * H:],
+        "bq": bqkv[:H], "bk": bqkv[H:2 * H], "bv": bqkv[2 * H:],
+        "wo": get(p + "mixer.out_proj.weight"),
+        "bo": get(p + "mixer.out_proj.bias"),
+        "router": get(p + "moe.gate.weight"),
+    }
+    ups, bups, downs, bdowns = [], [], [], []
+    for e in range(config.num_experts):
+        ep = f"{p}moe.mlp.{e}."
+        ups.append(get(ep + "fc1.weight"))
+        bups.append(get(ep + "fc1.bias"))
+        downs.append(get(ep + "fc2.weight"))
+        bdowns.append(get(ep + "fc2.bias"))
+    out["w_up_e"] = np.stack(ups)
+    out["b_up_e"] = np.stack(bups)
+    out["w_down_e"] = np.stack(downs)
+    out["b_down_e"] = np.stack(bdowns)
+    return out
+
+
+def _phixtral_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    return {
+        "embed": get("transformer.embd.wte.weight"),
+        "final_norm": get("lm_head.ln.weight"),
+        "final_norm_b": get("lm_head.ln.bias"),
+        "lm_head": get("lm_head.linear.weight"),
+        "lm_head_b": get("lm_head.linear.bias"),
+    }
+
+
 _FAMILY_LAYER = {
     "gemma2": _gemma2_layer,
     "gemma3": _gemma3_layer,
@@ -861,6 +994,10 @@ _FAMILY_LAYER = {
     "minicpmv": _minicpmv_layer,
     "internvl": _internvl_layer,
     "janus": _janus_layer,
+    "qwen": _qwen_layer,
+    "deci": _deci_layer,
+    "gpt_bigcode": _gptbigcode_layer,
+    "phixtral": _phixtral_layer,
 }
 
 _FAMILY_TOP = {
@@ -881,6 +1018,9 @@ _FAMILY_TOP = {
     "minicpmv": _minicpmv_top,
     "internvl": _internvl_top,
     "janus": _janus_top,
+    "qwen": _qwen_top,
+    "gpt_bigcode": _gptbigcode_top,
+    "phixtral": _phixtral_top,
 }
 
 

@@ -50,6 +50,10 @@ _FAMILIES = {
     # parallel attn/mlp + grouped fused qkv, translated in
     # config._hf_falcon and convert/hf._falcon_layer
     "falcon": llama,
+    "qwen": llama,  # v1: fused c_attn, halved-ff gate/up, logn scaling
+    "deci": llama,  # variable GQA replicated to uniform kv heads at ingest
+    "gpt_bigcode": llama,  # starcoder v1: MQA + learned positions
+    "phixtral": llama,  # phi decoder + MoE over non-gated fc1/fc2 experts
 }
 
 from bigdl_tpu.models import qwen2_vl  # noqa: E402  (delegates text to llama)

@@ -73,6 +73,10 @@ class ModelConfig:
     # HF modeling_falcon eager path); bloom/baichuan/mpt add it unscaled
     alibi_scale: Optional[float] = None
     learned_positions: bool = False  # gpt2 wpe table (rope disabled)
+    # qwen v1 logn attention: q *= max(1, log_train_len(pos+1)) for
+    # positions beyond the training length (HF modeling_qwen logn_tensor)
+    logn_attn: bool = False
+    logn_train_len: int = 0
     parallel_residual: bool = False  # gptneox: h += attn(x) + mlp(x)
     embed_layernorm: bool = False  # bloom word_embeddings_layernorm
     # MoE (mixtral / qwen2_moe); 0 experts = dense MLP
@@ -552,6 +556,86 @@ def _hf_phi(hf, kw):
         raise NotImplementedError("phi with qk_layernorm=True")
 
 
+def _hf_qwen(hf, kw):
+    """Qwen v1 (Qwen-7B/14B remote code, reference models/qwen.py):
+    fused biased c_attn, bias-free c_proj, RMSNorm, MHA, and an MLP
+    whose HF intermediate_size is the SUM of the two halves (w1/w2 each
+    project to intermediate//2; out = c_proj(w1(x) * silu(w2(x)))).
+    Optional logn attention scaling beyond the training length."""
+    kw["attention_bias"] = True
+    kw["attention_out_bias"] = False
+    kw["intermediate_size"] = hf.get("intermediate_size", 22016) // 2
+    kw["rms_norm_eps"] = hf.get("layer_norm_epsilon", 1e-6)
+    kw["max_position_embeddings"] = hf.get(
+        "max_position_embeddings", hf.get("seq_length", 8192))
+    if hf.get("use_logn_attn"):
+        kw["logn_attn"] = True
+        kw["logn_train_len"] = hf.get("seq_length", 8192)
+    # qwen's dynamic NTK adapts the rope base to the live sequence
+    # length; fixed-shape TPU programs pin it at the training length
+    # (exact within seq_length; longer contexts need an explicit
+    # rope_scaling override)
+
+
+def _hf_deci(hf, kw):
+    """DeciLM: llama with VARIABLE GQA (num_key_value_heads_per_layer).
+    Scan-stacked layers need uniform shapes, so ingest replicates each
+    layer's kv heads up to the max — numerically exact (repeat_kv
+    commutes with GQA grouping; convert/hf._deci_layer)."""
+    per_layer = hf.get("num_key_value_heads_per_layer")
+    if per_layer:
+        kw["num_key_value_heads"] = max(per_layer)
+    kw.setdefault("attention_bias", False)
+
+
+def _hf_gptbigcode(hf, kw):
+    """GPT-BigCode (starcoder v1, reference models/gptbigcode.py):
+    gpt2-style learned positions + layernorm + non-gated gelu MLP, but
+    nn.Linear weights (not Conv1D) and multi-query attention (1 kv
+    head) via a [H + 2*head_dim] fused c_attn."""
+    kw["hidden_size"] = hf.get("n_embd", 768)
+    kw["num_hidden_layers"] = hf.get("n_layer", 12)
+    kw["num_attention_heads"] = hf.get("n_head", 12)
+    kw["num_key_value_heads"] = 1 if hf.get("multi_query", True) else (
+        kw["num_attention_heads"])
+    kw["intermediate_size"] = hf.get("n_inner") or 4 * kw["hidden_size"]
+    kw["max_position_embeddings"] = hf.get("n_positions", 1024)
+    kw["rms_norm_eps"] = hf.get("layer_norm_epsilon", 1e-5)
+    kw["norm_type"] = "layernorm"
+    kw["norm_bias"] = True
+    kw["gated_mlp"] = False
+    kw["mlp_bias"] = True
+    kw["attention_bias"] = True
+    kw["attention_out_bias"] = True
+    kw["learned_positions"] = True
+    kw["hidden_act"] = hf.get("activation_function", "gelu_pytorch_tanh")
+    kw.setdefault("tie_word_embeddings", True)
+
+
+def _hf_phixtral(hf, kw):
+    """Phixtral (mlabonne MoE over phi-2 experts, reference
+    models/phixtral.py): phi's parallel-residual/biased/partial-rotary
+    decoder with mixtral-style top-k routing over NON-GATED fc1/fc2
+    experts; routing weights renormalize after top-k. Configs use the
+    legacy mixformer schema (n_embd/n_layer/rotary_dim)."""
+    _hf_phi(hf, kw)
+    kw["hidden_size"] = hf.get("n_embd", 2560)
+    kw["num_hidden_layers"] = hf.get("n_layer", 32)
+    kw["num_attention_heads"] = hf.get("n_head", 32)
+    kw["num_key_value_heads"] = hf.get("n_head_kv") or kw["num_attention_heads"]
+    kw["intermediate_size"] = hf.get("n_inner") or 4 * kw["hidden_size"]
+    kw["max_position_embeddings"] = hf.get("n_positions", 2048)
+    kw["num_experts"] = hf.get("num_local_experts", 4)
+    kw["num_experts_per_tok"] = hf.get("num_experts_per_tok", 2)
+    kw["norm_topk_prob"] = True
+    kw["rms_norm_eps"] = hf.get("layer_norm_epsilon", 1e-5)
+    kw["hidden_act"] = hf.get("activation_function", "gelu_new")
+    kw["lm_head_bias"] = True
+    if "rotary_dim" in hf:
+        head_dim = kw["hidden_size"] // kw["num_attention_heads"]
+        kw["partial_rotary_factor"] = hf["rotary_dim"] / head_dim
+
+
 def _hf_cohere(hf, kw):
     """Cohere / Command-R: bias-free LayerNorm, parallel attn+mlp over
     one shared norm, interleaved rope, logits scaled by logit_scale,
@@ -726,6 +810,10 @@ _HF_BUILDERS = {
     "qwen3_moe": _hf_qwen3_moe,
     "phi": _hf_phi,
     "cohere": _hf_cohere,
+    "qwen": _hf_qwen,
+    "deci": _hf_deci,
+    "gpt_bigcode": _hf_gptbigcode,
+    "phixtral": _hf_phixtral,
 }
 
 

@@ -76,9 +76,13 @@ def init_params(
         E = config.num_experts
         EI = config.moe_intermediate_size or I
         layers["router"] = w((L, E, H))
-        layers["w_gate_e"] = w((L, E, EI, H))
+        if config.gated_mlp:
+            layers["w_gate_e"] = w((L, E, EI, H))
         layers["w_up_e"] = w((L, E, EI, H))
         layers["w_down_e"] = w((L, E, H, EI))
+        if not config.gated_mlp and config.mlp_bias:
+            layers["b_up_e"] = jnp.zeros((L, E, EI), dtype)
+            layers["b_down_e"] = jnp.zeros((L, E, H), dtype)
         if config.shared_expert_intermediate_size:
             S = config.shared_expert_intermediate_size
             layers["w_gate_s"] = w((L, S, H))
@@ -359,14 +363,25 @@ def _moe_router(config: ModelConfig, xc: jax.Array, p: Params):
 
 
 def _expert_ffn(config: ModelConfig, xe: jax.Array, p: Params, compute_dtype):
-    """Per-expert gated FFN on already-grouped tokens: [E, C, H] -> [E, C, H]."""
-    wg = _deq(p["w_gate_e"], compute_dtype)  # [E, I, H]
-    wu = _deq(p["w_up_e"], compute_dtype)
+    """Per-expert FFN on already-grouped tokens: [E, C, H] -> [E, C, H].
+    Gated (mixtral/qwen2-moe) or plain fc->act->proj with biases
+    (phixtral's phi-2 experts, gated_mlp=False)."""
+    wu = _deq(p["w_up_e"], compute_dtype)  # [E, I, H]
     wd = _deq(p["w_down_e"], compute_dtype)  # [E, H, I]
-    g = jnp.einsum("ech,eih->eci", xe, wg, preferred_element_type=compute_dtype)
     u = jnp.einsum("ech,eih->eci", xe, wu, preferred_element_type=compute_dtype)
-    z = _act(config.hidden_act, g) * u
-    return jnp.einsum("eci,ehi->ech", z, wd, preferred_element_type=compute_dtype)
+    if config.gated_mlp:
+        wg = _deq(p["w_gate_e"], compute_dtype)  # [E, I, H]
+        g = jnp.einsum("ech,eih->eci", xe, wg,
+                       preferred_element_type=compute_dtype)
+        z = _act(config.hidden_act, g) * u
+    else:
+        if "b_up_e" in p:
+            u = u + p["b_up_e"].astype(compute_dtype)[:, None, :]
+        z = _act(config.hidden_act, u)
+    out = jnp.einsum("eci,ehi->ech", z, wd, preferred_element_type=compute_dtype)
+    if not config.gated_mlp and "b_down_e" in p:
+        out = out + p["b_down_e"].astype(compute_dtype)[:, None, :]
+    return out
 
 
 def _moe_dispatch_ragged(
@@ -426,13 +441,22 @@ def _moe_dispatch_dense(
     (models/deepseek.py)."""
     onehot = jax.nn.one_hot(topi, config.num_experts, dtype=jnp.float32)
     combine = jnp.einsum("btk,btke->bte", topv, onehot)
-    wg = _deq(p["w_gate_e"], compute_dtype)  # [E, I, H]
-    wu = _deq(p["w_up_e"], compute_dtype)
+    wu = _deq(p["w_up_e"], compute_dtype)  # [E, I, H]
     wd = _deq(p["w_down_e"], compute_dtype)  # [E, H, I]
-    g = jnp.einsum("bth,eih->btei", xc, wg, preferred_element_type=compute_dtype)
     u = jnp.einsum("bth,eih->btei", xc, wu, preferred_element_type=compute_dtype)
-    z = _act(config.hidden_act, g) * u
+    if config.gated_mlp:
+        wg = _deq(p["w_gate_e"], compute_dtype)  # [E, I, H]
+        g = jnp.einsum("bth,eih->btei", xc, wg,
+                       preferred_element_type=compute_dtype)
+        z = _act(config.hidden_act, g) * u
+    else:  # phixtral: plain biased fc1 -> act; biases ride inside each
+        # expert's weighted term, exactly like HF's per-expert MLP call
+        if "b_up_e" in p:
+            u = u + p["b_up_e"].astype(compute_dtype)[None, None]
+        z = _act(config.hidden_act, u)
     d = jnp.einsum("btei,ehi->bteh", z, wd, preferred_element_type=compute_dtype)
+    if not config.gated_mlp and "b_down_e" in p:
+        d = d + p["b_down_e"].astype(compute_dtype)[None, None]
     return jnp.einsum("bteh,bte->bth", d, combine.astype(compute_dtype))
 
 
@@ -578,6 +602,18 @@ def forward(
     else:
         cos = sin = None
 
+    # qwen v1 logn attention (HF modeling_qwen logn_tensor; reference
+    # models/qwen.py): queries beyond the training length scale by
+    # log_train_len(pos+1) so attention entropy stays flat as the
+    # context grows. max(1, .) keeps in-distribution positions exact.
+    logn_col = None
+    if config.logn_attn and config.logn_train_len:
+        i = positions.astype(jnp.float32) + 1.0
+        logn = jnp.maximum(
+            jnp.log(i) / jnp.log(jnp.float32(config.logn_train_len)), 1.0
+        )
+        logn_col = logn[:, :, None, None].astype(compute_dtype)
+
     # Prefill goes through the Pallas flash-attention kernel (no [T,S]
     # score matrix in HBM); decode and the differentiable cache-free
     # training path use the fused XLA attention. Mirrors the reference's
@@ -697,6 +733,8 @@ def forward(
             else:
                 cos_l, sin_l = cos, sin
             q, k = apply_rotary_emb(q, k, cos_l, sin_l, config.rope_interleaved)
+        if logn_col is not None:
+            q = q * logn_col
 
         if c is not None:
             c = kvcache.update_layer(c, idx, k, v)

@@ -53,6 +53,9 @@ def layer_specs(config: ModelConfig) -> dict:
             "w_gate_e": P(None, "tp", None, None),
             "w_up_e": P(None, "tp", None, None),
             "w_down_e": P(None, "tp", None, None),
+            # phixtral non-gated expert biases ride the expert axis
+            "b_up_e": P(None, "tp", None),
+            "b_down_e": P(None, "tp", None),
         })
         if config.shared_expert_intermediate_size:
             specs.update({

@@ -512,3 +512,236 @@ def test_alias_model_types_registered():
          "num_attention_heads": 4, "bias": True}
     )
     assert cfg.attention_bias and cfg.attention_out_bias
+
+
+def test_gptbigcode_equivalence():
+    """starcoder v1: MQA (1 kv head), learned positions, layernorm,
+    non-gated gelu MLP, fused [H + 2*head_dim] c_attn."""
+    cfg, model = hf_tiny(
+        "GPTBigCodeForCausalLM", "GPTBigCodeConfig",
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_inner=128,
+        n_positions=64, multi_query=True,
+        activation_function="gelu_pytorch_tanh",
+    )
+    config = check(cfg, model)
+    assert config.model_type == "gpt_bigcode"
+    assert config.num_key_value_heads == 1 and config.learned_positions
+    assert not config.gated_mlp
+
+
+def test_deci_kv_replication_exact_and_ingest():
+    """DeciLM's variable GQA: (a) math — attention over r-replicated kv
+    heads equals GQA with the original head count; (b) plumbing — the
+    deci ingest path replicates to the uniform max and matches an HF
+    llama oracle holding the replicated weights."""
+    rng = np.random.default_rng(0)
+    # (a) numpy: GQA(2 kv heads, 4 q heads) == MHA over repeat(kv, 2)
+    Hq, Hkv, D, T = 4, 2, 8, 5
+    q = rng.standard_normal((T, Hq, D)).astype(np.float64)
+    k2 = rng.standard_normal((T, Hkv, D)).astype(np.float64)
+    v2 = rng.standard_normal((T, Hkv, D)).astype(np.float64)
+
+    def attn(qh, kh, vh):  # causal single-head
+        s = qh @ kh.T / np.sqrt(D)
+        s = np.where(np.tril(np.ones((T, T))) == 1, s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return p @ vh
+
+    gqa = np.stack([attn(q[:, h], k2[:, h // 2], v2[:, h // 2])
+                    for h in range(Hq)], 1)
+    k4, v4 = np.repeat(k2, 2, axis=1), np.repeat(v2, 2, axis=1)
+    rep = np.stack([attn(q[:, h], k4[:, h], v4[:, h]) for h in range(Hq)], 1)
+    np.testing.assert_allclose(gqa, rep, rtol=1e-12, atol=1e-12)
+
+    # (b) ingest: deci sd with per-layer kv heads (2 then 4) vs an HF
+    # llama oracle whose layer-0 kv weights are head-replicated
+    cfg, model = hf_tiny(
+        "LlamaForCausalLM", "LlamaConfig",
+        **{**COMMON, "num_key_value_heads": 4},
+    )
+    sd = {k: v.clone() for k, v in model.state_dict().items()}
+    D = 64 // 4
+    for nm in ("k_proj", "v_proj"):
+        w4 = sd[f"model.layers.0.self_attn.{nm}.weight"]
+        # deci layer 0 stores only heads 0 and 2; the oracle llama gets
+        # them replicated (0,0,2,2)
+        w2 = w4.reshape(4, D, -1)[::2].reshape(2 * D, -1)
+        sd[f"model.layers.0.self_attn.{nm}.weight"] = w2
+        model.state_dict()[f"model.layers.0.self_attn.{nm}.weight"].copy_(
+            torch.from_numpy(
+                np.repeat(w2.numpy().reshape(2, D, -1), 2, axis=0)
+                .reshape(4 * D, -1)
+            )
+        )
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(TOKENS).long()).logits.numpy()
+    hf_cfg = cfg.to_dict()
+    hf_cfg["model_type"] = "deci"
+    hf_cfg["num_key_value_heads_per_layer"] = [2, 4]
+    config = ModelConfig.from_hf_config(hf_cfg)
+    assert config.model_type == "deci" and config.num_key_value_heads == 4
+    ours = run_ours(config, sd, TOKENS)
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-3, atol=2e-3)
+
+
+def test_qwen_v1_mlp_and_logn():
+    """Qwen v1: (a) the w1/w2 MLP mapping — ours must compute
+    c_proj(w1(x) * silu(w2(x))); (b) logn scaling matches HF's
+    logn_list definition; (c) fused-c_attn ingest generates."""
+    rng = np.random.default_rng(1)
+    H, I = 16, 24
+    x = rng.standard_normal((3, H)).astype(np.float32)
+    w1 = rng.standard_normal((I, H)).astype(np.float32) * 0.1
+    w2 = rng.standard_normal((I, H)).astype(np.float32) * 0.1
+    cp = rng.standard_normal((H, I)).astype(np.float32) * 0.1
+
+    def silu(a):
+        return a / (1 + np.exp(-a))
+
+    want = (w1 @ x.T).T * silu((w2 @ x.T).T) @ cp.T
+
+    from bigdl_tpu.models.llama import _act
+    g = jnp.asarray((w2 @ x.T).T)  # our w_gate = qwen w2
+    u = jnp.asarray((w1 @ x.T).T)  # our w_up = qwen w1
+    ours = np.asarray(_act("silu", g) * u) @ cp.T
+    np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-5)
+
+    # (b) HF: logn_list[i-1] = log(i, seq_length) if i > seq_length else 1
+    seq_len = 16
+    cfg = ModelConfig(
+        model_type="qwen", vocab_size=64, hidden_size=32,
+        intermediate_size=32, num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2, logn_attn=True, logn_train_len=seq_len,
+        max_position_embeddings=64, attention_bias=True,
+        attention_out_bias=False,
+    )
+    pos = np.arange(40)
+    want_scale = np.asarray([
+        np.log(i) / np.log(seq_len) if i > seq_len else 1.0
+        for i in pos + 1
+    ])
+    got = np.maximum(np.log(pos + 1.0) / np.log(float(seq_len)), 1.0)
+    np.testing.assert_allclose(got, want_scale, rtol=1e-6)
+
+    # (c) ingest a fused-c_attn state dict and generate
+    sd = {}
+    L, V = 1, 64
+    Hs = 32
+    sd["transformer.wte.weight"] = rng.standard_normal((V, Hs)).astype(np.float32)
+    sd["transformer.ln_f.weight"] = np.ones(Hs, np.float32)
+    sd["lm_head.weight"] = rng.standard_normal((V, Hs)).astype(np.float32)
+    p = "transformer.h.0."
+    sd[p + "ln_1.weight"] = np.ones(Hs, np.float32)
+    sd[p + "ln_2.weight"] = np.ones(Hs, np.float32)
+    sd[p + "attn.c_attn.weight"] = rng.standard_normal((3 * Hs, Hs)).astype(np.float32) * 0.05
+    sd[p + "attn.c_attn.bias"] = rng.standard_normal(3 * Hs).astype(np.float32) * 0.05
+    sd[p + "attn.c_proj.weight"] = rng.standard_normal((Hs, Hs)).astype(np.float32) * 0.05
+    sd[p + "mlp.w1.weight"] = rng.standard_normal((48, Hs)).astype(np.float32) * 0.05
+    sd[p + "mlp.w2.weight"] = rng.standard_normal((48, Hs)).astype(np.float32) * 0.05
+    sd[p + "mlp.c_proj.weight"] = rng.standard_normal((Hs, 48)).astype(np.float32) * 0.05
+    qcfg = ModelConfig.from_hf_config({
+        "model_type": "qwen", "vocab_size": V, "hidden_size": Hs,
+        "intermediate_size": 96, "num_hidden_layers": 1,
+        "num_attention_heads": 2, "seq_length": 16, "use_logn_attn": True,
+        "layer_norm_epsilon": 1e-6,
+    })
+    assert qcfg.intermediate_size == 48  # halved-ff convention
+    assert qcfg.logn_attn and qcfg.logn_train_len == 16
+    params = params_from_state_dict(qcfg, sd.__getitem__, qtype="bf16")
+    from bigdl_tpu.api import TpuModel
+
+    out = TpuModel(qcfg, params, "bf16").generate(
+        [[3, 1, 4, 1, 5]], max_new_tokens=24  # crosses logn_train_len
+    )
+    assert out.shape == (1, 24)
+
+
+def test_phixtral_moe_matches_torch_oracle():
+    """Non-gated MoE block vs a torch re-implementation of the phixtral
+    routing (softmax -> topk -> renorm -> biased fc1/gelu/fc2 experts,
+    reference models/phixtral.py:44-70)."""
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(2)
+    B, T, H, I, E, K = 2, 3, 16, 24, 4, 2
+    x = rng.standard_normal((B, T, H)).astype(np.float32)
+    gate = rng.standard_normal((E, H)).astype(np.float32) * 0.5
+    fc1 = rng.standard_normal((E, I, H)).astype(np.float32) * 0.3
+    b1 = rng.standard_normal((E, I)).astype(np.float32) * 0.1
+    fc2 = rng.standard_normal((E, H, I)).astype(np.float32) * 0.3
+    b2 = rng.standard_normal((E, H)).astype(np.float32) * 0.1
+
+    xt = torch.from_numpy(x).reshape(-1, H)
+    logits = xt @ torch.from_numpy(gate).T
+    weights = F.softmax(logits, dim=1, dtype=torch.float)
+    topw, tope = torch.topk(weights, K, dim=-1)
+    topw = topw / topw.sum(-1, keepdim=True)
+    want = torch.zeros_like(xt)
+    for n in range(xt.shape[0]):
+        for j in range(K):
+            e = int(tope[n, j])
+            h = F.gelu(xt[n] @ torch.from_numpy(fc1[e]).T
+                       + torch.from_numpy(b1[e]), approximate="tanh")
+            want[n] += topw[n, j] * (
+                h @ torch.from_numpy(fc2[e]).T + torch.from_numpy(b2[e])
+            )
+    want = want.reshape(B, T, H).numpy()
+
+    from bigdl_tpu.models.llama import _moe_mlp
+
+    cfg = ModelConfig(
+        model_type="phixtral", vocab_size=32, hidden_size=H,
+        intermediate_size=I, num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2, num_experts=E, num_experts_per_tok=K,
+        norm_topk_prob=True, gated_mlp=False, mlp_bias=True,
+        hidden_act="gelu_pytorch_tanh",
+    )
+    p = {"router": jnp.asarray(gate), "w_up_e": jnp.asarray(fc1),
+         "b_up_e": jnp.asarray(b1), "w_down_e": jnp.asarray(fc2),
+         "b_down_e": jnp.asarray(b2)}
+    for dispatch in ("dense", "ragged"):
+        cfg2 = ModelConfig(**{**cfg.__dict__, "moe_dispatch": dispatch,
+                              "moe_capacity_factor": 4.0})
+        got = np.asarray(_moe_mlp(cfg2, jnp.asarray(x), p, jnp.float32))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_phixtral_ingest_and_generate():
+    """Legacy mixformer naming (mixer.Wqkv, moe.mlp.{e}, lm_head.ln)
+    ingests and generates."""
+    rng = np.random.default_rng(3)
+    H, I, V, E = 32, 48, 64, 4
+    sd = {}
+    sd["transformer.embd.wte.weight"] = rng.standard_normal((V, H)).astype(np.float32)
+    sd["lm_head.ln.weight"] = np.ones(H, np.float32)
+    sd["lm_head.ln.bias"] = np.zeros(H, np.float32)
+    sd["lm_head.linear.weight"] = rng.standard_normal((V, H)).astype(np.float32) * 0.1
+    sd["lm_head.linear.bias"] = np.zeros(V, np.float32)
+    p = "transformer.h.0."
+    sd[p + "ln.weight"] = np.ones(H, np.float32)
+    sd[p + "ln.bias"] = np.zeros(H, np.float32)
+    sd[p + "mixer.Wqkv.weight"] = rng.standard_normal((3 * H, H)).astype(np.float32) * 0.05
+    sd[p + "mixer.Wqkv.bias"] = np.zeros(3 * H, np.float32)
+    sd[p + "mixer.out_proj.weight"] = rng.standard_normal((H, H)).astype(np.float32) * 0.05
+    sd[p + "mixer.out_proj.bias"] = np.zeros(H, np.float32)
+    sd[p + "moe.gate.weight"] = rng.standard_normal((E, H)).astype(np.float32) * 0.1
+    for e in range(E):
+        ep = f"{p}moe.mlp.{e}."
+        sd[ep + "fc1.weight"] = rng.standard_normal((I, H)).astype(np.float32) * 0.05
+        sd[ep + "fc1.bias"] = np.zeros(I, np.float32)
+        sd[ep + "fc2.weight"] = rng.standard_normal((H, I)).astype(np.float32) * 0.05
+        sd[ep + "fc2.bias"] = np.zeros(H, np.float32)
+    cfg = ModelConfig.from_hf_config({
+        "model_type": "phixtral", "vocab_size": V, "n_embd": H,
+        "n_layer": 1, "n_head": 2, "n_inner": I, "n_positions": 64,
+        "rotary_dim": 8, "num_local_experts": E, "num_experts_per_tok": 2,
+        "layer_norm_epsilon": 1e-5, "activation_function": "gelu_new",
+    })
+    assert cfg.num_experts == E and not cfg.gated_mlp and cfg.norm_topk_prob
+    assert cfg.partial_rotary_factor == pytest.approx(8 / 16)
+    params = params_from_state_dict(cfg, sd.__getitem__, qtype="bf16")
+    from bigdl_tpu.api import TpuModel
+
+    out = TpuModel(cfg, params, "bf16").generate([[3, 1, 4]], max_new_tokens=5)
+    assert out.shape == (1, 5)
