@@ -37,6 +37,7 @@ from bigdl_tpu.quant.qtypes import resolve_qtype
 
 _QUANT_TARGETS = {
     "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    "wqkv",  # pre-fused checkpoints ingested fused (baichuan_m1 W_pack)
     "w_gate_e", "w_up_e", "w_down_e", "w_gate_s", "w_up_s", "w_down_s",
     # rwkv projections (models/rwkv.py)
     "att_k", "att_v", "att_r", "att_g", "att_o", "ffn_k", "ffn_r", "ffn_v",
@@ -966,6 +967,24 @@ def _phixtral_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
     }
 
 
+def _baichuan_m1_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Baichuan-M1: fused W_pack qkv + per-kv-head kernel-2 conv taps
+    (HF conv_k/conv_v [1, 1, Hkv, 1, 2] -> [Hkv, 2])."""
+    p = f"model.layers.{i}."
+    Hkv = config.num_key_value_heads
+    return {
+        "attn_norm": get(p + "input_layernorm.weight"),
+        "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        "wqkv": get(p + "self_attn.W_pack.weight"),
+        "wo": get(p + "self_attn.o_proj.weight"),
+        "conv_k": get(p + "self_attn.conv_k").reshape(Hkv, 2).astype(np.float32),
+        "conv_v": get(p + "self_attn.conv_v").reshape(Hkv, 2).astype(np.float32),
+        "w_gate": get(p + "mlp.gate_proj.weight"),
+        "w_up": get(p + "mlp.up_proj.weight"),
+        "w_down": get(p + "mlp.down_proj.weight"),
+    }
+
+
 _FAMILY_LAYER = {
     "gemma2": _gemma2_layer,
     "gemma3": _gemma3_layer,
@@ -998,6 +1017,7 @@ _FAMILY_LAYER = {
     "deci": _deci_layer,
     "gpt_bigcode": _gptbigcode_layer,
     "phixtral": _phixtral_layer,
+    "baichuan_m1": _baichuan_m1_layer,
 }
 
 _FAMILY_TOP = {

@@ -60,6 +60,12 @@ from bigdl_tpu.models import qwen2_vl  # noqa: E402  (delegates text to llama)
 
 _FAMILIES["qwen2_vl"] = qwen2_vl
 
+from bigdl_tpu.models import qwen_vl  # noqa: E402  (delegates text to llama)
+
+# Qwen-VL checkpoints ship model_type "qwen" + a `visual` dict; the
+# text side is the qwen v1 decoder, the tower/resampler live here
+_FAMILIES["qwen_vl"] = qwen_vl
+
 from bigdl_tpu.models import minicpmv  # noqa: E402  (delegates text to llama)
 
 _FAMILIES["minicpmv"] = minicpmv
@@ -90,6 +96,12 @@ from bigdl_tpu.models import yuan  # noqa: E402  (LFA conv-filtered attention)
 # yuan's cache composes the KV cache with the conv-filter state, so it
 # has its own module + init_cache hook (models/yuan.py)
 _FAMILIES["yuan"] = yuan
+
+from bigdl_tpu.models import baichuan_m1  # noqa: E402  (conv-enhanced KV)
+
+# baichuan-m1 convolves K/V over time and carries the pre-conv tail in
+# its cache (models/baichuan_m1.py), like yuan's filter state
+_FAMILIES["baichuan_m1"] = baichuan_m1
 
 from bigdl_tpu.models import rwkv  # noqa: E402  (attention-free recurrence)
 

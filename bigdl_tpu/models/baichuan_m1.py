@@ -1,0 +1,240 @@
+"""Baichuan-M1 family — llama-shaped decoder with conv-enhanced KV.
+
+TPU-native re-design of the reference's patched forward
+(/root/reference/python/llm/src/ipex_llm/transformers/models/baichuan_m1.py):
+before rope, the per-head K and V streams pass a kernel-2 causal
+convolution over time (custom_convolution, baichuan_m1.py:41-55) —
+K'[t] = w0*K[t-1] + w1*K[t] with zero padding at the sequence start —
+and decode carries the PRE-conv K/V of the previous token so the next
+step can finish its convolution (the reference stashes them as
+`self.last_k/last_v`, baichuan_m1.py:186-203). A kernel-2 conv over time
+is a shift + two broadcast multiplies here, no conv op.
+
+`BaichuanM1Cache` composes the standard KV pool (which stores the
+CONVOLVED k/v — what attention reads) with the [L, B, Hkv, D] pre-conv
+tails, like yuan's filter state. The reference ignores the config's
+sliding window (baichuan_m1.py:216 "ignore sliding window"); so do we.
+
+Left padding: pad positions zero their pre-conv k/v, so the first real
+token's convolution sees zeros — exactly HF's zero-padded, unpadded
+single-sequence semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.kvcache import KVCache
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import apply_rotary_emb, attention, linear, rms_norm, rope_cos_sin
+from bigdl_tpu.ops.rope import make_inv_freq_scaled
+
+Params = dict[str, Any]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BaichuanM1Cache:
+    kv: KVCache  # stores the CONVOLVED k/v
+    last_k: jax.Array  # [L, B, Hkv, D] f32: pre-conv K of the last token
+    last_v: jax.Array
+    start: jax.Array  # [B]
+
+    @property
+    def pos(self):
+        return self.kv.pos
+
+
+def init_cache(
+    config: ModelConfig,
+    batch: int,
+    cache_len: int,
+    quantize_kv: bool = False,
+    dtype=jnp.bfloat16,
+) -> BaichuanM1Cache:
+    L, Hkv, D = (config.num_hidden_layers, config.num_key_value_heads,
+                 config.head_dim_)
+    kv = kvcache.init_cache(
+        L, batch, cache_len, Hkv, D, quantize_kv=quantize_kv, dtype=dtype,
+    )
+    # two distinct buffers: the engine donates the whole cache, and jax
+    # rejects donating one aliased buffer through two arguments
+    return BaichuanM1Cache(
+        kv=kv,
+        last_k=jnp.zeros((L, batch, Hkv, D), jnp.float32),
+        last_v=jnp.zeros((L, batch, Hkv, D), jnp.float32),
+        start=kv.start,
+    )
+
+
+# --- serving-engine adapter (serving/engine.py custom-cache protocol) ---
+
+def engine_pool(config: ModelConfig, n_slots: int, max_len: int):
+    cache = init_cache(config, n_slots, max_len)
+    kv = dataclasses.replace(cache.kv, pos=jnp.zeros((n_slots,), jnp.int32))
+    return dataclasses.replace(cache, kv=kv)
+
+
+def engine_insert(cache, pcache, slot, pad):
+    kv = kvcache.insert_row(cache.kv, pcache.kv, slot, pad)
+    return dataclasses.replace(
+        cache, kv=kv,
+        last_k=cache.last_k.at[:, slot].set(pcache.last_k[:, 0]),
+        last_v=cache.last_v.at[:, slot].set(pcache.last_v[:, 0]),
+        start=kv.start,
+    )
+
+
+def init_params(
+    config: ModelConfig,
+    key: jax.Array,
+    dtype=jnp.bfloat16,
+    scale: float = 0.02,
+) -> Params:
+    """Random dense init (tests/benchmarks run without checkpoints)."""
+    L, H, I = (config.num_hidden_layers, config.hidden_size,
+               config.intermediate_size)
+    V, QD, KD = config.vocab_size, config.q_dim, config.kv_dim
+    Hkv, D = config.num_key_value_heads, config.head_dim_
+    keys = iter(jax.random.split(key, 16))
+
+    def w(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((L, H), dtype),
+        "mlp_norm": jnp.ones((L, H), dtype),
+        "wqkv": w((L, QD + 2 * KD, H)),  # W_pack, fused
+        "wo": w((L, H, QD)),
+        "w_gate": w((L, I, H)), "w_up": w((L, I, H)), "w_down": w((L, H, I)),
+        # per-kv-head kernel-2 conv taps (HF conv_k/conv_v [1,1,h,1,2])
+        "conv_k": jnp.full((L, Hkv, 2), 0.5, jnp.float32),
+        "conv_v": jnp.full((L, Hkv, 2), 0.5, jnp.float32),
+    }
+    return {
+        "embed": w((V, H)),
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype),
+        "lm_head": w((V, H)),
+    }
+
+
+def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = None) -> Params:
+    """llama's quantizer covers the tree (wqkv/wo/gate/up/down); the tiny
+    f32 conv taps stay dense."""
+    return llama.quantize_params(params, qtype, lm_head_qtype)
+
+
+def forward(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32
+    cache: Optional[BaichuanM1Cache],
+    mode: str = "prefill",
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = False,
+) -> tuple[jax.Array, Optional[BaichuanM1Cache]]:
+    """Returns (logits [B, T, V] float32, advanced cache)."""
+    assert mode in ("prefill", "decode")
+    B, T = tokens.shape
+    Hq, Hkv, D = (config.num_attention_heads, config.num_key_value_heads,
+                  config.head_dim_)
+    QD, KD = config.q_dim, config.kv_dim
+    eps = config.rms_norm_eps
+
+    fresh = cache is None
+    if fresh:
+        cache = init_cache(config, B, T)
+    kv = dataclasses.replace(cache.kv, start=cache.start)
+
+    pos_col = kv.pos[:, None] if kv.pos.ndim == 1 else kv.pos
+    slots = pos_col + jnp.arange(T)[None, :]  # [B|1, T]
+    positions = kv.next_positions(T)  # [B, T]
+    real = (slots >= cache.start[:, None]).astype(jnp.float32)
+    if real.shape[0] != B:
+        real = jnp.broadcast_to(real, (B, T))
+
+    from bigdl_tpu.embedding import embed_lookup
+
+    h = embed_lookup(params["embed"], tokens, compute_dtype)
+
+    inv_freq, att_scale = make_inv_freq_scaled(
+        config.rotary_dim, config.rope_theta, config.rope_scaling_dict,
+        seq_len=kv.max_len,
+    )
+    cos, sin = rope_cos_sin(positions, inv_freq, scale=att_scale)
+
+    S = kv.max_len
+    sj = jnp.arange(S)
+    mask = (sj[None, None, :] <= slots[..., None]) & (
+        sj[None, None, :] >= cache.start[:, None, None]
+    )  # [B, T, S]
+    mask = mask[:, None, None]  # [B,1,1,T,S]
+
+    realc = real[:, :, None, None]  # [B, T, 1, 1]
+
+    def conv2(u, taps, last):
+        """Kernel-2 causal conv over time, per kv head: u [B,T,Hkv,D] f32
+        (pads already zeroed), taps [Hkv, 2], last [B,Hkv,D] the pre-conv
+        value at slot pos-1 (zeros on fresh prefill)."""
+        prev = jnp.concatenate([last[:, None], u[:, :-1]], axis=1)
+        w0 = taps[None, None, :, 0, None]
+        w1 = taps[None, None, :, 1, None]
+        return w0 * prev + w1 * u
+
+    def body(carry, xs):
+        hidden, c, idx = carry
+        p, lk, lv = xs
+
+        x = rms_norm(hidden, p["attn_norm"], eps)
+        qkv = linear(x, p["wqkv"], None, compute_dtype)
+        q = qkv[..., :QD].reshape(B, T, Hq, D)
+        k = qkv[..., QD:QD + KD].reshape(B, T, Hkv, D).astype(jnp.float32)
+        v = qkv[..., QD + KD:].reshape(B, T, Hkv, D).astype(jnp.float32)
+
+        # zero pads BEFORE the conv so the first real token convolves
+        # against zeros (HF's zero padding at the true sequence start)
+        k = k * realc
+        v = v * realc
+        kc = conv2(k, p["conv_k"], lk).astype(compute_dtype)
+        vc = conv2(v, p["conv_v"], lv).astype(compute_dtype)
+        new_lk, new_lv = k[:, -1], v[:, -1]
+
+        q, kc = apply_rotary_emb(q, kc, cos, sin, False)
+
+        c = kvcache.update_layer(c, idx, kc, vc)
+        k_att, v_att = kvcache.read_layer(c, idx, compute_dtype)
+        attn = attention(q, k_att, v_att, mask)
+        out = linear(attn.reshape(B, T, Hq * D), p["wo"], None, compute_dtype)
+        hidden = hidden + out
+
+        x2 = rms_norm(hidden, p["mlp_norm"], eps)
+        gate = linear(x2, p["w_gate"], None, compute_dtype)
+        up = linear(x2, p["w_up"], None, compute_dtype)
+        hidden = hidden + linear(
+            jax.nn.silu(gate) * up, p["w_down"], None, compute_dtype
+        )
+        return (hidden, c, idx + 1), (new_lk, new_lv)
+
+    (h, kv, _), (new_lk, new_lv) = jax.lax.scan(
+        body, (h, kv, jnp.zeros((), jnp.int32)),
+        (params["layers"], cache.last_k, cache.last_v),
+    )
+
+    if last_logits_only:
+        h = h[:, -1:]
+    hN = rms_norm(h, params["final_norm"], eps)
+    logits = linear(hN, params["lm_head"], None, compute_dtype).astype(jnp.float32)
+
+    if fresh:
+        return logits, None
+    kv = kvcache.advance(kv, T)
+    return logits, BaichuanM1Cache(
+        kv=kv, last_k=new_lk, last_v=new_lv, start=cache.start
+    )

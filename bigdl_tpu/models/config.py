@@ -556,6 +556,14 @@ def _hf_phi(hf, kw):
         raise NotImplementedError("phi with qk_layernorm=True")
 
 
+def _hf_baichuan_m1(hf, kw):
+    """Baichuan-M1: llama numerics + fused W_pack + kernel-2 K/V conv
+    (models/baichuan_m1.py). The reference ignores the config's sliding
+    window (baichuan_m1.py:216); so do we."""
+    kw.setdefault("attention_bias", False)
+    kw.pop("sliding_window", None)
+
+
 def _hf_qwen(hf, kw):
     """Qwen v1 (Qwen-7B/14B remote code, reference models/qwen.py):
     fused biased c_attn, bias-free c_proj, RMSNorm, MHA, and an MLP
@@ -571,6 +579,8 @@ def _hf_qwen(hf, kw):
     if hf.get("use_logn_attn"):
         kw["logn_attn"] = True
         kw["logn_train_len"] = hf.get("seq_length", 8192)
+    if "visual" in hf:  # Qwen-VL: <img>pad...pad</img> placeholders
+        kw["image_token_id"] = hf["visual"].get("image_start_id", 151857) + 2
     # qwen's dynamic NTK adapts the rope base to the live sequence
     # length; fixed-shape TPU programs pin it at the training length
     # (exact within seq_length; longer contexts need an explicit
@@ -811,9 +821,11 @@ _HF_BUILDERS = {
     "phi": _hf_phi,
     "cohere": _hf_cohere,
     "qwen": _hf_qwen,
+    "qwen_vl": _hf_qwen,  # Qwen-VL ships model_type "qwen" + visual dict
     "deci": _hf_deci,
     "gpt_bigcode": _hf_gptbigcode,
     "phixtral": _hf_phixtral,
+    "baichuan_m1": _hf_baichuan_m1,
 }
 
 

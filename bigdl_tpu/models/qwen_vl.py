@@ -1,0 +1,239 @@
+"""Qwen-VL: OpenCLIP-style ViT + cross-attention resampler over the
+qwen v1 decoder.
+
+TPU-native counterpart of the reference's qwen_vl support
+(/root/reference/python/llm/src/ipex_llm/transformers/models/qwen_vl.py:
+qwen_vl_vision_transformer_forward :195-217, qwen_vl_resampler_forward
+:178-192, image insertion in qwen_vl_model_forward :268-380). Pipeline:
+
+- vision tower: conv patch embed (no cls token) + absolute positional
+  embedding, pre-LN residual blocks (fused in_proj attention, gelu MLP);
+- resampler: 256 learned queries cross-attend to the projected vision
+  features — q = ln_q(query) + pos_embed, k = ln_kv(kv_proj(x)) +
+  pos_embed, v WITHOUT positions (torch MultiheadAttention semantics);
+- head: ln_post then a final [E, E] projection matrix;
+- text: the qwen v1 decoder (fused c_attn, halved-ff MLP, logn) — the
+  llama family via the "qwen" ModelConfig flags; projected image
+  embeddings overwrite the placeholder positions between the
+  <img>/</img> markers (hidden[a+1:b] = images in the reference; here
+  the scatter keyed on config.image_token_id, like the other VL
+  families).
+
+Positional embeddings are used at their stored grid (448px/14 = 32x32
+patches pooled to 16x16 queries); get_abs_pos interpolation for other
+resolutions is asserted away rather than silently mis-scaled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import layer_norm
+
+# the text side delegates wholesale to the llama family (qwen v1 flags)
+init_params = llama.init_params
+quantize_params = llama.quantize_params
+forward = llama.forward
+merge_fused_params = llama.merge_fused_params
+unmerge_fused_params = llama.unmerge_fused_params
+
+
+@dataclasses.dataclass(frozen=True)
+class QwenVLVisionConfig:
+    image_size: int = 448
+    patch_size: int = 14
+    width: int = 1664  # tower hidden
+    layers: int = 48
+    heads: int = 16
+    mlp_ratio: float = 4.9231
+    output_dim: int = 4096  # resampler/query dim = text hidden
+    layer_norm_eps: float = 1e-6
+
+    @classmethod
+    def from_hf(cls, visual: dict) -> "QwenVLVisionConfig":
+        keys = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in visual.items() if k in keys})
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def n_queries(self) -> int:
+        return (self.grid // 2) ** 2  # resampler pools 2x2
+
+    @property
+    def mlp_dim(self) -> int:
+        return int(self.mlp_ratio * self.width)
+
+    @property
+    def patch_dim(self) -> int:
+        return 3 * self.patch_size ** 2
+
+
+def vision_params_from_state_dict(
+    vcfg: QwenVLVisionConfig, get, prefix="transformer.visual."
+) -> dict:
+    def g(name):
+        return np.asarray(get(prefix + name), np.float32)
+
+    W = vcfg.width
+    blocks: dict[str, list] = {}
+    names = [
+        ("ln1_w", "ln_1.weight"), ("ln1_b", "ln_1.bias"),
+        ("ln2_w", "ln_2.weight"), ("ln2_b", "ln_2.bias"),
+        ("in_w", "attn.in_proj.weight"), ("in_b", "attn.in_proj.bias"),
+        ("out_w", "attn.out_proj.weight"), ("out_b", "attn.out_proj.bias"),
+        ("fc_w", "mlp.c_fc.weight"), ("fc_b", "mlp.c_fc.bias"),
+        ("proj_w", "mlp.c_proj.weight"), ("proj_b", "mlp.c_proj.bias"),
+    ]
+    for i in range(vcfg.layers):
+        for key, suffix in names:
+            blocks.setdefault(key, []).append(
+                g(f"transformer.resblocks.{i}.{suffix}")
+            )
+    params = {
+        "conv1": g("conv1.weight").reshape(W, -1),  # [W, 3*ps*ps]
+        "pos_embed": g("positional_embedding"),  # [grid^2, W]
+        "ln_pre_w": g("ln_pre.weight"), "ln_pre_b": g("ln_pre.bias"),
+        "blocks": {k: np.stack(v) for k, v in blocks.items()},
+        "ln_post_w": g("ln_post.weight"), "ln_post_b": g("ln_post.bias"),
+        "proj": g("proj"),  # [E, E]
+        "rs_query": g("attn_pool.query"),  # [Q, E]
+        "rs_pos": g("attn_pool.pos_embed"),  # [Q, E] 2D sincos
+        "rs_kv_w": g("attn_pool.kv_proj.weight"),  # [E, W]
+        "rs_in_w": g("attn_pool.attn.in_proj_weight"),  # [3E, E]
+        "rs_in_b": g("attn_pool.attn.in_proj_bias"),
+        "rs_out_w": g("attn_pool.attn.out_proj.weight"),
+        "rs_out_b": g("attn_pool.attn.out_proj.bias"),
+        "rs_lnq_w": g("attn_pool.ln_q.weight"), "rs_lnq_b": g("attn_pool.ln_q.bias"),
+        "rs_lnkv_w": g("attn_pool.ln_kv.weight"), "rs_lnkv_b": g("attn_pool.ln_kv.bias"),
+    }
+    return jax.tree.map(jnp.asarray, params)
+
+
+def _mha(q, k, v, in_w, in_b, out_w, out_b, heads: int):
+    """torch.nn.MultiheadAttention semantics: fused in_proj applies Wq to
+    the query stream and Wk/Wv to the key/value streams; softmax over
+    keys; out_proj. q [B,Nq,E], k/v [B,Nk,E] -> [B,Nq,E]."""
+    E = q.shape[-1]
+    wq, wk, wv = in_w[:E], in_w[E:2 * E], in_w[2 * E:]
+    bq, bk, bv = in_b[:E], in_b[E:2 * E], in_b[2 * E:]
+    qp = jnp.einsum("bne,fe->bnf", q, wq) + bq
+    kp = jnp.einsum("bne,fe->bnf", k, wk) + bk
+    vp = jnp.einsum("bne,fe->bnf", v, wv) + bv
+    B, Nq, _ = qp.shape
+    Nk = kp.shape[1]
+    D = E // heads
+    qh = qp.reshape(B, Nq, heads, D)
+    kh = kp.reshape(B, Nk, heads, D)
+    vh = vp.reshape(B, Nk, heads, D)
+    att = jnp.einsum("bnhd,bmhd->bhnm", qh, kh) * (D ** -0.5)
+    att = jax.nn.softmax(att, axis=-1)
+    ctx = jnp.einsum("bhnm,bmhd->bnhd", att, vh).reshape(B, Nq, E)
+    return jnp.einsum("bne,fe->bnf", ctx, out_w) + out_b
+
+
+def image_features(
+    vcfg: QwenVLVisionConfig,
+    vparams: dict,
+    patches: jax.Array,  # [B, N, 3*ps*ps] flattened pixel patches
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """[B, N, patch_dim] -> [B, n_queries, output_dim]: the full
+    VisionTransformer.forward (conv -> +pos -> ln_pre -> blocks ->
+    resampler -> ln_post -> @proj)."""
+    B, N, _ = patches.shape
+    assert N == vcfg.grid ** 2, (
+        f"qwen_vl vision expects the stored {vcfg.grid}x{vcfg.grid} patch "
+        f"grid (got {N} patches); other resolutions need pos-embed "
+        "interpolation"
+    )
+    W, Hh = vcfg.width, vcfg.heads
+    eps = vcfg.layer_norm_eps
+
+    h = jnp.einsum(
+        "bnd,wd->bnw", patches.astype(jnp.float32), vparams["conv1"]
+    )
+    h = h + vparams["pos_embed"][None]
+    h = layer_norm(h, vparams["ln_pre_w"], vparams["ln_pre_b"], eps)
+
+    def block(h, p):
+        x = layer_norm(h, p["ln1_w"], p["ln1_b"], eps)
+        h = h + _mha(x, x, x, p["in_w"], p["in_b"], p["out_w"], p["out_b"], Hh)
+        x = layer_norm(h, p["ln2_w"], p["ln2_b"], eps)
+        x = jnp.einsum("bnw,fw->bnf", x, p["fc_w"]) + p["fc_b"]
+        x = jax.nn.gelu(x, approximate=False)
+        h = h + (jnp.einsum("bnf,wf->bnw", x, p["proj_w"]) + p["proj_b"])
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, vparams["blocks"])
+
+    # resampler: queries cross-attend (positions on q and k, not v)
+    E = vcfg.output_dim
+    kv = jnp.einsum("bnw,ew->bne", h, vparams["rs_kv_w"])
+    kv = layer_norm(kv, vparams["rs_lnkv_w"], vparams["rs_lnkv_b"], eps)
+    q = layer_norm(
+        vparams["rs_query"], vparams["rs_lnq_w"], vparams["rs_lnq_b"], eps
+    )
+    # tower grid (32x32) pools onto the query grid (16x16): k positions
+    # are the stored pos_embed interpolated by the reference's
+    # get_abs_pos; at the native resolution it is a 2x2 nearest
+    # average-free bicubic — we require the native grid and build the
+    # k-side positions by bilinear pooling of the query grid instead
+    kpos = _expand_pos(vparams["rs_pos"], vcfg.grid)
+    out = _mha(
+        jnp.broadcast_to(q[None] + vparams["rs_pos"][None], (B, q.shape[0], E)),
+        kv + kpos[None],
+        kv,
+        vparams["rs_in_w"], vparams["rs_in_b"],
+        vparams["rs_out_w"], vparams["rs_out_b"],
+        E // 128,
+    )
+    out = layer_norm(out, vparams["ln_post_w"], vparams["ln_post_b"], eps)
+    out = jnp.einsum("bqe,ef->bqf", out, vparams["proj"])
+    return out.astype(out_dtype)
+
+
+def _expand_pos(pos: jax.Array, tgt_grid: int) -> jax.Array:
+    """[q*q, E] query-grid sincos positions -> [tgt*tgt, E] via bicubic
+    resize (the reference's get_abs_pos, qwen_vl.py:24-42, which
+    F.interpolate(mode='bicubic')s the stored grid to the source size)."""
+    q = int(round(float(np.sqrt(pos.shape[0]))))
+    if q == tgt_grid:
+        return pos
+    grid = pos.reshape(q, q, -1)
+    out = jax.image.resize(
+        grid, (tgt_grid, tgt_grid, grid.shape[-1]), method="bicubic"
+    )
+    return out.reshape(tgt_grid * tgt_grid, -1)
+
+
+def multimodal_prefill(
+    config: ModelConfig,
+    vcfg: QwenVLVisionConfig,
+    params: dict,
+    vparams: dict,
+    input_ids: np.ndarray,  # [B, T] with image_token_id placeholders
+    patches: jax.Array,  # [B, N, patch_dim]
+    cache,
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = True,
+):
+    """Projected image features overwrite the placeholder positions
+    (the reference writes hidden[a+1:b] between the <img>/</img> ids;
+    here the scatter keys on config.image_token_id)."""
+    from bigdl_tpu.models._multimodal import scatter_image_features
+
+    img = image_features(vcfg, vparams, patches)  # [B, Q, E]
+    h = scatter_image_features(config, params, input_ids, img, compute_dtype)
+    return llama.forward(
+        config, params, h, cache, mode="prefill", input_is_hidden=True,
+        compute_dtype=compute_dtype, last_logits_only=last_logits_only,
+    )

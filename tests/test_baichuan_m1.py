@@ -1,0 +1,157 @@
+"""Baichuan-M1 family: conv-enhanced KV attention.
+
+Oracles: the torch custom_convolution from the reference
+(models/baichuan_m1.py:41-55) for the K/V conv; prefill-vs-decode state
+carry for the last_k/last_v tails; left-pad invariance; engine serving.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.api import TpuModel
+from bigdl_tpu.models import baichuan_m1, get_family
+from bigdl_tpu.models.config import ModelConfig
+
+CFG = ModelConfig(
+    model_type="baichuan_m1", vocab_size=96, hidden_size=32,
+    intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, max_position_embeddings=64,
+)
+TOKENS = np.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
+
+
+def torch_custom_convolution(U, K):
+    """Reference implementation (baichuan_m1.py custom_convolution)."""
+    import torch.nn.functional as F
+
+    w = K.size(-1)
+    padding = (w - 1, 0)
+    U_padded = F.pad(U, (0, 0, 0, 0, *padding))
+    U_unfolded = U_padded.unfold(1, w, 1)
+    V_unfolded = U_unfolded * K
+    return V_unfolded.sum(dim=-1)
+
+
+def test_conv2_matches_reference_convolution(rng):
+    B, T, Hkv, D = 2, 6, 3, 4
+    u = rng.standard_normal((B, T, Hkv, D)).astype(np.float32)
+    taps = rng.standard_normal((Hkv, 2)).astype(np.float32)
+
+    # reference: K shaped [1, 1, h, 1, w]
+    want = torch_custom_convolution(
+        torch.from_numpy(u), torch.from_numpy(taps).reshape(1, 1, Hkv, 1, 2)
+    ).numpy()
+
+    prev = jnp.concatenate(
+        [jnp.zeros((B, 1, Hkv, D)), jnp.asarray(u[:, :-1])], axis=1
+    )
+    got = np.asarray(
+        taps[None, None, :, 0, None] * prev
+        + taps[None, None, :, 1, None] * jnp.asarray(u)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_registered_and_generates():
+    assert get_family("baichuan_m1") is baichuan_m1
+    m = TpuModel(CFG, baichuan_m1.init_params(CFG, jax.random.PRNGKey(0)), "bf16")
+    out = m.generate([[3, 1, 4, 1, 5]], max_new_tokens=6)
+    assert out.shape == (1, 6)
+
+
+def test_decode_state_carry(rng):
+    """Prefill the full sequence vs prefill a prefix + decode the rest:
+    logits must agree (the carried pre-conv tails make decode exact)."""
+    params = baichuan_m1.init_params(CFG, jax.random.PRNGKey(1))
+    toks = jnp.asarray(TOKENS)
+    full, _ = baichuan_m1.forward(
+        CFG, params, toks, baichuan_m1.init_cache(CFG, 1, 16, dtype=jnp.float32),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    lg, st = baichuan_m1.forward(
+        CFG, params, toks[:, :5],
+        baichuan_m1.init_cache(CFG, 1, 16, dtype=jnp.float32),
+        mode="prefill", compute_dtype=jnp.float32,
+    )
+    for t in (5, 6, 7):
+        lg, st = baichuan_m1.forward(
+            CFG, params, toks[:, t:t + 1], st, mode="decode",
+            compute_dtype=jnp.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full[:, t]), rtol=3e-4, atol=3e-4
+        )
+
+
+def test_left_pad_invariance():
+    from bigdl_tpu.generate import GenerationConfig, generate_tokens, pad_prompts
+
+    params = baichuan_m1.init_params(CFG, jax.random.PRNGKey(2))
+    outs = []
+    for bucket in (8, 16):  # different left padding
+        tokens, start = pad_prompts([[7, 3, 9, 2, 5]], pad_id=0, bucket=bucket)
+        out = generate_tokens(
+            CFG, params, jnp.asarray(tokens), jnp.asarray(start),
+            jax.random.PRNGKey(0), GenerationConfig(max_new_tokens=6),
+            baichuan_m1.forward, cache_len=32,
+            cache_init=baichuan_m1.init_cache,
+        )
+        outs.append(np.asarray(out))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_ingest_translation(rng):
+    from bigdl_tpu.convert import params_from_state_dict
+
+    H, I, V = 32, 64, 96
+    QD, KD = CFG.q_dim, CFG.kv_dim
+    sd = {}
+    sd["model.embed_tokens.weight"] = rng.standard_normal((V, H)).astype(np.float32)
+    sd["model.norm.weight"] = np.ones(H, np.float32)
+    sd["lm_head.weight"] = rng.standard_normal((V, H)).astype(np.float32)
+    for i in range(2):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = np.ones(H, np.float32)
+        sd[p + "post_attention_layernorm.weight"] = np.ones(H, np.float32)
+        sd[p + "self_attn.W_pack.weight"] = rng.standard_normal(
+            (QD + 2 * KD, H)).astype(np.float32) * 0.05
+        sd[p + "self_attn.o_proj.weight"] = rng.standard_normal(
+            (H, QD)).astype(np.float32) * 0.05
+        sd[p + "self_attn.conv_k"] = rng.standard_normal(
+            (1, 1, 2, 1, 2)).astype(np.float32)
+        sd[p + "self_attn.conv_v"] = rng.standard_normal(
+            (1, 1, 2, 1, 2)).astype(np.float32)
+        sd[p + "mlp.gate_proj.weight"] = rng.standard_normal(
+            (I, H)).astype(np.float32) * 0.05
+        sd[p + "mlp.up_proj.weight"] = rng.standard_normal(
+            (I, H)).astype(np.float32) * 0.05
+        sd[p + "mlp.down_proj.weight"] = rng.standard_normal(
+            (H, I)).astype(np.float32) * 0.05
+    params = params_from_state_dict(CFG, sd.__getitem__, qtype="sym_int4")
+    from bigdl_tpu.quant import QTensor
+
+    assert isinstance(params["layers"]["wqkv"], QTensor)
+    assert params["layers"]["conv_k"].shape == (2, 2, 2)  # [L, Hkv, 2]
+    m = TpuModel(CFG, params, "sym_int4")
+    out = m.generate([[3, 1, 4]], max_new_tokens=4)
+    assert out.shape == (1, 4)
+
+
+def test_engine_serving_matches_generate():
+    from bigdl_tpu.serving.engine import InferenceEngine
+
+    m = TpuModel(CFG, baichuan_m1.init_params(CFG, jax.random.PRNGKey(3)), "bf16")
+    prompts = [[3, 1, 4, 1, 5], [2, 7]]
+    want = {tuple(p): m.generate([p], max_new_tokens=6)[0].tolist()
+            for p in prompts}
+    eng = InferenceEngine(m, n_slots=2, max_len=64)
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle(max_steps=100)
+    for p, r in zip(prompts, reqs):
+        assert r.done and r.out_tokens == want[tuple(p)], (p, r.out_tokens)
