@@ -71,6 +71,12 @@ def _child_setup():
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_tpu")
     import jax
 
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # structural testing without the chip. Must go through jax.config:
+        # the session sitecustomize force-registers the axon plugin and
+        # overrides jax_platforms, so JAX_PLATFORMS=cpu alone does NOT
+        # stop a child from claiming (and wedging on) the tunnel.
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
@@ -264,6 +270,181 @@ def child_decode(preset: str) -> dict:
 
 
 # --------------------------------------------------------------------------
+# child: Pallas kernel compile-smoke matrix
+# --------------------------------------------------------------------------
+
+def child_kernels() -> dict:
+    """Compile-smoke EVERY Pallas kernel at real model shapes on the
+    live device, banking a per-kernel ok/fail matrix. Interpret-mode CPU
+    tests cannot catch Mosaic failures (f16 vector ops, lane reshapes,
+    VMEM overflow — BENCH_NOTES.md r03), so this is the only way any
+    kernel is proven before it carries the decode headline.
+
+    The cumulative matrix is re-printed after every entry: a hang or
+    Mosaic crash mid-run still banks everything before it (the parent
+    parses the LAST stdout line of a killed child)."""
+    child_budget = float(os.environ.get("BENCH_CHILD_BUDGET", "1e9"))
+    jax, device = _child_setup()
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.linear import _use_qgemv, linear
+    from bigdl_tpu.quant import quantize
+
+    matrix: dict[str, dict] = {}
+
+    def result_line() -> dict:
+        n_ok = sum(1 for v in matrix.values() if v.get("ok"))
+        return {
+            "metric": "pallas_kernel_matrix",
+            "value": n_ok,
+            "unit": f"kernels_ok_of_{len(matrix)}",
+            "vs_baseline": 0,
+            "kernels": matrix,
+            "device": getattr(device, "device_kind", str(device.platform)),
+        }
+
+    def bank(name: str, fn) -> None:
+        if child_budget - (time.time() - T0) < 15:
+            return  # leave unstated rather than mark untried kernels failed
+        t0 = time.time()
+        try:
+            extra = fn()  # optional dict of extra fields (timed entries)
+            matrix[name] = {"ok": True, "s": round(time.time() - t0, 1),
+                            **(extra or {})}
+            log(f"kernel {name}: OK ({matrix[name]['s']}s)")
+        except Exception as e:  # Mosaic lowering errors surface here
+            matrix[name] = {"ok": False, "s": round(time.time() - t0, 1),
+                            "error": repr(e)[:300]}
+            log(f"kernel {name}: FAIL {matrix[name]['error'][:120]}")
+        print(json.dumps(result_line()), flush=True)
+
+    # --- fused dequant-GEMV, every qtype the dispatcher routes to Pallas,
+    # at the hardest real shape: llama3-8b down-proj K=14336 (the VMEM-
+    # budget case), plus the hidden-size K=4096 for the headline format.
+    key = jax.random.PRNGKey(0)
+    x_cache: dict[int, jax.Array] = {}
+
+    def gemv_smoke(qtype: str, O: int, K: int):
+        def run():
+            w = jax.random.normal(key, (O, K), jnp.float32) * 0.02
+            # eager, not jitted: k-quant quantization runs host-side numpy
+            qt = quantize(w, qtype)
+            jax.block_until_ready(qt.data)
+            if K not in x_cache:
+                x_cache[K] = jnp.ones((1, K), jnp.bfloat16)
+            x = x_cache[K]
+            assert _use_qgemv(x, qt), f"{qtype} O={O} K={K} not GEMV-eligible"
+            y = jax.jit(lambda a, b: linear(a, b, None, jnp.bfloat16))(x, qt)
+            import numpy as np
+            v = np.asarray(jax.device_get(y))
+            assert v.shape == (1, O) and np.isfinite(v).all()
+        return run
+
+    for qtype in ("sym_int4", "asym_int4", "sym_int8", "nf4", "fp4",
+                  "q4_k", "q6_k"):
+        bank(f"gemv_{qtype}_k14336", gemv_smoke(qtype, 4096, 14336))
+    bank("gemv_sym_int4_k4096", gemv_smoke("sym_int4", 4096, 4096))
+    bank("gemv_sym_int4_k11008", gemv_smoke("sym_int4", 11008, 4096))
+
+    # --- flash attention (prefill path), llama3-8b GQA shape
+    def flash_smoke():
+        from bigdl_tpu.ops.pallas import flash_attention
+        import numpy as np
+
+        B, T, Hq, Hkv, D = 1, 512, 32, 8, 128
+        q = jnp.ones((B, T, Hq, D), jnp.bfloat16) * 0.01
+        k = jnp.ones((B, T, Hkv, D), jnp.bfloat16) * 0.01
+        v = jnp.ones((B, T, Hkv, D), jnp.bfloat16) * 0.01
+        o = jax.jit(lambda a, b, c: flash_attention(a, b, c))(q, k, v)
+        assert np.isfinite(np.asarray(jax.device_get(o))).all()
+
+    bank("flash_attention_t512", flash_smoke)
+
+    def flash_window_smoke():
+        from bigdl_tpu.ops.pallas import flash_attention
+        import numpy as np
+
+        B, T, Hq, Hkv, D = 1, 512, 32, 8, 128
+        q = jnp.ones((B, T, Hq, D), jnp.bfloat16) * 0.01
+        k = jnp.ones((B, T, Hkv, D), jnp.bfloat16) * 0.01
+        v = jnp.ones((B, T, Hkv, D), jnp.bfloat16) * 0.01
+        o = jax.jit(lambda a, b, c: flash_attention(
+            a, b, c, window=128, softcap=30.0))(q, k, v)
+        assert np.isfinite(np.asarray(jax.device_get(o))).all()
+
+    bank("flash_attention_window_softcap", flash_window_smoke)
+
+    # --- paged decode attention, bf16 and fp8 pages
+    def paged_smoke(quantized: bool):
+        def run():
+            import numpy as np
+
+            from bigdl_tpu import kvpaged
+            from bigdl_tpu.ops.pallas import paged_decode_attention
+
+            B, Hq, Hkv, D, page, npages, mp = 4, 32, 8, 128, 16, 64, 8
+            cache = kvpaged.init_paged(
+                1, npages, page, Hkv, D, B, mp, quantize_kv=quantized)
+            bt = jnp.arange(B * mp, dtype=jnp.int32).reshape(B, mp)
+            cache = dataclasses.replace(
+                cache, block_tables=bt,
+                pos=jnp.full((B,), 100, jnp.int32))
+            q = jnp.ones((B, Hq, D), jnp.bfloat16) * 0.01
+            o = jax.jit(lambda qq, c: paged_decode_attention(
+                qq, c.k, c.v, c.block_tables, jnp.asarray(0, jnp.int32),
+                c.pos, c.start, c.k_scale, c.v_scale))(q, cache)
+            assert np.isfinite(np.asarray(jax.device_get(o))).all()
+        return run
+
+    import dataclasses
+
+    bank("paged_attention_bf16", paged_smoke(False))
+    bank("paged_attention_fp8", paged_smoke(True))
+
+    # --- timed GEMV for the headline formats: marginal-cost chained loop
+    # gives the bare-kernel ms and achieved GB/s (the decode MBU ceiling)
+    def gemv_timed(qtype: str, O: int, K: int):
+        def run():
+            import numpy as np
+
+            w = jax.random.normal(key, (O, K), jnp.float32) * 0.02
+            qt = quantize(w, qtype)  # eager: k-quants quantize host-side
+            jax.block_until_ready(qt.data)
+            x = jnp.ones((1, K), jnp.bfloat16)
+
+            def chain(x0, n):
+                def body(_, xx):
+                    y = linear(xx, qt, None, jnp.bfloat16)
+                    # data-dependent, numerically negligible feedback so
+                    # the async tunnel cannot overlap/elide iterations
+                    return xx + jnp.sum(y) * jnp.bfloat16(1e-24)
+                return jax.lax.fori_loop(0, n, body, x0)
+
+            chain_j = jax.jit(chain)
+            fetch = lambda r: np.asarray(jax.device_get(r))
+            fetch(chain_j(x, 4))
+            t1 = time.perf_counter()
+            fetch(chain_j(x, 8))
+            t1 = time.perf_counter() - t1
+            t2 = time.perf_counter()
+            fetch(chain_j(x, 72))
+            t2 = time.perf_counter() - t2
+            ms = max((t2 - t1) / 64, 1e-6) * 1000
+            nbytes = qt.nbytes()
+            gbps = nbytes / (ms / 1000) / 1e9
+            log(f"gemv {qtype} K={K}: {ms:.3f} ms, {gbps:.0f} GB/s")
+            return {"ms": round(ms, 4), "GBps": round(gbps, 1)}
+        return run
+
+    if child_budget - (time.time() - T0) > 90:
+        bank("gemv_sym_int4_k14336_t", gemv_timed("sym_int4", 4096, 14336))
+    if child_budget - (time.time() - T0) > 60:
+        bank("gemv_q4_k_k14336_t", gemv_timed("q4_k", 4096, 14336))
+
+    return result_line()
+
+
+# --------------------------------------------------------------------------
 # child: QLoRA train-step MFU
 # --------------------------------------------------------------------------
 
@@ -449,6 +630,19 @@ def main() -> None:
         emit({"metric": "bench_failed", "value": 0, "unit": "none",
               "vs_baseline": 0, "error": "tpu tunnel unreachable"}, 1)
 
+    # Stage 0 — per-kernel compile-smoke matrix (VERDICT r04 #1): cheap
+    # seconds-per-kernel compiles, banked before any large candidate so a
+    # slow-compile day still proves/falsifies every Pallas kernel on real
+    # Mosaic. Result rides along inside the final JSON line.
+    kernel_matrix = None
+    if remaining() > 420:
+        res = run_child("kernels", "-", min(300, remaining() - 360))
+        if isinstance(res, dict) and res.get("kernels"):
+            kernel_matrix = res["kernels"]
+            n_ok = sum(1 for v in kernel_matrix.values() if v.get("ok"))
+            log(f"kernel matrix banked: {n_ok}/{len(kernel_matrix)} ok")
+            banked.append(("kernels", res))
+
     # smallest-first; min_s = give up if less wall-clock than this remains.
     # llama2-7b is the headline (BASELINE <20 ms/token) and gets the bulk
     # of the budget: on a slow-compile day (r03: ~300 s per 7B program
@@ -470,10 +664,11 @@ def main() -> None:
             banked.append((preset, res))
             log(f"banked {res['metric']} = {res['value']} {res['unit']}")
 
+    decoded = [b for b in banked if b[0] != "kernels"]
     train_res = None
-    if banked and remaining() > 200:
+    if decoded and remaining() > 200:
         # train MFU on the biggest preset that already decoded fine
-        preset = banked[-1][0]
+        preset = decoded[-1][0]
         res = run_child("train", preset, remaining() - 30)
         if isinstance(res, dict):
             train_res = res
@@ -483,16 +678,20 @@ def main() -> None:
         emit({"metric": "bench_failed", "value": 0, "unit": "none",
               "vs_baseline": 0,
               "error": "all candidates failed or timed out"}, 1)
-    best = banked[-1][1]  # largest successful model
+    best = (decoded[-1] if decoded else banked[-1])[1]
     if train_res:
         train_res.pop("metric", None)
         best.update(train_res)
+    if kernel_matrix is not None and best.get("metric") != "pallas_kernel_matrix":
+        best["kernel_matrix"] = kernel_matrix
     emit(best, 0)
 
 
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         print(json.dumps(child_probe()), flush=True)
+    elif "--kernels" in sys.argv:
+        print(json.dumps(child_kernels()), flush=True)
     elif "--decode" in sys.argv:
         print(json.dumps(child_decode(sys.argv[sys.argv.index("--decode") + 1])),
               flush=True)

@@ -139,8 +139,25 @@ class InferenceEngine:
                 f"paged serving is not available for "
                 f"{model.config.model_type}: its cache is not a KV pool"
             )
+        if quantize_kv and self._family_cache is not None:
+            # the family init_cache/engine_pool signatures don't thread
+            # quantize_kv; silently serving bf16 KV would misreport the
+            # memory footprint the caller asked for (ADVICE r04)
+            raise NotImplementedError(
+                f"quantize_kv is not wired for "
+                f"{model.config.model_type}'s family cache; use "
+                "quantize_kv=False"
+            )
         self.page_size = page_size
-        self.max_pages_per_row = -(-max_len // page_size)
+        # physical reserve past max_len: a speculative verify round writes
+        # draft_k tokens at pos..pos+K-1 before rolling back; a request
+        # whose decode window ends flush with max_len would otherwise lose
+        # those writes (out-of-bounds scatters drop silently and the
+        # emitted tokens attend with missing keys — ADVICE r04). Extra
+        # PHYSICAL slots keep outputs byte-identical to plain serving,
+        # unlike shrinking the logical window (which re-truncates prompts).
+        self._reserve = max(draft_k - 1, 0) if speculative else 0
+        self.max_pages_per_row = -(-(max_len + self._reserve) // page_size)
         # +1: physical page 0 is the reserved scratch sink, so the default
         # pool still covers every slot at full logical length
         self.n_pages = n_pages or n_slots * self.max_pages_per_row + 1
@@ -225,11 +242,6 @@ class InferenceEngine:
                 # K-1 draft tokens are verifiable; K=1 would pay a draft
                 # forward whose token can never be accepted
                 raise ValueError(f"draft_k must be >= 2, got {draft_k}")
-            if paged:
-                raise NotImplementedError(
-                    "speculative serving writes draft KV through a dense "
-                    "pool; use paged=False"
-                )
             if self._family_cache is not None:
                 raise NotImplementedError(
                     f"speculative serving needs the standard KV pool; "
@@ -244,7 +256,11 @@ class InferenceEngine:
                 )
             if draft_params is None:
                 self._draft_params = model.self_draft_params()
-            self.dcache = self._make_pool()
+            # the draft pool is ALWAYS dense (even when the target pool is
+            # paged): the draft model needs full prompt context, and a
+            # dense [slots, max_len] draft pool keeps the verify-round
+            # rollback a per-row pos subtraction in both pools
+            self.dcache = self._make_pool(force_dense=True)
             self._spec_decode = self._with_mesh(jax.jit(
                 functools.partial(self._spec_decode_impl, fwd),
                 donate_argnames=("cache", "dcache", "seen"),
@@ -267,9 +283,11 @@ class InferenceEngine:
 
         return wrapped
 
-    def _make_pool(self):
+    def _make_pool(self, force_dense: bool = False):
         """The shared KV pool, per-row positions from the start (idle rows
-        park at 0); sharded over kv heads when the model is on a mesh."""
+        park at 0); sharded over kv heads when the model is on a mesh.
+        force_dense: the speculative draft pool stays dense even when the
+        target pool is paged."""
         cfg = self.config
         if self._family_pool is not None:
             return self._family_pool(cfg, self.n_slots, self.max_len)
@@ -278,7 +296,7 @@ class InferenceEngine:
             return dataclasses.replace(
                 cache, pos=jnp.zeros((self.n_slots,), jnp.int32)
             )
-        if self.paged:
+        if self.paged and not force_dense:
             from bigdl_tpu import kvpaged
 
             return kvpaged.init_paged(
@@ -287,7 +305,7 @@ class InferenceEngine:
                 self.max_pages_per_row, quantize_kv=self.quantize_kv,
             )
         cache = kvcache.init_cache(
-            cfg.num_hidden_layers, self.n_slots, self.max_len,
+            cfg.num_hidden_layers, self.n_slots, self.max_len + self._reserve,
             cfg.num_key_value_heads, cfg.head_dim_,
             quantize_kv=self.quantize_kv,
         )
@@ -632,29 +650,52 @@ class InferenceEngine:
                 self._page_key[table[i]] = key
                 self._prefix_lru.append(key)
 
+        if self.speculative:
+            # prefix-cache hits only save TARGET prefill; the draft
+            # always prefills its full context into the dense draft pool
+            self._admit_draft(slot, prompt, limit)
+
         self._activate(slot, req, logits_last[None])
         return True
 
-    def _ensure_decode_pages(self) -> None:
-        """Before a decode step, every active slot about to write past its
-        allocation gets one more page; a slot that can't is finished with
-        'length' (pool exhausted)."""
+    def _admit_draft(self, slot: int, prompt: list[int], limit: int) -> None:
+        """Left-pad-prefill the speculative draft pool's row for a newly
+        admitted request — one definition shared by the dense and paged
+        admission paths so their draft discipline can never drift."""
+        bucket = min(round_up(max(len(prompt), 16), 64), limit)
+        dprompt = prompt[-bucket:]
+        tokens = np.full((1, bucket), self.gen.pad_token_id, np.int32)
+        tokens[0, bucket - len(dprompt):] = dprompt
+        pad = bucket - len(dprompt)
+        _, dpcache = self._prefill(
+            self._draft_params, jnp.asarray(tokens),
+            jnp.asarray([pad], jnp.int32), bucket=bucket,
+        )
+        self.dcache = self._insert(
+            self.dcache, dpcache, jnp.asarray(slot), jnp.asarray(pad)
+        )
+
+    def _ensure_decode_pages(self, need_tokens: int = 1) -> None:
+        """Before a decode step, every active slot whose next `need_tokens`
+        writes would run past its allocation gets more pages (speculative
+        verify writes draft_k tokens before rolling back — the pages must
+        exist or the scatter clamps into a neighbour page); a slot that
+        can't extend is finished with 'length' (pool exhausted)."""
         for i in np.nonzero(self.active)[0]:
             slot = int(i)
-            if self._slot_pos[slot] < self._slot_written[slot]:
-                continue
-            idx = len(self._slot_pages[slot])
-            if idx >= self.max_pages_per_row:  # logical capacity reached
-                self._finish(slot, "length")
-                continue
-            pg = self._alloc_page()
-            if pg is None:
-                self._finish(slot, "length")
-                continue
-            self._slot_pages[slot].append(pg)
-            self._slot_written[slot] += self.page_size
-            self._bt_host[slot, idx] = pg
-            self._bt_dirty = True
+            while self._slot_pos[slot] + need_tokens > self._slot_written[slot]:
+                idx = len(self._slot_pages[slot])
+                if idx >= self.max_pages_per_row:  # logical capacity hit
+                    self._finish(slot, "length")
+                    break
+                pg = self._alloc_page()
+                if pg is None:
+                    self._finish(slot, "length")
+                    break
+                self._slot_pages[slot].append(pg)
+                self._slot_written[slot] += self.page_size
+                self._bt_host[slot, idx] = pg
+                self._bt_dirty = True
 
     # ---- admission --------------------------------------------------------
 
@@ -727,13 +768,7 @@ class InferenceEngine:
             self.cache, pcache, jnp.asarray(slot), jnp.asarray(pad)
         )
         if self.speculative:
-            _, dpcache = self._prefill(
-                self._draft_params, jnp.asarray(tokens),
-                jnp.asarray([pad], jnp.int32), bucket=bucket,
-            )
-            self.dcache = self._insert(
-                self.dcache, dpcache, jnp.asarray(slot), jnp.asarray(pad)
-            )
+            self._admit_draft(slot, req.prompt, limit)
         self._activate(slot, req, logits_last)
 
     def _admit(self) -> None:
@@ -783,7 +818,7 @@ class InferenceEngine:
         so the engine can keep serving new requests."""
         self.cache = self._make_pool()
         if self.speculative:
-            self.dcache = self._make_pool()
+            self.dcache = self._make_pool(force_dense=True)
         self.cur = jnp.zeros((self.n_slots,), jnp.int32)
         self.seen = jnp.zeros(
             (self.n_slots, self.config.vocab_size), jnp.bool_
@@ -820,7 +855,9 @@ class InferenceEngine:
         self._reap_cancelled()
         self._admit()
         if self.paged:
-            self._ensure_decode_pages()
+            self._ensure_decode_pages(
+                self.draft_k if self.speculative else 1
+            )
             if self._bt_dirty:
                 self.cache = dataclasses.replace(
                     self.cache, block_tables=jnp.asarray(self._bt_host)
@@ -878,6 +915,8 @@ class InferenceEngine:
         for i in np.nonzero(self.active)[0]:
             i = int(i)
             s = self._slots[i]
+            if self.paged:  # mirror the post-rollback pool position
+                self._slot_pos[i] += int(n_acc_h[i]) + 1
             for t in range(int(n_acc_h[i]) + 1):
                 s.remaining -= 1
                 self.spec_emitted += 1
