@@ -195,6 +195,29 @@ def cmd_serve(args):
         server.shutdown()
 
 
+def cmd_fastchat_worker(args):
+    from bigdl_tpu.serving.fastchat_worker import FastChatWorker
+
+    model = _load(args.model, args.qtype)
+    worker = FastChatWorker(
+        model, tokenizer=_tokenizer(args.model),
+        controller_addr=args.controller_address,
+        worker_addr=args.worker_address,
+        model_names=(args.model_names.split(",") if args.model_names
+                     else None),
+        host=args.host, port=args.port, n_slots=args.slots,
+        max_len=args.max_len, paged=args.paged,
+    )
+    worker.start(register=args.controller_address is not None)
+    print(f"fastchat worker {worker.worker_id} serving {args.model} "
+          f"at {worker.worker_addr}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        worker.shutdown()
+
+
 def cmd_bench(args):
     model = _load(args.model, args.qtype)
     n_in, n_out = args.in_len, args.out_len
@@ -264,6 +287,24 @@ def main(argv=None):
     s.add_argument("--paged", action="store_true",
                    help="paged KV pool + prefix caching")
     s.set_defaults(fn=cmd_serve)
+
+    fw = sub.add_parser("fastchat-worker",
+                        help="FastChat model-worker (register + heartbeat "
+                             "+ worker_generate_stream)", parents=[qp])
+    fw.add_argument("model")
+    fw.add_argument("--controller-address", default=None,
+                    help="FastChat controller URL, e.g. http://host:21001 "
+                         "(omit to run unregistered)")
+    fw.add_argument("--worker-address", default=None,
+                    help="URL the controller should reach us at")
+    fw.add_argument("--model-names", default=None,
+                    help="comma-separated names to register")
+    fw.add_argument("--host", default="127.0.0.1")
+    fw.add_argument("--port", type=int, default=21002)
+    fw.add_argument("--slots", type=int, default=8)
+    fw.add_argument("--max-len", type=int, default=2048)
+    fw.add_argument("--paged", action="store_true")
+    fw.set_defaults(fn=cmd_fastchat_worker)
 
     ch = sub.add_parser("chat", help="interactive chat REPL", parents=[qp])
     ch.add_argument("model")

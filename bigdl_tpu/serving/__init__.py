@@ -4,4 +4,12 @@ pipeline_parallel.py:482-929 in /root/reference)."""
 
 from bigdl_tpu.serving.engine import InferenceEngine, Request
 
-__all__ = ["InferenceEngine", "Request"]
+__all__ = ["InferenceEngine", "Request", "FastChatWorker"]
+
+
+def __getattr__(name):
+    if name == "FastChatWorker":  # lazy: keeps engine-only imports light
+        from bigdl_tpu.serving.fastchat_worker import FastChatWorker
+
+        return FastChatWorker
+    raise AttributeError(name)
