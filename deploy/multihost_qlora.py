@@ -1,0 +1,204 @@
+"""Multihost QLoRA finetune entrypoint — the job each pod of the
+TPU JobSet runs (deploy/k8s/qlora-multihost-v5e-16.yaml).
+
+TPU-native replacement for the reference's MPI launcher+worker pair
+(/root/reference/docker/llm/finetune/lora/cpu/kubernetes/templates/
+ipex-llm-lora-finetuning-job.yaml:7-54 + the oneCCL/ssh bootstrap in its
+entrypoint): every process runs THIS script unchanged; the only
+distributed step is `init_multihost()` (jax.distributed.initialize),
+after which the dp×tp train step is a single jitted SPMD program —
+gradient psums over dp ride DCN once per step, tp psums stay on ICI
+(parallel/multihost.host_aware_mesh).
+
+Data: a .jsonl with {"tokens": [int, ...]} rows (pre-tokenized), or
+{"text": ...} rows if a tokenizer can be loaded from the model dir.
+Every host reads the SAME file and takes its dp-rank's strided rows —
+no shared filesystem coordination beyond the read-only mounts.
+
+Checkpoint/resume: the process-0 host writes the atomic train state
+(train/checkpoint.py) every --save-every steps; on restart (pod
+preemption, maintenance) every host reloads the same state and training
+resumes at the saved step with the saved PRNG key.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", required=True,
+                   help="HF checkpoint dir / saved low-bit dir / preset name")
+    p.add_argument("--data", required=True, help="train .jsonl")
+    p.add_argument("--ckpt-dir", default="/ckpt")
+    p.add_argument("--qtype", default="nf4")
+    p.add_argument("--rank", type=int, default=8)
+    p.add_argument("--batch-per-host", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=1000)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel width (must divide one host's "
+                        "chip count; dp spans the rest of the pod)")
+    p.add_argument("--save-every", type=int, default=100)
+    return p.parse_args(argv)
+
+
+def load_rows(path: str, seq_len: int, tokenizer=None):
+    """Yield fixed-length token rows from a jsonl forever (epoch loop)."""
+    while True:
+        with open(path) as f:
+            buf: list[int] = []
+            for line in f:
+                if not line.strip():
+                    continue
+                row = json.loads(line)
+                if "tokens" in row:
+                    ids = [int(t) for t in row["tokens"]]
+                elif tokenizer is not None:
+                    ids = list(tokenizer(row["text"])["input_ids"])
+                else:
+                    raise ValueError(
+                        "rows carry 'text' but no tokenizer is available; "
+                        "pre-tokenize to {'tokens': [...]} instead"
+                    )
+                buf.extend(ids)
+                while len(buf) >= seq_len + 1:
+                    yield buf[: seq_len + 1]
+                    buf = buf[seq_len + 1:]
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # make the env var authoritative even where a sitecustomize
+        # force-registers another platform (CI runs this entrypoint on
+        # the virtual CPU mesh; TPU VMs leave it unset -> default tpu)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from bigdl_tpu.parallel.multihost import host_aware_mesh, init_multihost
+
+    init_multihost()  # no-op on a single host, auto-joins a pod job
+
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+    from bigdl_tpu.parallel.sharding import (
+        expand_specs_for_params, lora_specs, param_specs, shard_params,
+    )
+    from bigdl_tpu.train import init_lora, make_train_step
+    from bigdl_tpu.train.checkpoint import load_train_state, save_train_state
+
+    pid, nproc = jax.process_index(), jax.process_count()
+    n_dev = len(jax.devices())
+    dp = n_dev // args.tp
+    mesh = host_aware_mesh(tp=args.tp, dp=dp, axes=("dp", "pp", "sp", "tp"))
+    if pid == 0:
+        print(f"[qlora] {nproc} hosts, {n_dev} chips, mesh dp={dp} "
+              f"tp={args.tp}", flush=True)
+
+    tokenizer = None
+    if args.model in PRESETS:
+        config = PRESETS[args.model]
+        params = llama.quantize_params(
+            llama.init_params(config, jax.random.PRNGKey(0)), args.qtype
+        )
+    else:
+        from bigdl_tpu.convert import load_hf_checkpoint
+
+        config, params, tokenizer = load_hf_checkpoint(
+            args.model, qtype=args.qtype
+        )
+
+    specs = expand_specs_for_params(param_specs(config), params)
+    params = shard_params(params, specs, mesh)
+    lora = init_lora(config, jax.random.PRNGKey(1), rank=args.rank)
+    lora_sp = expand_specs_for_params(
+        lora_specs(config, tuple(lora["layers"])), lora
+    )
+    lora = shard_params(lora, lora_sp, mesh)
+
+    optimizer = optax.adamw(args.lr)
+    opt_state = optimizer.init(lora["layers"])
+    step_fn = make_train_step(config, llama.forward, optimizer)
+    step_j = jax.jit(step_fn, donate_argnames=("lora", "opt_state"))
+
+    rng = jax.random.PRNGKey(42)
+    start_step = 0
+    ckpt_path = os.path.join(args.ckpt_dir, "train_state.npz")
+    if os.path.exists(ckpt_path):
+        state = load_train_state(
+            ckpt_path, like_lora=lora, like_opt_state=opt_state
+        )
+        lora, opt_state = state["lora"], state["opt_state"]
+        rng, start_step = state["rng"], state["step"]
+        if pid == 0:
+            print(f"[qlora] resumed at step {start_step}", flush=True)
+
+    # dp-rank-strided data: host p consumes rows [p*B, (p+1)*B) of each
+    # global batch of nproc*B rows, then skips the other hosts' rows —
+    # without the per-batch skip every host would train on every row
+    # (nproc duplicate gradients per sample)
+    B = args.batch_per_host
+    if (B * nproc) % dp != 0:
+        raise SystemExit(
+            f"global batch {B}*{nproc} hosts = {B * nproc} rows does not "
+            f"divide over the dp={dp} mesh axis; set --batch-per-host to "
+            f"a multiple of {max(dp // nproc, 1)}"
+        )
+    rows = load_rows(args.data, args.seq_len, tokenizer)
+    for _ in range(pid * B):  # stagger host offsets
+        next(rows)
+
+    def next_local_batch():
+        batch = [next(rows) for _ in range(B)]
+        for _ in range((nproc - 1) * B):  # the other hosts' rows
+            next(rows)
+        return np.stack(batch).astype(np.int32)
+
+    data_sharding = NamedSharding(mesh, P("dp", None))
+
+    t0 = time.time()
+    for step in range(start_step, args.steps):
+        batch = next_local_batch()
+        tokens = jax.make_array_from_process_local_data(
+            data_sharding, batch,
+            global_shape=(B * nproc, args.seq_len + 1),
+        ) if nproc > 1 else jax.device_put(jnp.asarray(batch), data_sharding)
+        mask = jnp.ones_like(tokens, jnp.float32)
+        # the QLoRA step is deterministic (no dropout), but the key
+        # advances per step and rides the checkpoint so a resumed run
+        # continues the same stream if a stochastic recipe is swapped in
+        rng, _ = jax.random.split(rng)
+        with jax.set_mesh(mesh):
+            lora, opt_state, loss = step_j(params, lora, opt_state,
+                                           tokens, mask)
+        if pid == 0 and (step % 10 == 0 or step == args.steps - 1):
+            dt = time.time() - t0
+            print(f"[qlora] step {step}: loss {float(loss):.4f} "
+                  f"({dt:.1f}s)", flush=True)
+        if pid == 0 and args.save_every and (step + 1) % args.save_every == 0:
+            save_train_state(ckpt_path, lora=lora, opt_state=opt_state,
+                             step=step + 1, rng=rng)
+    if pid == 0:
+        save_train_state(ckpt_path, lora=lora, opt_state=opt_state,
+                         step=args.steps, rng=rng)
+        print("[qlora] done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
