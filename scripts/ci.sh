@@ -12,6 +12,13 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 # reject (and segfault on) cache entries whose recorded machine features
 # mismatch the executing host (tests/conftest.py has the full story)
 
+if [[ "${1:-}" == "--core" ]]; then
+  echo "== core gate (< 5 min): quant/native/model/engine basics"
+  python -m pytest tests/ -q -n 2 -m core
+  echo "CORE OK"
+  exit 0
+fi
+
 echo "== unit + distributed tests (8-device CPU mesh)"
 # -n 2: two worker processes halve per-process native-state accumulation
 # (intermittent XLA:CPU compiler segfaults in very long single processes;

@@ -24,6 +24,10 @@ from bigdl_tpu.generate import (
 from bigdl_tpu.models import llama
 from bigdl_tpu.models.config import PRESETS, ModelConfig
 
+
+# fast gate subset: pytest -m core (scripts/ci.sh --core)
+pytestmark = pytest.mark.core
+
 CFG = PRESETS["tiny-llama"]
 
 

@@ -11,9 +11,13 @@ import pytest
 from bigdl_tpu import native
 from bigdl_tpu.quant import quantize
 
-pytestmark = pytest.mark.skipif(
-    not native.available(), reason="native toolchain unavailable"
-)
+pytestmark = [
+    pytest.mark.skipif(
+        not native.available(), reason="native toolchain unavailable"
+    ),
+    # fast gate subset: pytest -m core (scripts/ci.sh --core)
+    pytest.mark.core,
+]
 
 
 def cases(rng):

@@ -19,6 +19,9 @@ from bigdl_tpu.quant import (
     unpack_nibbles,
 )
 
+# fast gate subset: pytest -m core (scripts/ci.sh --core)
+pytestmark = pytest.mark.core
+
 QUANT_TYPES = [n for n, s in qtype_registry().items() if not s.is_dense]
 
 # Acceptable relative RMS error (||x - deq(q(x))|| / ||x||) for gaussian data.

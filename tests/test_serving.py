@@ -43,6 +43,7 @@ PROMPTS = [
 ]
 
 
+@pytest.mark.core
 def test_engine_matches_generate(model):
     want = {
         tuple(p): model.generate([p], max_new_tokens=10)[0].tolist()
@@ -99,6 +100,7 @@ def test_oversized_max_tokens_clamped(model):
     assert r.finish_reason == "length"
 
 
+@pytest.mark.core
 def test_finish_reason_stop_vs_length(model):
     ref = model.generate([PROMPTS[0]], max_new_tokens=8)[0].tolist()
     eng = InferenceEngine(
