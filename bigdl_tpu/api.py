@@ -82,10 +82,11 @@ class TpuModel:
                                       self.mesh)
 
             def pp_forward(config, params, tokens, cache,
-                           mode="prefill", last_logits_only=False, **kw):
-                # features beyond the plain prefill/decode step must fail
-                # loudly, not silently drop their kwargs (array-safe:
-                # no truthiness on jax arrays)
+                           mode="prefill", last_logits_only=False,
+                           collect_obs: int = 0, **kw):
+                # features beyond the cached prefill/decode step (plus
+                # SnapKV's collect_obs) must fail loudly, not silently
+                # drop their kwargs (array-safe: no truthiness on arrays)
                 unsupported = sorted(
                     k for k, v in kw.items()
                     if v is not None and (
@@ -100,7 +101,8 @@ class TpuModel:
                         "a tp/dp mesh (pp=1) instead"
                     )
                 return step(params, tokens, cache, mode=mode,
-                            last_logits_only=last_logits_only)
+                            last_logits_only=last_logits_only,
+                            collect_obs=collect_obs)
 
             self._pp_step = pp_forward
         return self._pp_step
@@ -273,21 +275,12 @@ class TpuModel:
                 "sliding-window/ALiBi attention for this config"
             )
             compress_kv = None
-        if self.pp_size > 1 and compress_kv is not None:
-            # the pipeline step has no collect_obs path (SnapKV needs the
-            # per-layer observation queries, api of forward_fn)
-            warnings.warn(
-                "SnapKV compress_kv skipped: not supported with "
-                "pipeline parallelism"
-            )
-            compress_kv = None
         if (
             flags.performance_mode()
             and cache_init is None  # lookup verify needs a rewindable KV cache
             and not do_sample
             and compress_kv is None  # lookup path has no SnapKV support
             and repetition_penalty == 1.0  # lookup has no penalty support
-            and self.pp_size <= 1  # lookup jits family.forward directly
             and max(len(p) for p in prompts) >= 256
         ):
             return self.generate_lookup(
@@ -343,18 +336,15 @@ class TpuModel:
         IPEX_LLM_PERFORMANCE_MODE): n-gram candidates, one verify forward."""
         from bigdl_tpu.decode import lookup_generate
 
-        if self.pp_size > 1:
-            raise NotImplementedError(
-                "lookup decoding jits the family forward directly and "
-                "would gather pp-sharded layer stacks onto every stage; "
-                "use plain generate() under pipeline parallelism"
+        # under a pp mesh the verify forward is the pipeline step
+        # (forward_fn keeps the family-forward call shape, so the lookup
+        # while_loop runs unchanged with per-stage KV caches)
+        with self._mesh_ctx():
+            return lookup_generate(
+                self.config, self.params, prompts, self.forward_fn,
+                max_new_tokens=max_new_tokens, lookahead=lookahead,
+                max_ngram=max_ngram, **kw,
             )
-
-        return lookup_generate(
-            self.config, self.params, prompts, self.family.forward,
-            max_new_tokens=max_new_tokens, lookahead=lookahead,
-            max_ngram=max_ngram, **kw,
-        )
 
     def self_draft_params(self):
         """The sym_int4 self-draft of this model's weights (the

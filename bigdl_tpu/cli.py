@@ -218,6 +218,14 @@ def cmd_fastchat_worker(args):
         worker.shutdown()
 
 
+def cmd_fetch_iq_tables(args):
+    from bigdl_tpu.quant import iq_quants
+
+    url = args.url or iq_quants.DEFAULT_TABLES_URL
+    tables = iq_quants.fetch_tables(url=url)
+    print(f"cached {sorted(tables)} -> {iq_quants._cache_path()}")
+
+
 def cmd_bench(args):
     model = _load(args.model, args.qtype)
     n_in, n_out = args.in_len, args.out_len
@@ -305,6 +313,15 @@ def main(argv=None):
     fw.add_argument("--max-len", type=int, default=2048)
     fw.add_argument("--paged", action="store_true")
     fw.set_defaults(fn=cmd_fastchat_worker)
+
+    ft = sub.add_parser("fetch-iq-tables",
+                        help="download + cache the llama.cpp IQ-quant "
+                             "codebook grids (one-time, per machine)")
+    # default=None: resolved in cmd_fetch_iq_tables, keeping parser
+    # build free of quant imports (file convention)
+    ft.add_argument("--url", default=None,
+                    help="override the llama.cpp ggml-common.h URL")
+    ft.set_defaults(fn=cmd_fetch_iq_tables)
 
     ch = sub.add_parser("chat", help="interactive chat REPL", parents=[qp])
     ch.add_argument("model")

@@ -130,26 +130,42 @@ def make_pipeline_step(
 
     from bigdl_tpu.models.llama import embed_tokens, lm_head_logits
 
-    def step(params, tokens, cache, mode="decode", last_logits_only=False):
+    def step(params, tokens, cache, mode="decode", last_logits_only=False,
+             collect_obs: int = 0):
         def stage_step(params, tokens, cache):
             s = jax.lax.axis_index(axis)
             h0 = embed_tokens(config, params, tokens, compute_dtype)
+            B, T = tokens.shape
+            # per-stage SnapKV observation queries, committed (like the
+            # cache) only on the stage's active tick
+            obs0 = jnp.zeros(
+                (L_local, B, collect_obs, config.num_attention_heads,
+                 config.head_dim_), compute_dtype,
+            ) if collect_obs else None
 
             def tick(carry, t):
-                recv, cache, out = carry
-                h_out, cache_new = forward_fn(
+                recv, cache, out, obs = carry
+                res = forward_fn(
                     config, params, recv, cache, mode=mode,
                     compute_dtype=compute_dtype, input_is_hidden=True,
                     return_hidden=True, layer_offset=s * L_local,
+                    collect_obs=collect_obs,
                 )
+                if collect_obs:
+                    h_out, cache_new, obs_new = res
+                else:
+                    (h_out, cache_new), obs_new = res, None
                 active = s == t
                 cache = _tree_where(active, cache_new, cache)
+                if collect_obs:
+                    obs = jnp.where(active, obs_new, obs)
                 out = jnp.where(active & (s == n_stages - 1), h_out, out)
                 recv = jax.lax.ppermute(h_out, axis, perm_fwd)
-                return (recv, cache, out), None
+                return (recv, cache, out, obs), None
 
-            (_, cache, out), _ = jax.lax.scan(
-                tick, (h0, cache, jnp.zeros_like(h0)), jnp.arange(n_stages)
+            (_, cache, out, obs), _ = jax.lax.scan(
+                tick, (h0, cache, jnp.zeros_like(h0), obs0),
+                jnp.arange(n_stages)
             )
             # psum: only the last stage holds the real hidden (V/H times
             # less ICI traffic than psumming logits). f32: XLA CPU's
@@ -163,6 +179,8 @@ def make_pipeline_step(
             if last_logits_only:
                 h_final = h_final[:, -1:]
             logits = lm_head_logits(config, params, h_final, compute_dtype)
+            if collect_obs:
+                return logits, cache, obs
             return logits, cache
 
         from bigdl_tpu.parallel.sharding import param_specs
@@ -179,11 +197,15 @@ def make_pipeline_step(
         from bigdl_tpu.parallel.sharding import expand_specs_for_params
 
         pspecs = expand_specs_for_params(pspecs, params)
+        out_specs = (P(), pp_cache_specs(cache, axis))
+        if collect_obs:
+            # obs stacks stage-local layer blocks -> global [L, B, W, Hq, D]
+            out_specs = out_specs + (P(axis),)
         return jax.shard_map(
             stage_step,
             mesh=mesh,
             in_specs=(pspecs, P(), pp_cache_specs(cache, axis)),
-            out_specs=(P(), pp_cache_specs(cache, axis)),
+            out_specs=out_specs,
             axis_names={axis},
             check_vma=False,
         )(params, tokens, cache)

@@ -247,13 +247,6 @@ class InferenceEngine:
                     f"speculative serving needs the standard KV pool; "
                     f"{model.config.model_type} has a family cache"
                 )
-            if self._mesh is not None and "pp" in self._mesh.axis_names and (
-                self._mesh.shape["pp"] > 1
-            ):
-                raise NotImplementedError(
-                    "speculative serving under pipeline parallelism is not "
-                    "wired; use a tp/dp mesh"
-                )
             if draft_params is None:
                 self._draft_params = model.self_draft_params()
             # the draft pool is ALWAYS dense (even when the target pool is

@@ -602,3 +602,58 @@ def test_iq_tables_parse_ggml_common(tmp_path, rng):
     parsed = _parse_ggml_common(str(p2))
     for name in _REQUIRED:
         np.testing.assert_array_equal(parsed[name], tabs[name])
+
+
+def test_iq_tables_fetch_and_cache(rng, tmp_path, monkeypatch):
+    """VERDICT r04 missing #5 (turnkey IQ): fetch_tables downloads a
+    ggml-common.h (file:// stands in for the zero-egress sandbox),
+    parses the grids, and caches an npz that later iq_tables() calls
+    load with no env var and no network."""
+    from bigdl_tpu.quant import iq_quants
+
+    tables = _synthetic_iq_tables(rng)
+    lines = []
+    for name in ("iq2xxs_grid", "iq2xs_grid", "iq1s_grid"):
+        u64 = np.ascontiguousarray(tables[name]).view(np.uint64)[:, 0]
+        body = ",\n".join(f"0x{v:016x}" for v in u64)
+        lines.append(
+            f"GGML_TABLE_BEGIN(uint64_t, {name}, {len(u64)})\n"
+            f"{body},\nGGML_TABLE_END()\n"
+        )
+    header = tmp_path / "ggml-common.h"
+    header.write_text("\n".join(lines))
+
+    cache_home = tmp_path / "cache"
+    monkeypatch.setenv("XDG_CACHE_HOME", str(cache_home))
+    monkeypatch.delenv("BIGDL_TPU_IQ_TABLES", raising=False)
+    saved = iq_quants._TABLES
+    try:
+        iq_quants._TABLES = None
+        got = iq_quants.fetch_tables(url=header.as_uri())
+        for name, t in tables.items():
+            np.testing.assert_array_equal(got[name], t)
+        assert (cache_home / "bigdl_tpu" / "iq_tables.npz").exists()
+
+        # a fresh process state resolves from the cache, no env/net
+        iq_quants._TABLES = None
+        got2 = iq_quants.iq_tables(autofetch=False)
+        for name, t in tables.items():
+            np.testing.assert_array_equal(got2[name], t)
+    finally:
+        iq_quants._TABLES = saved
+
+
+def test_iq_tables_error_names_the_fetch_cli(tmp_path, monkeypatch):
+    """Without tables, cache, or network, the error must hand the user
+    the one-time fix (the fetch CLI + cache path)."""
+    from bigdl_tpu.quant import iq_quants
+
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "empty"))
+    monkeypatch.delenv("BIGDL_TPU_IQ_TABLES", raising=False)
+    saved = iq_quants._TABLES
+    try:
+        iq_quants._TABLES = None
+        with pytest.raises(RuntimeError, match="fetch-iq-tables"):
+            iq_quants.iq_tables(autofetch=False)
+    finally:
+        iq_quants._TABLES = saved

@@ -96,3 +96,53 @@ def test_engine_pp_mid_flight_admission():
     ref_eng.run_until_idle()
     assert r1.out_tokens == ref1.out_tokens
     assert r2.out_tokens == ref2.out_tokens
+
+
+def test_pp_lookup_matches_single_device():
+    """VERDICT r04 missing/weak #6: prompt-lookup decoding runs through
+    the pipeline step (forward_fn) — greedy output matches plain
+    generate on a single device."""
+    single = build()
+    # repetitive prompt so lookup finds real n-gram candidates
+    prompt = [[5, 6, 7, 8, 5, 6, 7, 8, 5, 6, 7, 8, 9, 10, 5, 6]]
+    want = single.generate(prompt, max_new_tokens=10)
+    model = build(pp=2, tp=1)
+    got = model.generate_lookup(prompt, max_new_tokens=10)
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_pp_snapkv_matches_single_device():
+    """SnapKV compression under pp: the pipeline step now threads
+    collect_obs (per-stage observation queries committed on the active
+    tick), so compress_kv no longer downgrades to full-cache decode."""
+    single = build()
+    prompt = [list(range(3, 51))]  # 48 tokens, budget 32 -> compresses
+    want = single.generate(prompt, max_new_tokens=8, compress_kv=32,
+                           compress_window=8)
+    model = build(pp=2, tp=1)
+    got = model.generate(prompt, max_new_tokens=8, compress_kv=32,
+                         compress_window=8)
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_engine_pp_speculative_matches_plain():
+    """In-engine speculative decoding over a (pp=2, tp=2) mesh: greedy
+    output byte-identical to plain single-device serving."""
+    from bigdl_tpu.serving.engine import InferenceEngine
+
+    plain = build()
+    ref_eng = InferenceEngine(plain, n_slots=2, max_len=64)
+    refs = [ref_eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+    ref_eng.run_until_idle()
+
+    model = build(pp=2, tp=2)
+    eng = InferenceEngine(model, n_slots=2, max_len=64, speculative=True,
+                          draft_params=model.params, draft_k=3)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+    eng.run_until_idle(max_steps=200)
+    for r, ref in zip(reqs, refs):
+        assert r.done and r.out_tokens == ref.out_tokens, (
+            r.out_tokens, ref.out_tokens
+        )
+    assert eng.spec_rounds > 0
+    assert eng.spec_emitted / eng.spec_rounds > 1.0
