@@ -571,7 +571,9 @@ def test_iq2xxs_gguf_loads_and_generates(tmp_path, rng, iq_env):
 def test_iq_tables_parse_ggml_common(tmp_path, rng):
     """Both ggml-common.h declaration styles parse: the GGML_TABLE_BEGIN
     macro form and the legacy C array with a symbolic size."""
-    from bigdl_tpu.quant.iq_quants import _REQUIRED, _parse_ggml_common
+    from bigdl_tpu.quant.iq_quants import _REQUIRED
+    from bigdl_tpu.quant.iq_quants import _parse_ggml_common_text
+    _parse_ggml_common = lambda p: _parse_ggml_common_text(open(p).read())
 
     tabs = _synthetic_iq_tables(rng)
 
