@@ -174,7 +174,15 @@ class InferenceEngine:
             self._prefix_cache: dict[Any, int] = {}  # chunk key -> page
             self._page_key: dict[int, Any] = {}  # reverse map for eviction
             self._prefix_lru: list[Any] = []  # keys, oldest first
+            # parent prefix -> child keys one page deeper: the sub-page
+            # match scans only the shared run's direct children instead
+            # of the whole cache (O(children) per admission)
+            self._prefix_children: dict[Any, set] = {}
             self.prefix_hits = 0
+            # sub-page sharing: cached-page KV copied instead of
+            # re-prefilled when a prefix diverges mid-page
+            self.prefix_partial_hits = 0
+            self.prefix_tokens_reused = 0
             self._bt_host = np.zeros(
                 (n_slots, self.max_pages_per_row), np.int32
             )
@@ -222,6 +230,9 @@ class InferenceEngine:
         self._paged_prefill = self._with_mesh(jax.jit(
             functools.partial(self._paged_prefill_impl, fwd),
             donate_argnames=("k", "v", "ks", "vs"),
+        ))
+        self._copy_page = self._with_mesh(jax.jit(
+            self._copy_page_impl, donate_argnames=("cache",)
         ))
         # --- in-engine speculative decoding (reference serves it through
         # ipex_llm_worker.py:72-99; SURVEY §7 names "continuous batching +
@@ -366,6 +377,18 @@ class InferenceEngine:
             upd["start"] = cache.start.at[slot].set(pad)
             return dataclasses.replace(cache, **upd)
         return kvcache.insert_row(cache, pcache, slot, pad)
+
+    @staticmethod
+    def _copy_page_impl(cache, src, dst):
+        """Duplicate one physical page's KV (all layers) into another —
+        the sub-page prefix-sharing copy (slots past the shared run are
+        overwritten by the tail prefill or masked by pos)."""
+        upd = {"k": cache.k.at[:, dst].set(cache.k[:, src]),
+               "v": cache.v.at[:, dst].set(cache.v[:, src])}
+        if cache.quantized:
+            upd["k_scale"] = cache.k_scale.at[:, dst].set(cache.k_scale[:, src])
+            upd["v_scale"] = cache.v_scale.at[:, dst].set(cache.v_scale[:, src])
+        return dataclasses.replace(cache, **upd)
 
     def _paged_prefill_impl(self, forward, params, k, v, ks, vs, row_bt,
                             pos0, tokens, last_idx):
@@ -534,6 +557,9 @@ class InferenceEngine:
                 del self._prefix_cache[key]
                 self._prefix_lru.remove(key)
                 del self._page_key[pg]
+                kids = self._prefix_children.get(key[:-self.page_size])
+                if kids:
+                    kids.discard(key)
                 self._page_ref[pg] = 1
                 return pg
         return None
@@ -575,9 +601,50 @@ class InferenceEngine:
         n_hit = len(shared)
         lp = n_hit * page
         tail = prompt[lp:]
-        bucket = min(round_up(max(len(tail), 16), 32), self.max_len - lp)
 
-        need = -(-(lp + bucket) // page) - n_hit
+        # sub-page sharing: a cached page one level deeper whose tokens
+        # agree with our tail for t_copy tokens lets us COPY those KV
+        # slots instead of re-prefilling them (prefixes shorter than a
+        # page previously recomputed from scratch). Capped at
+        # len(tail)-1 so the last real token always prefills (its
+        # logits seed generation).
+        t_copy, src_page = 0, None
+        if len(tail) > 1:
+            head = tuple(prompt[:lp])
+            for key in self._prefix_children.get(head, ()):
+                pg = self._prefix_cache.get(key)
+                if pg is None:
+                    continue
+                m = 0
+                for a, b in zip(key[lp:], tail):
+                    if a != b:
+                        break
+                    m += 1
+                if m > t_copy:
+                    t_copy, src_page = m, pg
+            t_copy = min(t_copy, len(tail) - 1)
+            if t_copy == 0:
+                src_page = None
+
+        def plan(cut):
+            b = min(round_up(max(len(prompt) - lp - cut, 16), 32),
+                    self.max_len - lp - cut)
+            return b, -(-(lp + cut + b) // page) - n_hit
+
+        bucket0, need0 = plan(0)
+        if src_page is not None:
+            bucket, need = plan(t_copy)
+            # prefill cost is quantized to the bucket/page plan: a copy
+            # that doesn't shrink either is pure added latency (the
+            # page-copy dispatch + LRU bookkeeping) — skip it
+            if bucket >= bucket0 and need >= need0:
+                t_copy, src_page = 0, None
+                bucket, need = bucket0, need0
+        else:
+            t_copy = 0
+            bucket, need = bucket0, need0
+        lp_eff = lp + t_copy
+        tail2 = prompt[lp_eff:]
         if need > self.n_pages - 1:  # can NEVER be satisfied (page 0 is
             # scratch): fail now instead of head-of-line blocking forever
             self._fail_request(req, (
@@ -585,11 +652,14 @@ class InferenceEngine:
                 f"{self.n_pages - 1}; raise n_pages or shorten the prompt"
             ))
             return True  # consumed (failed), keep admitting others
-        # incref shared pages BEFORE allocating fresh ones — _alloc_page's
-        # LRU eviction must not evict a page out of this very request's
-        # prefix (refcount 0 pages are fair eviction game)
+        # incref shared pages (and the sub-page copy source) BEFORE
+        # allocating fresh ones — _alloc_page's LRU eviction must not
+        # evict a page out of this very request's prefix (refcount 0
+        # pages are fair eviction game)
         for pg in shared:
             self._page_ref[pg] += 1
+        if src_page is not None:
+            self._page_ref[src_page] += 1
         fresh: list[int] = []
         for _ in range(need):
             pg = self._alloc_page()
@@ -599,6 +669,8 @@ class InferenceEngine:
                     self._free_pages.append(q)
                 for q in shared:
                     self._page_ref[q] -= 1
+                if src_page is not None:
+                    self._page_ref[src_page] -= 1
                 return False
             fresh.append(pg)
         if n_hit:
@@ -619,14 +691,29 @@ class InferenceEngine:
         self._bt_host[slot] = row
         self._bt_dirty = True
 
+        if src_page is not None:
+            # copy the WHOLE source page (one static-shape program;
+            # slots past t_copy are overwritten by the tail prefill or
+            # masked by pos), then release the copy hold
+            self.cache = self._copy_page(
+                self.cache, jnp.asarray(src_page), jnp.asarray(fresh[0])
+            )
+            self._page_ref[src_page] -= 1
+            self.prefix_partial_hits += 1
+            self.prefix_tokens_reused += t_copy
+            src_key = self._page_key.get(src_page)
+            if src_key in self._prefix_lru:  # refresh: it just proved hot
+                self._prefix_lru.remove(src_key)
+                self._prefix_lru.append(src_key)
+
         toks = np.full((1, bucket), self.gen.pad_token_id, np.int32)
-        toks[0, : len(tail)] = tail  # RIGHT pad: writes past pos get
+        toks[0, : len(tail2)] = tail2  # RIGHT pad: writes past pos get
         # overwritten by decode and are masked meanwhile
         logits_last, k, v, ks, vs = self._paged_prefill(
             self.model.params, self.cache.k, self.cache.v,
             self.cache.k_scale, self.cache.v_scale,
-            jnp.asarray(row[None]), jnp.asarray([lp], jnp.int32),
-            jnp.asarray(toks), jnp.asarray(len(tail) - 1),
+            jnp.asarray(row[None]), jnp.asarray([lp_eff], jnp.int32),
+            jnp.asarray(toks), jnp.asarray(len(tail2) - 1),
         )
         self.cache = dataclasses.replace(
             self.cache, k=k, v=v, k_scale=ks, v_scale=vs,
@@ -642,6 +729,8 @@ class InferenceEngine:
                 self._prefix_cache[key] = table[i]
                 self._page_key[table[i]] = key
                 self._prefix_lru.append(key)
+                self._prefix_children.setdefault(key[:i * page], set()
+                                                 ).add(key)
 
         if self.speculative:
             # prefix-cache hits only save TARGET prefill; the draft
@@ -827,6 +916,7 @@ class InferenceEngine:
             self._prefix_cache.clear()
             self._page_key.clear()
             self._prefix_lru.clear()
+            self._prefix_children.clear()
             self._bt_host[:] = 0
             self._bt_dirty = True
 

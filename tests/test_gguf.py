@@ -573,7 +573,9 @@ def test_iq_tables_parse_ggml_common(tmp_path, rng):
     macro form and the legacy C array with a symbolic size."""
     from bigdl_tpu.quant.iq_quants import _REQUIRED
     from bigdl_tpu.quant.iq_quants import _parse_ggml_common_text
-    _parse_ggml_common = lambda p: _parse_ggml_common_text(open(p).read())
+    import pathlib
+    _parse_ggml_common = lambda p: _parse_ggml_common_text(
+        pathlib.Path(p).read_text())
 
     tabs = _synthetic_iq_tables(rng)
 

