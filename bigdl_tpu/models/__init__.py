@@ -85,6 +85,11 @@ from bigdl_tpu.models import janus  # noqa: E402  (delegates text to llama)
 _FAMILIES["janus"] = janus
 _FAMILIES["multi_modality"] = janus  # original janus checkpoints
 
+from bigdl_tpu.models import chatglm4v  # noqa: E402  (delegates text to llama)
+
+# THUDM glm-4v-9b: chatglm text schema + EVA2-CLIP tower/adapter
+_FAMILIES["chatglm4v"] = chatglm4v
+
 from bigdl_tpu.models import deepseek  # noqa: E402  (MLA latent-KV cache)
 
 _FAMILIES["deepseek_v2"] = deepseek
@@ -116,6 +121,11 @@ _FAMILIES["rwkv5"] = rwkv
 # _FAMILIES, whose consumers (optimize_model, TpuModel.generate) assume
 # the decoder signature; it is served through the api_server's
 # /v1/audio/transcriptions endpoint (whisper= kwarg) instead
+#
+# sd (models/sd.py) is likewise outside the registry: a diffusion UNet +
+# DDIM sampler with (latents, t, context) call shape — pair it with the
+# diffusers attention processor in integrations/diffusers.py or drive it
+# directly (params_from_state_dict ingests a diffusers UNet checkpoint)
 
 
 def get_family(model_type: str):

@@ -1,0 +1,290 @@
+"""ChatGLM4V (THUDM glm-4v-9b remote-code schema): EVA2-CLIP vision
+tower + conv/GLU adapter over the chatglm decoder.
+
+TPU-native counterpart of the reference's chatglm4v support
+(/root/reference/python/llm/src/ipex_llm/transformers/models/chatglm4v.py:
+patch_embedding_forward :293-300 — conv proj, cls token, absolute
+position embedding; visual_attention_forward :261-290 — fused
+query_key_value; chatglm4v_model_forward :43-93 — image features
+replace the [boi, placeholder, eoi] span and every patch shares ONE
+rope position). Architecture per THUDM's visual.py:
+
+- tower: conv patch embed + cls + learned positions; transformer blocks
+  apply LayerNorm to each SUBLAYER OUTPUT (x + ln(attn(x)), then
+  x + ln(mlp(x)) — EVA2-CLIP's post-sublayer norm, unlike CLIP/SigLIP
+  pre-LN);
+- adapter: drop cls, regrid, 2x2 stride-2 conv into the text hidden
+  size, then the GLU projector (linear -> LN -> gelu -> silu(gate) *
+  up -> down), learned boi/eoi embeddings concatenated around the
+  patches, all divided by scaling_factor;
+- insertion: features (boi + patches + eoi) replace the prompt's
+  3-token [boi_token_id, placeholder, eoi_token_id] span; rope
+  positions repeat boi_pos+1 across every patch (llama.forward's
+  `positions` override), and the cache's rope_base carries the true
+  next position so decode continues correctly;
+- text: the chatglm decoder (interleaved half-dim rope) — the llama
+  family via the "chatglm" ModelConfig translation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.models import llama
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import layer_norm
+
+# the text side delegates wholesale to the llama family (chatglm flags)
+init_params = llama.init_params
+quantize_params = llama.quantize_params
+forward = llama.forward
+merge_fused_params = llama.merge_fused_params
+unmerge_fused_params = llama.unmerge_fused_params
+
+
+@dataclasses.dataclass(frozen=True)
+class EvaVisionConfig:
+    hidden_size: int = 1792
+    num_hidden_layers: int = 63
+    num_heads: int = 16
+    intermediate_size: int = 15360
+    image_size: int = 1120
+    patch_size: int = 14
+    scaling_factor: float = 8.0
+    layer_norm_eps: float = 1e-6
+    text_hidden_size: int = 4096  # adapter output dim
+    ffn_hidden_size: int = 13696  # GLU inner dim (text config's)
+
+    @classmethod
+    def from_hf(cls, vision: dict, text_hidden: int, ffn_hidden: int
+                ) -> "EvaVisionConfig":
+        return cls(
+            hidden_size=vision["hidden_size"],
+            num_hidden_layers=vision["num_hidden_layers"],
+            num_heads=vision["num_heads"],
+            intermediate_size=vision["intermediate_size"],
+            image_size=vision["image_size"],
+            patch_size=vision["patch_size"],
+            scaling_factor=vision.get("scaling_factor", 8.0),
+            layer_norm_eps=vision.get("layer_norm_eps", 1e-6),
+            text_hidden_size=text_hidden,
+            ffn_hidden_size=ffn_hidden,
+        )
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def n_patches(self) -> int:  # after the 2x2 conv downsample
+        return (self.grid // 2) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return 3 * self.patch_size ** 2
+
+
+def vision_params_from_state_dict(
+    vcfg: EvaVisionConfig, get, prefix: str = "transformer.vision."
+) -> dict:
+    """THUDM glm-4v-9b `transformer.vision.*` names -> our tree."""
+    def g(name):
+        return np.asarray(get(prefix + name), np.float32)
+
+    E = vcfg.hidden_size
+    blocks: dict[str, list] = {}
+    names = [
+        ("ln1_w", "input_layernorm.weight"), ("ln1_b", "input_layernorm.bias"),
+        ("ln2_w", "post_attention_layernorm.weight"),
+        ("ln2_b", "post_attention_layernorm.bias"),
+        ("wqkv", "attention.query_key_value.weight"),
+        ("bqkv", "attention.query_key_value.bias"),
+        ("wo", "attention.dense.weight"), ("bo", "attention.dense.bias"),
+        ("fc1_w", "mlp.fc1.weight"), ("fc1_b", "mlp.fc1.bias"),
+        ("fc2_w", "mlp.fc2.weight"), ("fc2_b", "mlp.fc2.bias"),
+    ]
+    for i in range(vcfg.num_hidden_layers):
+        for key, suffix in names:
+            blocks.setdefault(key, []).append(
+                g(f"transformer.layers.{i}.{suffix}")
+            )
+    params = {
+        "patch_proj": g("patch_embedding.proj.weight").reshape(E, -1),
+        "patch_bias": g("patch_embedding.proj.bias"),
+        "cls_token": g("patch_embedding.cls_embedding").reshape(1, E),
+        "pos_embed": g("patch_embedding.position_embedding.weight"),
+        "blocks": {k: jnp.asarray(np.stack(v)) for k, v in blocks.items()},
+        # adapter
+        "conv_w": g("conv.weight"),  # [text_E, E, 2, 2]
+        "conv_b": g("conv.bias"),
+        "glu_in": g("linear_proj.linear_proj.weight"),
+        "glu_ln_w": g("linear_proj.norm1.weight"),
+        "glu_ln_b": g("linear_proj.norm1.bias"),
+        "glu_gate": g("linear_proj.gate_proj.weight"),
+        "glu_up": g("linear_proj.dense_h_to_4h.weight"),
+        "glu_down": g("linear_proj.dense_4h_to_h.weight"),
+        "boi": g("boi").reshape(1, -1),
+        "eoi": g("eoi").reshape(1, -1),
+    }
+    return jax.tree.map(jnp.asarray, params)
+
+
+def vision_forward(
+    vcfg: EvaVisionConfig,
+    vparams: dict,
+    patches: jax.Array,  # [B, N, patch_dim] flattened pixel patches
+) -> jax.Array:
+    """[B, N, patch_dim] -> [B, N+1, E] tower hidden states (cls first).
+    EVA2-CLIP block: x + LN(attn(x)), then x + LN(mlp(x)) — the norm
+    wraps the sublayer OUTPUT (reference visual layout)."""
+    B, N, _ = patches.shape
+    E, Hh, D = vcfg.hidden_size, vcfg.num_heads, vcfg.head_dim
+    eps = vcfg.layer_norm_eps
+
+    h = (
+        jnp.einsum("bnd,ed->bne", patches.astype(jnp.float32),
+                   vparams["patch_proj"])
+        + vparams["patch_bias"]
+    )
+    cls = jnp.broadcast_to(vparams["cls_token"][None], (B, 1, E))
+    h = jnp.concatenate([cls, h], axis=1)
+    h = h + vparams["pos_embed"][None, : N + 1]
+    S = N + 1
+    scale = D ** -0.5
+
+    def block(h, p):
+        qkv = jnp.einsum("bne,fe->bnf", h, p["wqkv"]) + p["bqkv"]
+        q, k, v = jnp.split(qkv.reshape(B, S, 3, Hh, D), 3, axis=2)
+        q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+        att = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
+        att = jax.nn.softmax(att, axis=-1)
+        ctx = jnp.einsum("bhnm,bmhd->bnhd", att, v).reshape(B, S, E)
+        out = jnp.einsum("bne,fe->bnf", ctx, p["wo"]) + p["bo"]
+        h = h + layer_norm(out, p["ln1_w"], p["ln1_b"], eps)
+
+        x = jnp.einsum("bne,fe->bnf", h, p["fc1_w"]) + p["fc1_b"]
+        x = jax.nn.gelu(x, approximate=False)
+        x = jnp.einsum("bnf,ef->bne", x, p["fc2_w"]) + p["fc2_b"]
+        h = h + layer_norm(x, p["ln2_w"], p["ln2_b"], eps)
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, vparams["blocks"])
+    return h
+
+
+def image_features(
+    vcfg: EvaVisionConfig,
+    vparams: dict,
+    patches: jax.Array,  # [B, N, patch_dim]
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """Tower -> drop cls -> 2x2 conv -> GLU -> boi/eoi wrap ->
+    / scaling_factor. Returns [B, n_patches + 2, text_hidden]."""
+    h = vision_forward(vcfg, vparams, patches)[:, 1:]  # drop cls
+    B, N, E = h.shape
+    g = int(round(float(np.sqrt(N))))
+    grid = h.reshape(B, g, g, E)  # NHWC
+    x = jax.lax.conv_general_dilated(
+        grid, jnp.transpose(vparams["conv_w"], (2, 3, 1, 0)),  # HWIO
+        window_strides=(2, 2), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + vparams["conv_b"]
+    x = x.reshape(B, -1, x.shape[-1])  # [B, (g/2)^2, text_E]
+
+    x = jnp.einsum("bnk,fk->bnf", x, vparams["glu_in"])
+    x = jax.nn.gelu(
+        layer_norm(x, vparams["glu_ln_w"], vparams["glu_ln_b"], 1e-5),
+        approximate=False,
+    )
+    x = (jax.nn.silu(jnp.einsum("bnf,gf->bng", x, vparams["glu_gate"]))
+         * jnp.einsum("bnf,gf->bng", x, vparams["glu_up"]))
+    x = jnp.einsum("bng,fg->bnf", x, vparams["glu_down"])
+
+    boi = jnp.broadcast_to(vparams["boi"][None], (B, 1, x.shape[-1]))
+    eoi = jnp.broadcast_to(vparams["eoi"][None], (B, 1, x.shape[-1]))
+    x = jnp.concatenate([boi, x, eoi], axis=1) / vcfg.scaling_factor
+    return x.astype(out_dtype)
+
+
+def build_multimodal_inputs(
+    config: ModelConfig,
+    params: dict,
+    input_ids: np.ndarray,  # [B, T] with a [boi, placeholder, eoi] span
+    feats: jax.Array,  # [B, P+2, H] image_features output
+    boi_token_id: int,
+    eoi_token_id: int,
+    compute_dtype=jnp.bfloat16,
+):
+    """Reference insertion semantics (chatglm4v_model_forward :60-93):
+    features replace the 3-token span; every patch repeats rope position
+    boi_pos+1. Returns (embeds [B, T'], positions [B, T']). Rows must
+    carry the span at the same offset (one image per row, HF batch
+    contract)."""
+    B, T = input_ids.shape
+    P2 = feats.shape[1]  # P + 2
+    ids = np.asarray(input_ids)
+    boi_pos = [int(np.nonzero(ids[b] == boi_token_id)[0][0]) for b in range(B)]
+    eoi_pos = [int(np.nonzero(ids[b] == eoi_token_id)[0][0]) for b in range(B)]
+    if len(set(boi_pos)) != 1 or len(set(eoi_pos)) != 1:
+        raise ValueError("all rows must carry the image span at the same "
+                         f"offset; got boi {boi_pos}, eoi {eoi_pos}")
+    a, b = boi_pos[0], eoi_pos[0]
+    if b - a != 2:
+        raise ValueError(f"expected [boi, placeholder, eoi]; eoi-boi = {b - a}")
+
+    h = llama.embed_tokens(config, params, jnp.asarray(ids), compute_dtype)
+    embeds = jnp.concatenate(
+        [h[:, :a], feats.astype(compute_dtype), h[:, b + 1:]], axis=1
+    )
+    base = np.arange(T, dtype=np.int32)
+    positions = np.concatenate([
+        base[: a + 1],
+        np.full((P2 - 2,), a + 1, np.int32),  # every patch shares a+1
+        base[b:],
+    ])
+    return embeds, jnp.asarray(np.tile(positions[None], (B, 1)))
+
+
+def multimodal_prefill(
+    config: ModelConfig,
+    vcfg: EvaVisionConfig,
+    params: dict,
+    vparams: dict,
+    input_ids: np.ndarray,
+    patches: jax.Array,
+    cache_len: int,
+    boi_token_id: int,
+    eoi_token_id: int,
+    compute_dtype=jnp.bfloat16,
+):
+    """Image prefill: tower + adapter, span insertion, one text forward
+    with the position override; the returned cache's rope_base carries
+    the true next position so plain decode continues correctly."""
+    feats = image_features(vcfg, vparams, patches, out_dtype=compute_dtype)
+    embeds, positions = build_multimodal_inputs(
+        config, params, input_ids, feats, boi_token_id, eoi_token_id,
+        compute_dtype,
+    )
+    B = embeds.shape[0]
+    cache = kvcache.init_cache(
+        config.num_hidden_layers, B, cache_len,
+        config.num_key_value_heads, config.head_dim_,
+    )
+    logits, cache = llama.forward(
+        config, params, embeds, cache, mode="prefill",
+        compute_dtype=compute_dtype, input_is_hidden=True,
+        positions=positions,
+    )
+    cache = dataclasses.replace(
+        cache, rope_base=positions[:, -1] + 1
+    )
+    return logits, cache

@@ -513,6 +513,10 @@ def forward(
     layer_offset=0,  # global index of params['layers'][0] (pipeline stages)
     position_grid=None,  # [3, B, T] M-RoPE (t, h, w) positions — multimodal
     # prefill only (models/qwen2_vl.py); None = standard 1-D positions
+    positions=None,  # [B, T] explicit 1-D rope/learned positions — remote-
+    # code schemes where slot != position (chatglm4v repeats the image
+    # span's position across all patches); pair with cache.rope_base so
+    # decode continues from the true last position
     last_logits_only: bool = False,  # static: lm head on the last position
     # only — prefill skips the [B,T,V] logits (reference
     # reshape_lm_head_input / IPEX_LLM_LAST_LM_HEAD,
@@ -554,7 +558,9 @@ def forward(
     # position in rope_base. pos may be per-row (serving engine).
     pos_col = pos0[:, None] if pos0.ndim == 1 else pos0
     slots = pos_col + jnp.arange(T)[None, :]  # [B|1, T] global cache slots
-    if cache is not None:
+    if positions is not None:
+        positions = positions.astype(jnp.int32)  # caller-supplied override
+    elif cache is not None:
         positions = cache.next_positions(T)  # [B, T]
     else:
         positions = jnp.maximum(slots - row_start[:, None], 0)  # [B, T]

@@ -1,0 +1,230 @@
+"""ChatGLM4V (glm-4v-9b) tests: EVA2-CLIP tower + conv/GLU adapter
+against a torch oracle implementing the THUDM visual.py layout (the
+remote-code model has no in-library transformers class), and the
+image-span insertion / repeated-position prefill against a cache-free
+full-sequence forward."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.models import chatglm4v, get_family, llama
+from bigdl_tpu.models.config import ModelConfig
+
+VCFG = chatglm4v.EvaVisionConfig(
+    hidden_size=32, num_hidden_layers=2, num_heads=4,
+    intermediate_size=64, image_size=28, patch_size=7,
+    scaling_factor=8.0, text_hidden_size=48, ffn_hidden_size=40,
+)
+
+TCFG = ModelConfig(
+    model_type="chatglm4v", vocab_size=128, hidden_size=48,
+    intermediate_size=96, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, max_position_embeddings=128,
+)
+
+BOI, EOI, PLACEHOLDER = 120, 121, 122
+
+
+class TorchEva(torch.nn.Module):
+    """Oracle following THUDM glm-4v-9b visual.py (layouts cited in
+    models/chatglm4v.py's docstring): conv patch embed + cls + learned
+    positions; blocks x + LN(attn(x)) / x + LN(mlp(x)); adapter 2x2
+    conv -> GLU -> boi/eoi -> / scaling_factor."""
+
+    def __init__(self, v: chatglm4v.EvaVisionConfig):
+        super().__init__()
+        E, I = v.hidden_size, v.intermediate_size
+        self.v = v
+        self.proj = torch.nn.Conv2d(3, E, v.patch_size, v.patch_size)
+        n = v.grid ** 2 + 1
+        self.cls_embedding = torch.nn.Parameter(torch.randn(1, E))
+        self.position_embedding = torch.nn.Embedding(n, E)
+        self.layers = torch.nn.ModuleList()
+        for _ in range(v.num_hidden_layers):
+            blk = torch.nn.Module()
+            blk.input_layernorm = torch.nn.LayerNorm(E, eps=v.layer_norm_eps)
+            blk.post_attention_layernorm = torch.nn.LayerNorm(
+                E, eps=v.layer_norm_eps)
+            blk.query_key_value = torch.nn.Linear(E, 3 * E)
+            blk.dense = torch.nn.Linear(E, E)
+            blk.fc1 = torch.nn.Linear(E, I)
+            blk.fc2 = torch.nn.Linear(I, E)
+            self.layers.append(blk)
+        T = v.text_hidden_size
+        self.conv = torch.nn.Conv2d(E, T, kernel_size=2, stride=2)
+        self.linear_proj = torch.nn.Linear(T, T, bias=False)
+        self.norm1 = torch.nn.LayerNorm(T)
+        self.gate_proj = torch.nn.Linear(T, v.ffn_hidden_size, bias=False)
+        self.dense_h_to_4h = torch.nn.Linear(T, v.ffn_hidden_size, bias=False)
+        self.dense_4h_to_h = torch.nn.Linear(v.ffn_hidden_size, T, bias=False)
+        self.boi = torch.nn.Parameter(torch.randn(1, 1, T))
+        self.eoi = torch.nn.Parameter(torch.randn(1, 1, T))
+
+    def tower(self, images):
+        v = self.v
+        x = self.proj(images).flatten(2).transpose(1, 2)  # [B, N, E]
+        cls = self.cls_embedding.expand(x.shape[0], 1, -1)
+        x = torch.cat((cls, x), dim=1)
+        x = x + self.position_embedding.weight.unsqueeze(0)
+        B, S, E = x.shape
+        Hh, D = v.num_heads, v.head_dim
+        for blk in self.layers:
+            qkv = blk.query_key_value(x).reshape(B, S, 3, Hh, D)
+            qkv = qkv.permute(2, 0, 3, 1, 4)
+            q, k, v_ = qkv[0], qkv[1], qkv[2]
+            out = torch.nn.functional.scaled_dot_product_attention(
+                q, k, v_, is_causal=False)
+            out = blk.dense(out.transpose(1, 2).reshape(B, S, E))
+            x = x + blk.input_layernorm(out)
+            m = blk.fc2(torch.nn.functional.gelu(blk.fc1(x)))
+            x = x + blk.post_attention_layernorm(m)
+        return x
+
+    def forward(self, images):
+        v = self.v
+        x = self.tower(images)[:, 1:]
+        B, N, E = x.shape
+        g = int(N ** 0.5)
+        x = x.view(B, g, g, E).permute(0, 3, 1, 2)
+        x = self.conv(x)
+        x = x.flatten(2).transpose(1, 2)
+        x = self.linear_proj(x)
+        x = torch.nn.functional.gelu(self.norm1(x))
+        x = torch.nn.functional.silu(self.gate_proj(x)) * self.dense_h_to_4h(x)
+        x = self.dense_4h_to_h(x)
+        boi = self.boi.expand(B, -1, -1)
+        eoi = self.eoi.expand(B, -1, -1)
+        return torch.cat((boi, x, eoi), dim=1) / v.scaling_factor
+
+
+def oracle_params(m: TorchEva) -> dict:
+    sd = {k: v.detach().to(torch.float32).numpy()
+          for k, v in m.state_dict().items()}
+    names = {
+        "patch_embedding.proj.weight": sd["proj.weight"],
+        "patch_embedding.proj.bias": sd["proj.bias"],
+        "patch_embedding.cls_embedding": sd["cls_embedding"],
+        "patch_embedding.position_embedding.weight":
+            sd["position_embedding.weight"],
+        "conv.weight": sd["conv.weight"],
+        "conv.bias": sd["conv.bias"],
+        "linear_proj.linear_proj.weight": sd["linear_proj.weight"],
+        "linear_proj.norm1.weight": sd["norm1.weight"],
+        "linear_proj.norm1.bias": sd["norm1.bias"],
+        "linear_proj.gate_proj.weight": sd["gate_proj.weight"],
+        "linear_proj.dense_h_to_4h.weight": sd["dense_h_to_4h.weight"],
+        "linear_proj.dense_4h_to_h.weight": sd["dense_4h_to_h.weight"],
+        "boi": sd["boi"],
+        "eoi": sd["eoi"],
+    }
+    for i in range(VCFG.num_hidden_layers):
+        for ours, theirs in [
+            ("input_layernorm.weight", f"layers.{i}.input_layernorm.weight"),
+            ("input_layernorm.bias", f"layers.{i}.input_layernorm.bias"),
+            ("post_attention_layernorm.weight",
+             f"layers.{i}.post_attention_layernorm.weight"),
+            ("post_attention_layernorm.bias",
+             f"layers.{i}.post_attention_layernorm.bias"),
+            ("attention.query_key_value.weight",
+             f"layers.{i}.query_key_value.weight"),
+            ("attention.query_key_value.bias",
+             f"layers.{i}.query_key_value.bias"),
+            ("attention.dense.weight", f"layers.{i}.dense.weight"),
+            ("attention.dense.bias", f"layers.{i}.dense.bias"),
+            ("mlp.fc1.weight", f"layers.{i}.fc1.weight"),
+            ("mlp.fc1.bias", f"layers.{i}.fc1.bias"),
+            ("mlp.fc2.weight", f"layers.{i}.fc2.weight"),
+            ("mlp.fc2.bias", f"layers.{i}.fc2.bias"),
+        ]:
+            names[f"transformer.layers.{i}.{ours}"] = sd[theirs]
+    return chatglm4v.vision_params_from_state_dict(
+        VCFG, lambda n: names[n], prefix=""
+    )
+
+
+def pixels_to_patches(pixels, p):
+    B, C, Hh, W = pixels.shape
+    g = Hh // p
+    return (
+        pixels.reshape(B, C, g, p, g, p)
+        .transpose(0, 2, 4, 1, 3, 5)
+        .reshape(B, g * g, -1)
+    )
+
+
+def test_tower_and_adapter_match_oracle():
+    torch.manual_seed(0)
+    m = TorchEva(VCFG).eval().to(torch.float32)
+    rng = np.random.default_rng(0)
+    pixels = rng.standard_normal((2, 3, 28, 28)).astype(np.float32)
+    with torch.no_grad():
+        want = m(torch.from_numpy(pixels)).numpy()
+
+    vparams = oracle_params(m)
+    patches = pixels_to_patches(pixels, VCFG.patch_size)
+    got = chatglm4v.image_features(VCFG, vparams, jnp.asarray(patches))
+    assert got.shape == (2, VCFG.n_patches + 2, VCFG.text_hidden_size)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+
+def test_multimodal_prefill_positions_and_decode():
+    """The [boi, placeholder, eoi] span is replaced by the features,
+    every patch shares one rope position, and decode continues from the
+    true next position (rope_base) — incremental decode == cache-free
+    full-sequence forward at every step."""
+    assert get_family("chatglm4v") is chatglm4v
+    torch.manual_seed(1)
+    m = TorchEva(VCFG).eval().to(torch.float32)
+    vparams = oracle_params(m)
+    params = llama.init_params(TCFG, jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(1)
+    pixels = rng.standard_normal((1, 3, 28, 28)).astype(np.float32)
+    patches = jnp.asarray(pixels_to_patches(pixels, VCFG.patch_size))
+    ids = np.asarray([[5, 6, BOI, PLACEHOLDER, EOI, 7, 8, 9]], np.int32)
+
+    logits, cache = chatglm4v.multimodal_prefill(
+        TCFG, VCFG, params, vparams, ids, patches, cache_len=64,
+        boi_token_id=BOI, eoi_token_id=EOI, compute_dtype=jnp.float32,
+    )
+    P2 = VCFG.n_patches + 2
+    T2 = ids.shape[1] - 3 + P2
+    assert logits.shape[1] == T2
+    assert int(cache.rope_base[0]) == ids.shape[1] - 3 + 2 + 1
+
+    # reference: cache-free forward over the same embeds + positions
+    feats = chatglm4v.image_features(VCFG, vparams, patches,
+                                     out_dtype=jnp.float32)
+    embeds, positions = chatglm4v.build_multimodal_inputs(
+        TCFG, params, ids, feats, BOI, EOI, jnp.float32)
+    toks = [int(jnp.argmax(logits[0, -1]))]
+    for _ in range(3):  # greedy decode through the cache
+        lg, cache = llama.forward(
+            TCFG, params, jnp.asarray([[toks[-1]]], jnp.int32), cache,
+            mode="decode", compute_dtype=jnp.float32,
+        )
+        # cache-free oracle over the full assembled sequence
+        emb_t = llama.embed_tokens(
+            TCFG, params, jnp.asarray([toks], jnp.int32), jnp.float32)
+        full = jnp.concatenate([embeds, emb_t], axis=1)
+        last = int(positions[0, -1])
+        pos_full = jnp.concatenate([
+            positions,
+            jnp.arange(last + 1, last + 1 + len(toks), dtype=jnp.int32)[None],
+        ], axis=1)
+        ref, _ = llama.forward(
+            TCFG, params, full, None, mode="prefill",
+            compute_dtype=jnp.float32, input_is_hidden=True,
+            positions=pos_full,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[0, -1]), np.asarray(ref[0, -1]),
+            rtol=1e-3, atol=1e-3,
+        )
+        toks.append(int(jnp.argmax(lg[0, -1])))
