@@ -995,6 +995,7 @@ _FAMILY_LAYER = {
     "starcoder2": _starcoder2_layer,
     "glm": _glm_layer,
     "chatglm": _chatglm_layer,
+    "chatglm4v": _chatglm_layer,
     "qwen2_vl": _qwen2_vl_layer,
     "mpt": _mpt_layer,
     "gpt2": _gpt2_layer,
@@ -1024,6 +1025,7 @@ _FAMILY_TOP = {
     "baichuan": _baichuan_top,
     "internlm2": _internlm2_top,
     "chatglm": _chatglm_top,
+    "chatglm4v": _chatglm_top,
     "qwen2_vl": _qwen2_vl_top,
     "mpt": _mpt_top,
     "gpt2": _gpt2_top,
@@ -1340,7 +1342,7 @@ def load_hf_checkpoint(
 # families whose layer builders slice/merge raw arrays (fused checkpoints) —
 # they must receive fp32, never packed QTensors
 _SPLIT_FAMILIES = {"phi3", "baichuan", "internlm2", "glm", "chatglm",
-                   "falcon"}  # falcon ungroups fused query_key_value
+                   "chatglm4v", "falcon"}  # falcon ungroups fused query_key_value
 
 
 def _wrap_quantized(get_tensor, quant_config: dict, model_type: str, qtype: str):

@@ -185,6 +185,12 @@ class ModelConfig:
         """Build from a HuggingFace config.json dict (the ingest path the
         reference drives through transformers AutoConfig, model.py:111)."""
         model_type = hf.get("model_type", "llama")
+        if model_type == "chatglm" and isinstance(hf.get("vision_config"),
+                                                  dict):
+            # THUDM glm-4v-9b ships model_type "chatglm" + a vision_config
+            # dict; route to the chatglm4v family (EVA2-CLIP tower over
+            # the same chatglm text schema)
+            model_type = "chatglm4v"
         if isinstance(hf.get("text_config"), dict):
             # multimodal configs nest the decoder fields (HF >= 4.52
             # qwen2_vl etc.); original checkpoints keep them at top level
@@ -822,6 +828,7 @@ _HF_BUILDERS = {
     "cohere": _hf_cohere,
     "qwen": _hf_qwen,
     "qwen_vl": _hf_qwen,  # Qwen-VL ships model_type "qwen" + visual dict
+    "chatglm4v": _hf_chatglm,  # glm-4v: chatglm text schema + vision_config
     "deci": _hf_deci,
     "gpt_bigcode": _hf_gptbigcode,
     "phixtral": _hf_phixtral,

@@ -228,3 +228,40 @@ def test_multimodal_prefill_positions_and_decode():
             rtol=1e-3, atol=1e-3,
         )
         toks.append(int(jnp.argmax(lg[0, -1])))
+
+
+def test_config_sniffs_glm4v_checkpoints():
+    """glm-4v-9b config.json ships model_type 'chatglm' + vision_config;
+    ingest must route to the chatglm4v family with the chatglm text
+    translation applied (fused checkpoints, interleaved half-dim rope)."""
+    from bigdl_tpu.models.config import ModelConfig
+
+    hf = {
+        "model_type": "chatglm",
+        "hidden_size": 64, "num_layers": 2, "num_attention_heads": 4,
+        "multi_query_attention": True, "multi_query_group_num": 2,
+        "ffn_hidden_size": 96, "padded_vocab_size": 128,
+        "kv_channels": 16, "seq_length": 256,
+        "boi_token_id": 100, "eoi_token_id": 101,
+        "vision_config": {
+            "hidden_size": 32, "num_hidden_layers": 2, "num_heads": 4,
+            "intermediate_size": 64, "image_size": 28, "patch_size": 7,
+            "scaling_factor": 8.0,
+        },
+    }
+    cfg = ModelConfig.from_hf_config(hf)
+    assert cfg.model_type == "chatglm4v"
+    assert get_family("chatglm4v") is chatglm4v
+    assert cfg.num_hidden_layers == 2 and cfg.intermediate_size == 96
+    assert cfg.rope_interleaved and cfg.partial_rotary_factor == 0.5
+    assert cfg.num_key_value_heads == 2
+
+    vcfg = chatglm4v.EvaVisionConfig.from_hf(
+        hf["vision_config"], text_hidden=cfg.hidden_size,
+        ffn_hidden=cfg.intermediate_size,
+    )
+    assert vcfg.grid == 4 and vcfg.n_patches == 4
+
+    # plain chatglm (no vision_config) still routes to the text family
+    hf2 = {k: v for k, v in hf.items() if k != "vision_config"}
+    assert ModelConfig.from_hf_config(hf2).model_type == "chatglm"
