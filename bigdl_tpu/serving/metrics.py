@@ -101,4 +101,34 @@ class Metrics:
                 "# TYPE bigdl_tpu_queue_depth gauge",
                 f"bigdl_tpu_queue_depth {self.engine._queue.qsize()}",
             ]
+            if self.engine.paged:
+                lines += [
+                    "# HELP bigdl_tpu_free_pages allocatable KV pages",
+                    "# TYPE bigdl_tpu_free_pages gauge",
+                    f"bigdl_tpu_free_pages {len(self.engine._free_pages)}",
+                    "# HELP bigdl_tpu_prefix_hits_total full-page prefix "
+                    "cache hits",
+                    "# TYPE bigdl_tpu_prefix_hits_total counter",
+                    f"bigdl_tpu_prefix_hits_total {self.engine.prefix_hits}",
+                    "# HELP bigdl_tpu_prefix_partial_hits_total sub-page "
+                    "prefix copies",
+                    "# TYPE bigdl_tpu_prefix_partial_hits_total counter",
+                    f"bigdl_tpu_prefix_partial_hits_total "
+                    f"{self.engine.prefix_partial_hits}",
+                    "# HELP bigdl_tpu_prefix_tokens_reused_total prompt "
+                    "tokens served from copied KV instead of prefill",
+                    "# TYPE bigdl_tpu_prefix_tokens_reused_total counter",
+                    f"bigdl_tpu_prefix_tokens_reused_total "
+                    f"{self.engine.prefix_tokens_reused}",
+                ]
+            if self.engine.speculative:
+                lines += [
+                    "# HELP bigdl_tpu_spec_rounds_total verify rounds run",
+                    "# TYPE bigdl_tpu_spec_rounds_total counter",
+                    f"bigdl_tpu_spec_rounds_total {self.engine.spec_rounds}",
+                    "# HELP bigdl_tpu_spec_emitted_total tokens emitted by "
+                    "verify rounds",
+                    "# TYPE bigdl_tpu_spec_emitted_total counter",
+                    f"bigdl_tpu_spec_emitted_total {self.engine.spec_emitted}",
+                ]
         return "\n".join(lines) + "\n"

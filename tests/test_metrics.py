@@ -186,3 +186,33 @@ def test_diffusers_integration_gated():
         d.TpuAttnProcessor()
     with pytest.raises(ImportError, match="diffusers"):
         d.upcast_vae(None)
+
+
+def test_engine_feature_gauges_render():
+    """Paged + speculative engines expose their cache/accept counters
+    on /metrics (prefix hits, sub-page copies, spec rounds)."""
+    import jax
+
+    from bigdl_tpu.api import TpuModel, optimize_model
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+    from bigdl_tpu.serving.engine import InferenceEngine
+    from bigdl_tpu.serving.metrics import Metrics
+
+    cfg = PRESETS["tiny-llama"]
+    model = TpuModel(cfg, optimize_model(
+        llama.init_params(cfg, jax.random.PRNGKey(0)), cfg
+    ), "sym_int4")
+    eng = InferenceEngine(model, n_slots=2, max_len=64, paged=True,
+                          page_size=8, speculative=True,
+                          draft_params=model.params, draft_k=3)
+    eng.submit([1, 2, 3, 4, 5], max_new_tokens=4)
+    eng.run_until_idle()
+    text = Metrics(eng).render()
+    for name in ("bigdl_tpu_free_pages", "bigdl_tpu_prefix_hits_total",
+                 "bigdl_tpu_prefix_partial_hits_total",
+                 "bigdl_tpu_prefix_tokens_reused_total",
+                 "bigdl_tpu_spec_rounds_total",
+                 "bigdl_tpu_spec_emitted_total"):
+        assert name in text, name
+    assert "bigdl_tpu_spec_rounds_total 0" not in text  # rounds ran
