@@ -55,6 +55,62 @@ def _emit(out, choice, n_acc, n_gen, max_k):
 _AUTO_EMA, _AUTO_TARGET, _AUTO_STEP, _AUTO_TH_EMA = 0.5, 0.9, 1e-2, 0.9
 
 
+def rejection_accept(
+    key: jax.Array,
+    probs: jax.Array,  # [B, K, V] target sampling distributions (filtered)
+    drafts: jax.Array,  # [B, K] greedy draft tokens (one-hot proposal q)
+    greedy: jax.Array,  # [B, K] target argmax tokens
+    row_greedy: jax.Array,  # [B] greedy rows: argmax-match acceptance
+    row_sampled: jax.Array,  # [B] sampling rows: rejection acceptance
+) -> tuple[jax.Array, jax.Array]:
+    """Speculative-sampling acceptance (Leviathan et al.) for a batched
+    verify round, vectorized over rows with mixed decode modes.
+
+    The draft proposes greedily, i.e. q = one-hot(d_i): draft token d_i
+    is accepted with probability p_i(d_i), and on rejection the residual
+    distribution max(p - q, 0)/Z reduces to p with d_i's mass zeroed,
+    renormalized — so every emitted token is an EXACT sample from its
+    p_i, same output law as plain sampling. Greedy rows keep the
+    deterministic argmax-match rule (byte-identical to non-speculative
+    serving); rows in neither mask (repetition-penalty rows, whose p
+    depends on tokens emitted earlier in the same round) accept 0.
+
+    Returns (n_acc [B], extra [B] — the token at position n_acc; the
+    caller emits drafts[:, :n_acc] then extra)."""
+    B, K, V = probs.shape
+    k_u, k_res = jax.random.split(key)
+
+    u = jax.random.uniform(k_u, (B, K - 1))
+    p_draft = jnp.take_along_axis(
+        probs[:, : K - 1], drafts[:, : K - 1, None], axis=-1
+    )[..., 0]  # [B, K-1]
+    acc_sampled = u < p_draft
+    acc_greedy = drafts[:, : K - 1] == greedy[:, : K - 1]
+    acc = jnp.where(row_greedy[:, None], acc_greedy, acc_sampled)
+    acc = acc & (row_greedy | row_sampled)[:, None]
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+
+    # the (n_acc+1)-th emitted token: on rejection at position n_acc,
+    # resample from p with the rejected draft's mass removed; when all
+    # K-1 drafts were accepted this is the bonus sample from p_{K-1}
+    p_n = jnp.take_along_axis(probs, n_acc[:, None, None], axis=1)[:, 0]
+    d_n = jnp.take_along_axis(
+        drafts, jnp.minimum(n_acc, K - 1)[:, None], axis=1
+    )[:, 0]
+    rejected = n_acc < (K - 1)
+    p_adj = jnp.where(
+        rejected[:, None],
+        p_n * (1.0 - jax.nn.one_hot(d_n, V, dtype=probs.dtype)),
+        p_n,
+    )
+    extra_sampled = jax.random.categorical(
+        k_res, jnp.log(p_adj + 1e-20), axis=-1
+    ).astype(jnp.int32)
+    extra_greedy = jnp.take_along_axis(greedy, n_acc[:, None], axis=1)[:, 0]
+    extra = jnp.where(row_sampled, extra_sampled, extra_greedy)
+    return n_acc, extra
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(

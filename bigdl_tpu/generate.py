@@ -86,6 +86,39 @@ def sample_token(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def filter_logits_per_row(
+    logits: jax.Array,  # [B, ..., V] float32
+    temperature: jax.Array,  # [B] float32
+    top_k: jax.Array,  # [B] int32, <=0 disables
+    top_p: jax.Array,  # [B] float32, >=1 disables
+) -> jax.Array:
+    """Temperature + top-k + top-p filtering with traced per-row params;
+    returns masked/scaled logits whose softmax is the exact sampling
+    distribution (shared by sample_token_per_row and the speculative
+    rejection-acceptance path, which needs the DISTRIBUTION, not just a
+    sample). Extra middle axes broadcast (verify rounds pass [B, K, V])."""
+    V = logits.shape[-1]
+    exp = (slice(None),) + (None,) * (logits.ndim - 1)
+    lt = logits / jnp.maximum(temperature, 1e-5)[exp]
+    sorted_desc = jnp.sort(lt, axis=-1)[..., ::-1]
+    # top-k first: threshold at the k-th largest value per row
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(top_k - 1, 0, V - 1)[exp], axis=-1
+    )
+    lt_k = jnp.where((top_k > 0)[exp] & (lt < kth), -jnp.inf, lt)
+    # top-p (nucleus) over the top-k-FILTERED, renormalized
+    # distribution (HF order; matches sample_token): -inf survivors
+    # sort last and carry zero probability
+    sorted_k = jnp.sort(lt_k, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_k, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    cutoff_idx = jnp.sum(cum < top_p[exp], axis=-1, keepdims=True) - 1
+    cutoff = jnp.take_along_axis(
+        sorted_k, jnp.clip(cutoff_idx, 0, V - 1), axis=-1
+    )
+    return jnp.where((top_p < 1.0)[exp] & (lt_k < cutoff), -jnp.inf, lt_k)
+
+
 def sample_token_per_row(
     logits: jax.Array,  # [B, V] float32
     key: jax.Array,
@@ -104,25 +137,7 @@ def sample_token_per_row(
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def run_sampling(_):
-        lt = logits / jnp.maximum(temperature, 1e-5)[:, None]
-        sorted_desc = jnp.sort(lt, axis=-1)[:, ::-1]
-        # top-k first: threshold at the k-th largest value per row
-        kth = jnp.take_along_axis(
-            sorted_desc, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=-1
-        )
-        lt_k = jnp.where((top_k > 0)[:, None] & (lt < kth), -jnp.inf, lt)
-        # top-p (nucleus) over the top-k-FILTERED, renormalized
-        # distribution (HF order; matches sample_token): -inf survivors
-        # sort last and carry zero probability
-        sorted_k = jnp.sort(lt_k, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_k, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1) - probs
-        cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True) - 1
-        cutoff = jnp.take_along_axis(
-            sorted_k, jnp.clip(cutoff_idx, 0, V - 1), axis=-1
-        )
-        masked = jnp.where((top_p < 1.0)[:, None] & (lt_k < cutoff),
-                           -jnp.inf, lt_k)
+        masked = filter_logits_per_row(logits, temperature, top_k, top_p)
         return jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
 
     # all-greedy batches (the serving engine's common case) skip the

@@ -241,7 +241,8 @@ class InferenceEngine:
         # draft steps, then ONE batched verify forward over the shared
         # target pool; per-row `pos` makes per-slot acceptance rollback a
         # vector subtraction. Greedy slots emit the target's greedy
-        # tokens — byte-identical to non-speculative serving; sampling /
+        # tokens — byte-identical to non-speculative serving; sampling
+        # slots accept drafts by rejection sampling (exact output law);
         # repetition-penalty slots ride along accepting 0 drafts (their
         # position-0 token is the regular sampler's).
         self.speculative = speculative
@@ -440,7 +441,12 @@ class InferenceEngine:
         vector op thanks to per-row positions. Entries above pos hold
         stale drafts that are masked out and overwritten next round.
         Acceptance caps at K-1 because the draft pool only holds KV for
-        cur, d0..d_{K-2}."""
+        cur, d0..d_{K-2}.
+
+        Acceptance rule per row: greedy rows match the target argmax
+        (byte-identical to plain serving); sampling rows run rejection
+        acceptance (exact sampling law, decode/speculative.py's
+        rejection_accept); repetition-penalty rows accept 0."""
         from bigdl_tpu.generate import apply_repetition_penalty
 
         cfg = self.config
@@ -462,22 +468,60 @@ class InferenceEngine:
         tlogits = tlogits.astype(jnp.float32)
         greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # [B, K]
 
-        # sampling / penalty slots take the regular sampler's token at
-        # position 0 and accept nothing — output distribution unchanged
-        first = tlogits[:, 0]
-        step0 = jax.lax.cond(
-            jnp.any(penalty != 1.0),
-            lambda: apply_repetition_penalty(first, seen, penalty),
-            lambda: first,
+        # acceptance per decode mode: greedy rows match the target's
+        # argmax (byte-identical to plain serving); sampling rows run
+        # rejection acceptance against the full per-position sampling
+        # distribution (exact output law — decode/speculative.py);
+        # repetition-penalty rows accept 0 and take the penalty-adjusted
+        # sampler token at position 0 (their distribution depends on
+        # tokens emitted earlier in the same round)
+        from bigdl_tpu.decode.speculative import rejection_accept
+        from bigdl_tpu.generate import filter_logits_per_row
+
+        pen1 = penalty == 1.0
+        row_greedy = ~dosample & pen1
+        row_sampled = dosample & pen1
+        k_acc, k_pen = jax.random.split(key)
+
+        def accept_mixed():
+            probs = jax.nn.softmax(
+                filter_logits_per_row(tlogits, temp, topk, topp), axis=-1
+            )
+            return rejection_accept(
+                k_acc, probs, drafts, greedy, row_greedy, row_sampled
+            )
+
+        def accept_greedy_only():
+            # all-greedy pools (the common serving case) skip the two
+            # full [B, K, V] sorts + softmax of the filtered-probs path
+            acc = (drafts[:, : K - 1] == greedy[:, : K - 1]) \
+                & row_greedy[:, None]
+            n = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+            return n, jnp.take_along_axis(greedy, n[:, None], axis=1)[:, 0]
+
+        n_acc, extra = jax.lax.cond(
+            jnp.any(row_sampled), accept_mixed, accept_greedy_only
         )
-        samp0 = sample_token_per_row(step0, key, temp, topk, topp, dosample)
-        spec_row = ~dosample & (penalty == 1.0)
-        choice = greedy.at[:, 0].set(
-            jnp.where(spec_row, greedy[:, 0], samp0)
+
+        def penalty_sample():
+            step0 = apply_repetition_penalty(tlogits[:, 0], seen, penalty)
+            return sample_token_per_row(
+                step0, k_pen, temp, topk, topp, dosample
+            )
+
+        # penalty rows accept 0 and take the penalty-adjusted sampler
+        # token at position 0; all-pen1 batches skip the extra sampler
+        samp0 = jax.lax.cond(
+            jnp.any(~pen1), penalty_sample, lambda: extra
         )
-        match = (drafts[:, :K - 1] == choice[:, :K - 1]) & spec_row[:, None]
-        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-        cur2 = jnp.take_along_axis(choice, n_acc[:, None], axis=1)[:, 0]
+        extra = jnp.where(pen1, extra, samp0)
+
+        pos = jnp.arange(K, dtype=jnp.int32)[None, :]
+        choice = jnp.where(
+            pos < n_acc[:, None], drafts,
+            jnp.where(pos == n_acc[:, None], extra[:, None], greedy),
+        )
+        cur2 = extra
 
         cache = dataclasses.replace(cache, pos=cache.pos - K + n_acc + 1)
         dcache = dataclasses.replace(dcache, pos=dcache.pos - K + n_acc + 1)
