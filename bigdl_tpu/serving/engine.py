@@ -254,10 +254,15 @@ class InferenceEngine:
                 # K-1 draft tokens are verifiable; K=1 would pay a draft
                 # forward whose token can never be accepted
                 raise ValueError(f"draft_k must be >= 2, got {draft_k}")
-            if self._family_cache is not None:
+            if self._family_pool is not None:
+                # engine_pool adapters (rwkv recurrence, yuan filter
+                # state, mllama cross-attn) have nested pools / property
+                # pos — the vector rollback below cannot express their
+                # crop. SERVABLE_CACHE dataclasses (MLA latents) carry
+                # real per-row pos and speculate like the standard pool.
                 raise NotImplementedError(
-                    f"speculative serving needs the standard KV pool; "
-                    f"{model.config.model_type} has a family cache"
+                    f"speculative serving is not wired for "
+                    f"{model.config.model_type}'s custom cache adapter"
                 )
             if draft_params is None:
                 self._draft_params = model.self_draft_params()

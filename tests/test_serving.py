@@ -469,3 +469,40 @@ def test_engine_speculative_sampling_accepts_drafts(model):
     assert eng.spec_emitted / eng.spec_rounds > 1.0, (
         eng.spec_emitted, eng.spec_rounds
     )
+
+
+def test_engine_speculative_mla_family():
+    """Speculative decoding over the MLA latent cache (SERVABLE_CACHE
+    families): the latent dataclass carries real per-row pos, so the
+    vector rollback applies unchanged — greedy output byte-identical to
+    plain MLA serving; engine_pool adapter families still refuse."""
+    from bigdl_tpu.models import deepseek
+    from bigdl_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.from_hf_config(dict(
+        model_type="deepseek_v2", vocab_size=96, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        q_lora_rank=32, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, n_routed_experts=0,
+        first_k_dense_replace=2,
+    ))
+    params = deepseek.quantize_params(
+        deepseek.init_params(cfg, jax.random.PRNGKey(0)), "sym_int4"
+    )
+    m = TpuModel(cfg, params, "sym_int4")
+
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+    ref_eng = InferenceEngine(m, n_slots=2, max_len=128)
+    refs = [ref_eng.submit(p, max_new_tokens=8) for p in prompts]
+    ref_eng.run_until_idle()
+
+    eng = InferenceEngine(m, n_slots=2, max_len=128, speculative=True,
+                          draft_params=m.params, draft_k=3)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run_until_idle(max_steps=300)
+    for r, ref in zip(reqs, refs):
+        assert r.done and r.out_tokens == ref.out_tokens, (
+            r.out_tokens, ref.out_tokens
+        )
+    assert eng.spec_rounds > 0
+    assert eng.spec_emitted / eng.spec_rounds > 1.0
