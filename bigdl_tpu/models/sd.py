@@ -484,6 +484,219 @@ def params_from_state_dict(config: SDConfig, get) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# VAE decoder (AutoencoderKL.decoder) — latents -> pixels on-device,
+# completing txt2img without a torch round trip (the reference instead
+# patches the torch VAE's dtype, sd.py:145-152 upcast_vae)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    latent_channels: int = 4
+    out_channels: int = 3
+    block_out_channels: tuple = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_num_groups: int = 32
+    scaling_factor: float = 0.18215  # SD 1.x latent scale
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "VAEConfig":
+        keys = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in hf.items() if k in keys}
+        if "block_out_channels" in kw:
+            kw["block_out_channels"] = tuple(kw["block_out_channels"])
+        return cls(**kw)
+
+
+def _vae_resnet(x, p, groups: int):
+    h = _group_norm(x, p["norm1_w"], p["norm1_b"], groups, eps=1e-6)
+    h = _conv(jax.nn.silu(h), p["conv1_w"], p["conv1_b"])
+    h = _group_norm(h, p["norm2_w"], p["norm2_b"], groups, eps=1e-6)
+    h = _conv(jax.nn.silu(h), p["conv2_w"], p["conv2_b"])
+    if "skip_w" in p:
+        x = _conv(x, p["skip_w"], p["skip_b"], padding=0)
+    return x + h
+
+
+def vae_decode(
+    config: VAEConfig,
+    params: dict,
+    latents: jax.Array,  # [B, H, W, latent_channels] channel-last
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Latents -> pixels in [-1, 1]: post_quant conv, mid (resnet +
+    single-head attention + resnet), mirrored up blocks with nearest-2x
+    upsampling, GroupNorm/SiLU head."""
+    g = config.norm_num_groups
+    x = (latents / config.scaling_factor).astype(compute_dtype)
+    x = _conv(x, params["post_quant_w"], params["post_quant_b"], padding=0)
+    x = _conv(x, params["conv_in_w"], params["conv_in_b"])
+
+    mid = params["mid"]
+    x = _vae_resnet(x, mid["resnets"][0], g)
+    B, H, W, C = x.shape
+    h = _group_norm(x, mid["attn_norm_w"], mid["attn_norm_b"], g, eps=1e-6)
+    h = h.reshape(B, H * W, C)
+    q = linear(h, mid["attn_q"], mid["attn_q_b"], compute_dtype)
+    k = linear(h, mid["attn_k"], mid["attn_k_b"], compute_dtype)
+    v = linear(h, mid["attn_v"], mid["attn_v_b"], compute_dtype)
+    att = jax.nn.softmax(
+        jnp.einsum("btc,bsc->bts", q, k).astype(jnp.float32) * (C ** -0.5),
+        axis=-1,
+    ).astype(compute_dtype)
+    h = jnp.einsum("bts,bsc->btc", att, v)
+    h = linear(h, mid["attn_out"], mid["attn_out_b"], compute_dtype)
+    x = x + h.reshape(B, H, W, C)
+    x = _vae_resnet(x, mid["resnets"][1], g)
+
+    for block in params["up"]:
+        for p in block["resnets"]:
+            x = _vae_resnet(x, p, g)
+        if "up_w" in block:
+            B, H, W, C = x.shape
+            x = jax.image.resize(x, (B, H * 2, W * 2, C), "nearest")
+            x = _conv(x, block["up_w"], block["up_b"])
+
+    x = _group_norm(x, params["norm_out_w"], params["norm_out_b"], g,
+                    eps=1e-6)
+    x = _conv(jax.nn.silu(x), params["conv_out_w"], params["conv_out_b"])
+    return x
+
+
+def init_vae_params(config: VAEConfig, key: jax.Array,
+                    dtype=jnp.float32) -> dict:
+    counter = [0]
+
+    def nxt():
+        counter[0] += 1
+        return jax.random.fold_in(key, counter[0])
+
+    def w(shape, scale=0.02):
+        return (jax.random.normal(nxt(), shape, jnp.float32) * scale
+                ).astype(dtype)
+
+    def zeros(n):
+        return jnp.zeros((n,), dtype)
+
+    def ones(n):
+        return jnp.ones((n,), dtype)
+
+    def resnet(cin, cout):
+        p = {"norm1_w": ones(cin), "norm1_b": zeros(cin),
+             "conv1_w": w((3, 3, cin, cout)), "conv1_b": zeros(cout),
+             "norm2_w": ones(cout), "norm2_b": zeros(cout),
+             "conv2_w": w((3, 3, cout, cout)), "conv2_b": zeros(cout)}
+        if cin != cout:
+            p["skip_w"] = w((1, 1, cin, cout))
+            p["skip_b"] = zeros(cout)
+        return p
+
+    chans = config.block_out_channels
+    cm, c0 = chans[-1], chans[0]
+    lc = config.latent_channels
+    params = {
+        "post_quant_w": w((1, 1, lc, lc)), "post_quant_b": zeros(lc),
+        "conv_in_w": w((3, 3, lc, cm)), "conv_in_b": zeros(cm),
+        "mid": {
+            "resnets": [resnet(cm, cm), resnet(cm, cm)],
+            "attn_norm_w": ones(cm), "attn_norm_b": zeros(cm),
+            "attn_q": w((cm, cm)), "attn_q_b": zeros(cm),
+            "attn_k": w((cm, cm)), "attn_k_b": zeros(cm),
+            "attn_v": w((cm, cm)), "attn_v_b": zeros(cm),
+            "attn_out": w((cm, cm)), "attn_out_b": zeros(cm),
+        },
+        "up": [],
+        "norm_out_w": ones(c0), "norm_out_b": zeros(c0),
+        "conv_out_w": w((3, 3, c0, config.out_channels)),
+        "conv_out_b": zeros(config.out_channels),
+    }
+    rev = list(chans)[::-1]  # decoder runs wide -> narrow
+    for bi, c in enumerate(rev):
+        prev = rev[bi - 1] if bi else rev[0]
+        block = {"resnets": [
+            resnet(prev if li == 0 else c, c)
+            for li in range(config.layers_per_block + 1)
+        ]}
+        if bi < len(rev) - 1:
+            block["up_w"] = w((3, 3, c, c))
+            block["up_b"] = zeros(c)
+        params["up"].append(block)
+    return params
+
+
+def vae_params_from_state_dict(config: VAEConfig, get) -> dict:
+    """diffusers AutoencoderKL state_dict (decoder + post_quant_conv) ->
+    our tree."""
+    def t(name):
+        a = np.asarray(get(name), np.float32)
+        return jnp.asarray(np.transpose(a, (2, 3, 1, 0)))
+
+    def m(name):  # 1x1 attention convs OR linears, both -> [O, I]
+        a = np.asarray(get(name), np.float32)
+        if a.ndim == 4:  # older checkpoints: attention as 1x1 conv
+            a = a[:, :, 0, 0]
+        return jnp.asarray(a)
+
+    def v(name):
+        return jnp.asarray(np.asarray(get(name), np.float32))
+
+    def resnet(prefix, cin, cout):
+        p = {"norm1_w": v(f"{prefix}.norm1.weight"),
+             "norm1_b": v(f"{prefix}.norm1.bias"),
+             "conv1_w": t(f"{prefix}.conv1.weight"),
+             "conv1_b": v(f"{prefix}.conv1.bias"),
+             "norm2_w": v(f"{prefix}.norm2.weight"),
+             "norm2_b": v(f"{prefix}.norm2.bias"),
+             "conv2_w": t(f"{prefix}.conv2.weight"),
+             "conv2_b": v(f"{prefix}.conv2.bias")}
+        if cin != cout:
+            p["skip_w"] = t(f"{prefix}.conv_shortcut.weight")
+            p["skip_b"] = v(f"{prefix}.conv_shortcut.bias")
+        return p
+
+    chans = config.block_out_channels
+    cm, c0 = chans[-1], chans[0]
+    d = "decoder"
+    params = {
+        "post_quant_w": t("post_quant_conv.weight"),
+        "post_quant_b": v("post_quant_conv.bias"),
+        "conv_in_w": t(f"{d}.conv_in.weight"),
+        "conv_in_b": v(f"{d}.conv_in.bias"),
+        "mid": {
+            "resnets": [resnet(f"{d}.mid_block.resnets.0", cm, cm),
+                        resnet(f"{d}.mid_block.resnets.1", cm, cm)],
+            "attn_norm_w": v(f"{d}.mid_block.attentions.0.group_norm.weight"),
+            "attn_norm_b": v(f"{d}.mid_block.attentions.0.group_norm.bias"),
+            "attn_q": m(f"{d}.mid_block.attentions.0.to_q.weight"),
+            "attn_q_b": v(f"{d}.mid_block.attentions.0.to_q.bias"),
+            "attn_k": m(f"{d}.mid_block.attentions.0.to_k.weight"),
+            "attn_k_b": v(f"{d}.mid_block.attentions.0.to_k.bias"),
+            "attn_v": m(f"{d}.mid_block.attentions.0.to_v.weight"),
+            "attn_v_b": v(f"{d}.mid_block.attentions.0.to_v.bias"),
+            "attn_out": m(f"{d}.mid_block.attentions.0.to_out.0.weight"),
+            "attn_out_b": v(f"{d}.mid_block.attentions.0.to_out.0.bias"),
+        },
+        "up": [],
+        "norm_out_w": v(f"{d}.conv_norm_out.weight"),
+        "norm_out_b": v(f"{d}.conv_norm_out.bias"),
+        "conv_out_w": t(f"{d}.conv_out.weight"),
+        "conv_out_b": v(f"{d}.conv_out.bias"),
+    }
+    rev = list(chans)[::-1]
+    for bi, c in enumerate(rev):
+        prev = rev[bi - 1] if bi else rev[0]
+        block = {"resnets": [
+            resnet(f"{d}.up_blocks.{bi}.resnets.{li}",
+                   prev if li == 0 else c, c)
+            for li in range(config.layers_per_block + 1)
+        ]}
+        if bi < len(rev) - 1:
+            block["up_w"] = t(f"{d}.up_blocks.{bi}.upsamplers.0.conv.weight")
+            block["up_b"] = v(f"{d}.up_blocks.{bi}.upsamplers.0.conv.bias")
+        params["up"].append(block)
+    return params
+
+
+# ---------------------------------------------------------------------------
 # DDIM sampling
 # ---------------------------------------------------------------------------
 

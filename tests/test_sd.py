@@ -166,3 +166,68 @@ def test_ddim_sample_deterministic(params):
     out3 = sd.ddim_sample(CFG, params, txt, unc, lat, num_steps=3,
                           guidance_scale=1.0)
     assert float(jnp.max(jnp.abs(out1 - out3))) > 1e-4
+
+
+def test_vae_decoder_shapes_and_ingest():
+    """VAE decoder: latents upsample 2^(n_blocks-1)x to pixels; a
+    diffusers-named AutoencoderKL state dict ingests and runs."""
+    vcfg = sd.VAEConfig(block_out_channels=(16, 32, 32), layers_per_block=1,
+                        norm_num_groups=8)
+    p = sd.init_vae_params(vcfg, jax.random.PRNGKey(10))
+    lat = jax.random.normal(jax.random.PRNGKey(11), (1, 8, 8, 4))
+    img = jax.jit(lambda l: sd.vae_decode(vcfg, p, l))(lat)
+    assert img.shape == (1, 32, 32, 3)  # two upsamples
+    assert np.isfinite(np.asarray(img)).all()
+
+    rng = np.random.default_rng(1)
+    store = {}
+
+    def fake(name, shape):
+        store[name] = rng.standard_normal(shape).astype(np.float32) * 0.02
+
+    chans = vcfg.block_out_channels
+    cm, c0 = chans[-1], chans[0]
+
+    def add_resnet(pre, cin, cout):
+        fake(f"{pre}.norm1.weight", (cin,)); fake(f"{pre}.norm1.bias", (cin,))
+        fake(f"{pre}.conv1.weight", (cout, cin, 3, 3))
+        fake(f"{pre}.conv1.bias", (cout,))
+        fake(f"{pre}.norm2.weight", (cout,)); fake(f"{pre}.norm2.bias", (cout,))
+        fake(f"{pre}.conv2.weight", (cout, cout, 3, 3))
+        fake(f"{pre}.conv2.bias", (cout,))
+        if cin != cout:
+            fake(f"{pre}.conv_shortcut.weight", (cout, cin, 1, 1))
+            fake(f"{pre}.conv_shortcut.bias", (cout,))
+
+    fake("post_quant_conv.weight", (4, 4, 1, 1))
+    fake("post_quant_conv.bias", (4,))
+    fake("decoder.conv_in.weight", (cm, 4, 3, 3))
+    fake("decoder.conv_in.bias", (cm,))
+    add_resnet("decoder.mid_block.resnets.0", cm, cm)
+    add_resnet("decoder.mid_block.resnets.1", cm, cm)
+    fake("decoder.mid_block.attentions.0.group_norm.weight", (cm,))
+    fake("decoder.mid_block.attentions.0.group_norm.bias", (cm,))
+    for n in ("to_q", "to_k", "to_v"):
+        fake(f"decoder.mid_block.attentions.0.{n}.weight", (cm, cm))
+        fake(f"decoder.mid_block.attentions.0.{n}.bias", (cm,))
+    fake("decoder.mid_block.attentions.0.to_out.0.weight", (cm, cm))
+    fake("decoder.mid_block.attentions.0.to_out.0.bias", (cm,))
+    rev = list(chans)[::-1]
+    for bi, c in enumerate(rev):
+        prev = rev[bi - 1] if bi else rev[0]
+        for li in range(vcfg.layers_per_block + 1):
+            add_resnet(f"decoder.up_blocks.{bi}.resnets.{li}",
+                       prev if li == 0 else c, c)
+        if bi < len(rev) - 1:
+            fake(f"decoder.up_blocks.{bi}.upsamplers.0.conv.weight",
+                 (c, c, 3, 3))
+            fake(f"decoder.up_blocks.{bi}.upsamplers.0.conv.bias", (c,))
+    fake("decoder.conv_norm_out.weight", (c0,))
+    fake("decoder.conv_norm_out.bias", (c0,))
+    fake("decoder.conv_out.weight", (3, c0, 3, 3))
+    fake("decoder.conv_out.bias", (3,))
+
+    ingested = sd.vae_params_from_state_dict(vcfg, lambda n: store[n])
+    img2 = sd.vae_decode(vcfg, ingested, lat)
+    assert img2.shape == (1, 32, 32, 3)
+    assert np.isfinite(np.asarray(img2)).all()
