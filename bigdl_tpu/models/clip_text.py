@@ -1,0 +1,153 @@
+"""CLIP text encoder (SD's conditioning model), TPU-native.
+
+The reference runs the text encoder inside stock torch diffusers; here
+it is jnp so the whole SD pipeline (encode -> denoise -> decode) stays
+on-device (models/sd.py). Layout per HF `CLIPTextModel` (SD 1.x uses
+openai/clip-vit-large-patch14: 12 layers, width 768, quick_gelu):
+
+- token + learned position embeddings;
+- pre-LN transformer blocks with CAUSAL attention (CLIP's text side is
+  autoregressive-masked);
+- final LayerNorm; SD consumes `last_hidden_state` (not the pooled
+  projection), so the projection head is omitted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.ops import layer_norm
+from bigdl_tpu.ops.linear import linear
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 77
+    layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"  # SD1.x; SD2 uses "gelu"
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "ClipTextConfig":
+        keys = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in hf.items() if k in keys})
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def init_params(config: ClipTextConfig, key: jax.Array,
+                dtype=jnp.float32) -> dict:
+    counter = [0]
+
+    def nxt():
+        counter[0] += 1
+        return jax.random.fold_in(key, counter[0])
+
+    def w(shape, scale=0.02):
+        return (jax.random.normal(nxt(), shape, jnp.float32) * scale
+                ).astype(dtype)
+
+    E, I, L = (config.hidden_size, config.intermediate_size,
+               config.num_hidden_layers)
+    layers = {
+        "ln1_w": jnp.ones((L, E), dtype), "ln1_b": jnp.zeros((L, E), dtype),
+        "ln2_w": jnp.ones((L, E), dtype), "ln2_b": jnp.zeros((L, E), dtype),
+        "wq": w((L, E, E)), "bq": jnp.zeros((L, E), dtype),
+        "wk": w((L, E, E)), "bk": jnp.zeros((L, E), dtype),
+        "wv": w((L, E, E)), "bv": jnp.zeros((L, E), dtype),
+        "wo": w((L, E, E)), "bo": jnp.zeros((L, E), dtype),
+        "fc1": w((L, I, E)), "b1": jnp.zeros((L, I), dtype),
+        "fc2": w((L, E, I)), "b2": jnp.zeros((L, E), dtype),
+    }
+    return {
+        "tok": w((config.vocab_size, E)),
+        "pos": w((config.max_position_embeddings, E)),
+        "layers": layers,
+        "lnf_w": jnp.ones((E,), dtype), "lnf_b": jnp.zeros((E,), dtype),
+    }
+
+
+def params_from_state_dict(config: ClipTextConfig, get,
+                           prefix: str = "text_model.") -> dict:
+    """HF CLIPTextModel state_dict -> our stacked-layer tree."""
+    def g(name):
+        return np.asarray(get(prefix + name), np.float32)
+
+    names = [
+        ("ln1_w", "layer_norm1.weight"), ("ln1_b", "layer_norm1.bias"),
+        ("ln2_w", "layer_norm2.weight"), ("ln2_b", "layer_norm2.bias"),
+        ("wq", "self_attn.q_proj.weight"), ("bq", "self_attn.q_proj.bias"),
+        ("wk", "self_attn.k_proj.weight"), ("bk", "self_attn.k_proj.bias"),
+        ("wv", "self_attn.v_proj.weight"), ("bv", "self_attn.v_proj.bias"),
+        ("wo", "self_attn.out_proj.weight"), ("bo", "self_attn.out_proj.bias"),
+        ("fc1", "mlp.fc1.weight"), ("b1", "mlp.fc1.bias"),
+        ("fc2", "mlp.fc2.weight"), ("b2", "mlp.fc2.bias"),
+    ]
+    layers: dict[str, list] = {}
+    for i in range(config.num_hidden_layers):
+        for key, suffix in names:
+            layers.setdefault(key, []).append(
+                g(f"encoder.layers.{i}.{suffix}")
+            )
+    return {
+        "tok": jnp.asarray(g("embeddings.token_embedding.weight")),
+        "pos": jnp.asarray(g("embeddings.position_embedding.weight")),
+        "layers": {k: jnp.asarray(np.stack(v)) for k, v in layers.items()},
+        "lnf_w": jnp.asarray(g("final_layer_norm.weight")),
+        "lnf_b": jnp.asarray(g("final_layer_norm.bias")),
+    }
+
+
+def forward(
+    config: ClipTextConfig,
+    params: dict,
+    tokens: jax.Array,  # [B, T] int32
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Returns last_hidden_state [B, T, E] (post final LayerNorm) — the
+    conditioning tensor SD's cross-attention consumes."""
+    B, T = tokens.shape
+    E, Hh, D = (config.hidden_size, config.num_attention_heads,
+                config.head_dim)
+    eps = config.layer_norm_eps
+
+    h = (params["tok"][tokens] + params["pos"][None, :T]).astype(compute_dtype)
+    ti = jnp.arange(T)
+    mask = (ti[None, :] <= ti[:, None])[None, None]  # causal [1,1,T,T]
+
+    def block(h, p):
+        x = layer_norm(h, p["ln1_w"], p["ln1_b"], eps)
+        q = (linear(x, p["wq"], p["bq"], compute_dtype)
+             .reshape(B, T, Hh, D))
+        k = (linear(x, p["wk"], p["bk"], compute_dtype)
+             .reshape(B, T, Hh, D))
+        v = (linear(x, p["wv"], p["bv"], compute_dtype)
+             .reshape(B, T, Hh, D))
+        att = jnp.einsum("bthd,bshd->bhts", q, k) * (D ** -0.5)
+        att = jnp.where(mask, att, -jnp.inf)
+        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1
+                             ).astype(compute_dtype)
+        ctx = jnp.einsum("bhts,bshd->bthd", att, v).reshape(B, T, E)
+        h = h + linear(ctx, p["wo"], p["bo"], compute_dtype)
+
+        x = layer_norm(h, p["ln2_w"], p["ln2_b"], eps)
+        x = linear(x, p["fc1"], p["b1"], compute_dtype)
+        if config.hidden_act == "quick_gelu":
+            x = x * jax.nn.sigmoid(1.702 * x)
+        else:
+            x = jax.nn.gelu(x, approximate=False)
+        x = linear(x, p["fc2"], p["b2"], compute_dtype)
+        return h + x, None
+
+    h, _ = jax.lax.scan(block, h, params["layers"])
+    return layer_norm(h, params["lnf_w"], params["lnf_b"], eps)

@@ -745,3 +745,37 @@ def ddim_sample(
         return a_prev ** 0.5 * x0 + (1 - a_prev) ** 0.5 * eps
 
     return jax.lax.fori_loop(0, num_steps, body, lat0)
+
+
+def text_to_image(
+    config: SDConfig,
+    params: dict,
+    clip_config,
+    clip_params: dict,
+    vae_config: VAEConfig,
+    vae_params: dict,
+    prompt_ids: jax.Array,  # [B, S] CLIP token ids (padded to 77)
+    uncond_ids: jax.Array,  # [B, S] empty-prompt ids
+    key: jax.Array,
+    height: int = 512,
+    width: int = 512,
+    num_steps: int = 20,
+    guidance_scale: float = 7.5,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Full SD pipeline on-device: CLIP encode -> CFG DDIM denoise ->
+    VAE decode. Returns images [B, H, W, 3] in [0, 1]."""
+    from bigdl_tpu.models import clip_text
+
+    ctx = clip_text.forward(clip_config, clip_params, prompt_ids,
+                            compute_dtype)
+    unc = clip_text.forward(clip_config, clip_params, uncond_ids,
+                            compute_dtype)
+    B = prompt_ids.shape[0]
+    lat = jax.random.normal(
+        key, (B, height // 8, width // 8, config.in_channels), jnp.float32
+    )
+    lat = ddim_sample(config, params, ctx, unc, lat, num_steps,
+                      guidance_scale, compute_dtype)
+    img = vae_decode(vae_config, vae_params, lat, compute_dtype)
+    return jnp.clip(img * 0.5 + 0.5, 0.0, 1.0)
