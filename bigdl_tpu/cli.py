@@ -226,6 +226,29 @@ def cmd_fetch_iq_tables(args):
     print(f"cached {sorted(tables)} -> {iq_quants._cache_path()}")
 
 
+def cmd_txt2img(args):
+    from bigdl_tpu.models.sd import load_diffusers_pipeline
+    from bigdl_tpu.utils.png import write_png
+
+    pipe = load_diffusers_pipeline(args.model, qtype=args.qtype)
+
+    def as_prompt(text):
+        if text is None:
+            return None
+        toks = text.split()
+        if toks and all(t.isdigit() for t in toks):
+            return [int(t) for t in toks]  # raw CLIP ids (no tokenizer)
+        return text
+
+    imgs = pipe(as_prompt(args.prompt),
+                negative_prompt=as_prompt(args.negative),
+                height=args.size, width=args.size, num_steps=args.steps,
+                guidance_scale=args.guidance, seed=args.seed)
+    write_png(args.output, imgs[0])
+    print(f"wrote {args.output} ({args.size}x{args.size}, "
+          f"{args.steps} steps, cfg {args.guidance})")
+
+
 def cmd_bench(args):
     model = _load(args.model, args.qtype)
     n_in, n_out = args.in_len, args.out_len
@@ -322,6 +345,20 @@ def main(argv=None):
     ft.add_argument("--url", default=None,
                     help="override the llama.cpp ggml-common.h URL")
     ft.set_defaults(fn=cmd_fetch_iq_tables)
+
+    ti = sub.add_parser("txt2img",
+                        help="Stable Diffusion text-to-image (diffusers "
+                             "checkpoint dir, fully on-device)",
+                        parents=[qp])
+    ti.add_argument("model", help="local diffusers pipeline directory")
+    ti.add_argument("-p", "--prompt", required=True)
+    ti.add_argument("--negative", default=None)
+    ti.add_argument("-o", "--output", default="out.png")
+    ti.add_argument("--size", type=int, default=512)
+    ti.add_argument("--steps", type=int, default=20)
+    ti.add_argument("--guidance", type=float, default=7.5)
+    ti.add_argument("--seed", type=int, default=0)
+    ti.set_defaults(fn=cmd_txt2img)
 
     ch = sub.add_parser("chat", help="interactive chat REPL", parents=[qp])
     ch.add_argument("model")

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Optional
 
 import jax
@@ -745,6 +746,115 @@ def ddim_sample(
         return a_prev ** 0.5 * x0 + (1 - a_prev) ** 0.5 * eps
 
     return jax.lax.fori_loop(0, num_steps, body, lat0)
+
+
+def _diffusers_opener(path: str, subdir: str):
+    """Tensor getter over a diffusers component dir (any *.safetensors
+    name, sharded or not) — open_checkpoint assumes HF's
+    model.safetensors naming, diffusers uses diffusion_pytorch_model."""
+    import glob as _glob
+
+    import torch  # lazy: ingest only
+    from safetensors import safe_open
+
+    files = sorted(_glob.glob(os.path.join(path, subdir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {path}/{subdir}")
+    where: dict[str, str] = {}
+    for fp in files:
+        with safe_open(fp, framework="pt") as f:
+            for k in f.keys():
+                where[k] = fp
+
+    def get(name: str):
+        with safe_open(where[name], framework="pt") as f:
+            t = f.get_tensor(name)
+        return (t.float().numpy() if t.is_floating_point()
+                else t.numpy())
+
+    return get
+
+
+@dataclasses.dataclass
+class SDPipeline:
+    """A loaded diffusers checkpoint, ready to generate on-device.
+
+    `tokenizer` is optional (transformers CLIPTokenizer when the
+    checkpoint ships one); without it prompts must be CLIP token-id
+    lists, consistent with the rest of the framework."""
+    config: SDConfig
+    params: dict
+    clip_config: object
+    clip_params: dict
+    vae_config: VAEConfig
+    vae_params: dict
+    tokenizer: Optional[object] = None
+
+    def _encode(self, prompt) -> np.ndarray:
+        L = self.clip_config.max_position_embeddings
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("text prompt but no tokenizer loaded; "
+                                 "pass CLIP token ids instead")
+            ids = self.tokenizer(prompt, padding="max_length",
+                                 truncation=True, max_length=L)["input_ids"]
+        else:
+            ids = list(prompt)[:L]
+        out = np.zeros((1, L), np.int32)
+        out[0, : len(ids)] = ids
+        return out
+
+    def __call__(self, prompt, negative_prompt=None, height: int = 512,
+                 width: int = 512, num_steps: int = 20,
+                 guidance_scale: float = 7.5, seed: int = 0) -> np.ndarray:
+        """Returns uint8 images [B, H, W, 3]."""
+        neg = negative_prompt if negative_prompt is not None else (
+            "" if self.tokenizer is not None else [])
+        img = text_to_image(
+            self.config, self.params, self.clip_config, self.clip_params,
+            self.vae_config, self.vae_params,
+            jnp.asarray(self._encode(prompt)),
+            jnp.asarray(self._encode(neg)),
+            jax.random.PRNGKey(seed), height=height, width=width,
+            num_steps=num_steps, guidance_scale=guidance_scale,
+        )
+        return np.asarray(jnp.round(img * 255)).astype(np.uint8)
+
+
+def load_diffusers_pipeline(path: str, qtype: Optional[str] = None
+                            ) -> SDPipeline:
+    """Load a local diffusers StableDiffusionPipeline directory
+    (unet/ + vae/ + text_encoder/ [+ tokenizer/]) into on-device params;
+    qtype quantizes the UNet's transformer linears."""
+    import json
+
+    from bigdl_tpu.models import clip_text
+
+    def cfg(subdir):
+        with open(os.path.join(path, subdir, "config.json")) as f:
+            return json.load(f)
+
+    config = SDConfig.from_hf(cfg("unet"))
+    params = params_from_state_dict(config, _diffusers_opener(path, "unet"))
+    if qtype:
+        params = quantize_params(params, qtype)
+    vae_config = VAEConfig.from_hf(cfg("vae"))
+    vae_params = vae_params_from_state_dict(
+        vae_config, _diffusers_opener(path, "vae"))
+    clip_config = clip_text.ClipTextConfig.from_hf(cfg("text_encoder"))
+    clip_params = clip_text.params_from_state_dict(
+        clip_config, _diffusers_opener(path, "text_encoder"))
+    tokenizer = None
+    tok_dir = os.path.join(path, "tokenizer")
+    if os.path.isdir(tok_dir):
+        try:
+            from transformers import CLIPTokenizer
+
+            tokenizer = CLIPTokenizer.from_pretrained(tok_dir)
+        except Exception:  # noqa: BLE001 — ids-only operation still works
+            tokenizer = None
+    return SDPipeline(config, params, clip_config, clip_params,
+                      vae_config, vae_params, tokenizer)
 
 
 def text_to_image(

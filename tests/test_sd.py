@@ -19,6 +19,139 @@ CFG = sd.SDConfig(
 )
 
 
+
+def _fake_unet_store(cfg, rng):
+    """diffusers-named UNet state dict of the right shapes."""
+    store = {}
+
+    def fake(name, shape):
+        store[name] = rng.standard_normal(shape).astype(np.float32) * 0.02
+
+    te = cfg.time_embed_dim
+    xd = cfg.cross_attention_dim
+    chans = cfg.block_out_channels
+
+    def add_resnet(pre, cin, cout):
+        fake(f"{pre}.norm1.weight", (cin,)); fake(f"{pre}.norm1.bias", (cin,))
+        fake(f"{pre}.conv1.weight", (cout, cin, 3, 3))
+        fake(f"{pre}.conv1.bias", (cout,))
+        fake(f"{pre}.time_emb_proj.weight", (cout, te))
+        fake(f"{pre}.time_emb_proj.bias", (cout,))
+        fake(f"{pre}.norm2.weight", (cout,)); fake(f"{pre}.norm2.bias", (cout,))
+        fake(f"{pre}.conv2.weight", (cout, cout, 3, 3))
+        fake(f"{pre}.conv2.bias", (cout,))
+        if cin != cout:
+            fake(f"{pre}.conv_shortcut.weight", (cout, cin, 1, 1))
+            fake(f"{pre}.conv_shortcut.bias", (cout,))
+
+    def add_attn(pre, c):
+        fake(f"{pre}.norm.weight", (c,)); fake(f"{pre}.norm.bias", (c,))
+        fake(f"{pre}.proj_in.weight", (c, c, 1, 1))
+        fake(f"{pre}.proj_in.bias", (c,))
+        b = f"{pre}.transformer_blocks.0"
+        for ln in ("norm1", "norm2", "norm3"):
+            fake(f"{b}.{ln}.weight", (c,)); fake(f"{b}.{ln}.bias", (c,))
+        for a, kdim in (("attn1", c), ("attn2", xd)):
+            fake(f"{b}.{a}.to_q.weight", (c, c))
+            fake(f"{b}.{a}.to_k.weight", (c, kdim))
+            fake(f"{b}.{a}.to_v.weight", (c, kdim))
+            fake(f"{b}.{a}.to_out.0.weight", (c, c))
+            fake(f"{b}.{a}.to_out.0.bias", (c,))
+        fake(f"{b}.ff.net.0.proj.weight", (8 * c, c))
+        fake(f"{b}.ff.net.0.proj.bias", (8 * c,))
+        fake(f"{b}.ff.net.2.weight", (c, 4 * c))
+        fake(f"{b}.ff.net.2.bias", (c,))
+        fake(f"{pre}.proj_out.weight", (c, c, 1, 1))
+        fake(f"{pre}.proj_out.bias", (c,))
+
+    fake("conv_in.weight", (chans[0], cfg.in_channels, 3, 3))
+    fake("conv_in.bias", (chans[0],))
+    fake("time_embedding.linear_1.weight", (te, chans[0]))
+    fake("time_embedding.linear_1.bias", (te,))
+    fake("time_embedding.linear_2.weight", (te, te))
+    fake("time_embedding.linear_2.bias", (te,))
+    fake("conv_norm_out.weight", (chans[0],))
+    fake("conv_norm_out.bias", (chans[0],))
+    fake("conv_out.weight", (cfg.out_channels, chans[0], 3, 3))
+    fake("conv_out.bias", (cfg.out_channels,))
+    for bi, res in enumerate(sd._down_channels(cfg)):
+        c = chans[bi]
+        for li, (a, b) in enumerate(res):
+            add_resnet(f"down_blocks.{bi}.resnets.{li}", a, b)
+        if bi < len(chans) - 1:
+            for li in range(len(res)):
+                add_attn(f"down_blocks.{bi}.attentions.{li}", c)
+            fake(f"down_blocks.{bi}.downsamplers.0.conv.weight", (c, c, 3, 3))
+            fake(f"down_blocks.{bi}.downsamplers.0.conv.bias", (c,))
+    cm = chans[-1]
+    add_resnet("mid_block.resnets.0", cm, cm)
+    add_resnet("mid_block.resnets.1", cm, cm)
+    add_attn("mid_block.attentions.0", cm)
+    for bi, res in enumerate(sd._up_channels(cfg)):
+        c = chans[::-1][bi]
+        for li, (a, b) in enumerate(res):
+            add_resnet(f"up_blocks.{bi}.resnets.{li}", a, b)
+        if bi > 0:
+            for li in range(len(res)):
+                add_attn(f"up_blocks.{bi}.attentions.{li}", c)
+        if bi < len(chans) - 1:
+            fake(f"up_blocks.{bi}.upsamplers.0.conv.weight", (c, c, 3, 3))
+            fake(f"up_blocks.{bi}.upsamplers.0.conv.bias", (c,))
+    return store
+
+
+def _fake_vae_store(vcfg, rng):
+    """diffusers-named AutoencoderKL (decoder) state dict."""
+    store = {}
+
+    def fake(name, shape):
+        store[name] = rng.standard_normal(shape).astype(np.float32) * 0.02
+
+    chans = vcfg.block_out_channels
+    cm, c0 = chans[-1], chans[0]
+    lc = vcfg.latent_channels
+
+    def add_resnet(pre, cin, cout):
+        fake(f"{pre}.norm1.weight", (cin,)); fake(f"{pre}.norm1.bias", (cin,))
+        fake(f"{pre}.conv1.weight", (cout, cin, 3, 3))
+        fake(f"{pre}.conv1.bias", (cout,))
+        fake(f"{pre}.norm2.weight", (cout,)); fake(f"{pre}.norm2.bias", (cout,))
+        fake(f"{pre}.conv2.weight", (cout, cout, 3, 3))
+        fake(f"{pre}.conv2.bias", (cout,))
+        if cin != cout:
+            fake(f"{pre}.conv_shortcut.weight", (cout, cin, 1, 1))
+            fake(f"{pre}.conv_shortcut.bias", (cout,))
+
+    fake("post_quant_conv.weight", (lc, lc, 1, 1))
+    fake("post_quant_conv.bias", (lc,))
+    fake("decoder.conv_in.weight", (cm, lc, 3, 3))
+    fake("decoder.conv_in.bias", (cm,))
+    add_resnet("decoder.mid_block.resnets.0", cm, cm)
+    add_resnet("decoder.mid_block.resnets.1", cm, cm)
+    fake("decoder.mid_block.attentions.0.group_norm.weight", (cm,))
+    fake("decoder.mid_block.attentions.0.group_norm.bias", (cm,))
+    for n in ("to_q", "to_k", "to_v"):
+        fake(f"decoder.mid_block.attentions.0.{n}.weight", (cm, cm))
+        fake(f"decoder.mid_block.attentions.0.{n}.bias", (cm,))
+    fake("decoder.mid_block.attentions.0.to_out.0.weight", (cm, cm))
+    fake("decoder.mid_block.attentions.0.to_out.0.bias", (cm,))
+    rev = list(chans)[::-1]
+    for bi, c in enumerate(rev):
+        prev = rev[bi - 1] if bi else rev[0]
+        for li in range(vcfg.layers_per_block + 1):
+            add_resnet(f"decoder.up_blocks.{bi}.resnets.{li}",
+                       prev if li == 0 else c, c)
+        if bi < len(rev) - 1:
+            fake(f"decoder.up_blocks.{bi}.upsamplers.0.conv.weight",
+                 (c, c, 3, 3))
+            fake(f"decoder.up_blocks.{bi}.upsamplers.0.conv.bias", (c,))
+    fake("decoder.conv_norm_out.weight", (c0,))
+    fake("decoder.conv_norm_out.bias", (c0,))
+    fake("decoder.conv_out.weight", (vcfg.out_channels, c0, 3, 3))
+    fake("decoder.conv_out.bias", (vcfg.out_channels,))
+    return store
+
+
 @pytest.fixture(scope="module")
 def params():
     return sd.init_params(CFG, jax.random.PRNGKey(0))
@@ -296,3 +429,68 @@ def test_text_to_image_end_to_end():
     assert img.shape == (1, 8, 8, 3)
     a = np.asarray(img)
     assert np.isfinite(a).all() and a.min() >= 0.0 and a.max() <= 1.0
+
+
+def test_load_diffusers_pipeline_and_cli_txt2img(tmp_path):
+    """A fake diffusers checkpoint dir (unet/ + vae/ + text_encoder/
+    safetensors + configs) loads into SDPipeline, generates, and the
+    txt2img CLI writes a valid PNG."""
+    torch = pytest.importorskip("torch")
+    import json
+    import subprocess
+    import sys
+
+    from safetensors.numpy import save_file
+    from transformers import CLIPTextConfig, CLIPTextModel
+
+    rng = np.random.default_rng(3)
+    ucfg = sd.SDConfig(
+        block_out_channels=(16, 32), layers_per_block=1,
+        cross_attention_dim=24, attention_head_dim=4, norm_num_groups=8,
+    )
+    vcfg = sd.VAEConfig(block_out_channels=(8, 16), layers_per_block=1,
+                        norm_num_groups=4)
+    hf_clip = CLIPTextConfig(
+        vocab_size=64, hidden_size=24, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=4,
+        max_position_embeddings=8,
+    )
+    torch.manual_seed(0)
+    clip_sd = {k: v.detach().float().numpy()
+               for k, v in CLIPTextModel(hf_clip).state_dict().items()}
+
+    for sub, cfg_json, store in (
+        ("unet", {"in_channels": 4, "out_channels": 4,
+                  "block_out_channels": [16, 32], "layers_per_block": 1,
+                  "cross_attention_dim": 24, "attention_head_dim": 4,
+                  "norm_num_groups": 8}, _fake_unet_store(ucfg, rng)),
+        ("vae", {"latent_channels": 4, "out_channels": 3,
+                 "block_out_channels": [8, 16], "layers_per_block": 1,
+                 "norm_num_groups": 4}, _fake_vae_store(vcfg, rng)),
+        ("text_encoder", hf_clip.to_dict(), clip_sd),
+    ):
+        d = tmp_path / sub
+        d.mkdir()
+        (d / "config.json").write_text(json.dumps(cfg_json))
+        save_file(store, str(d / "diffusion_pytorch_model.safetensors"))
+
+    pipe = sd.load_diffusers_pipeline(str(tmp_path))
+    assert pipe.tokenizer is None  # no tokenizer dir: ids-only mode
+    imgs = pipe([3, 1, 4, 1, 5], height=32, width=32, num_steps=2,
+                guidance_scale=3.0)
+    assert imgs.dtype == np.uint8 and imgs.shape[0] == 1
+
+    out = tmp_path / "img.png"
+    import pathlib
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    r = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.cli", "txt2img", str(tmp_path),
+         "-p", "3 1 4", "-o", str(out), "--size", "32", "--steps", "2"],
+        capture_output=True, text=True, timeout=500,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": str(repo), "HOME": "/tmp"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    data = out.read_bytes()
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
+    assert b"IHDR" in data[:33] and b"IEND" in data[-16:]
