@@ -326,16 +326,19 @@ def child_kernels() -> dict:
 
     def gemv_smoke(qtype: str, O: int, K: int):
         def run():
-            w = jax.random.normal(key, (O, K), jnp.float32) * 0.02
-            # eager, not jitted: k-quant quantization runs host-side numpy
-            qt = quantize(w, qtype)
+            # synthetic packed fields: the kernel compiles the identical
+            # program, and the host-side k-quant quantizer at real shapes
+            # costs ~90 s each (r05) — enough to blow the child budget
+            from bigdl_tpu.quant.synth import synth_qtensor
+            import numpy as np
+
+            qt = jax.device_put(synth_qtensor(qtype, O, K))
             jax.block_until_ready(qt.data)
             if K not in x_cache:
                 x_cache[K] = jnp.ones((1, K), jnp.bfloat16)
             x = x_cache[K]
             assert _use_qgemv(x, qt), f"{qtype} O={O} K={K} not GEMV-eligible"
             y = jax.jit(lambda a, b: linear(a, b, None, jnp.bfloat16))(x, qt)
-            import numpy as np
             v = np.asarray(jax.device_get(y))
             assert v.shape == (1, O) and np.isfinite(v).all()
         return run
@@ -407,8 +410,12 @@ def child_kernels() -> dict:
         def run():
             import numpy as np
 
-            w = jax.random.normal(key, (O, K), jnp.float32) * 0.02
-            qt = quantize(w, qtype)  # eager: k-quants quantize host-side
+            from bigdl_tpu.quant.synth import synth_qtensor
+
+            # synthetic fields, same reason as gemv_smoke: the host-side
+            # k-quant quantizer at this shape costs ~90 s — more than the
+            # budget gate below — and the timed kernel is identical
+            qt = jax.device_put(synth_qtensor(qtype, O, K))
             jax.block_until_ready(qt.data)
             x = jnp.ones((1, K), jnp.bfloat16)
 
@@ -523,7 +530,13 @@ def emit(obj: dict, rc: int = 0) -> None:
 
 
 def run_child(mode: str, preset: str, budget: float, extra_env=None):
-    """Run one candidate in a killable subprocess; returns dict or None."""
+    """Run one candidate in a killable subprocess.
+
+    Returns (result, killed): result is a dict (parsed last stdout
+    line), "error" (fast deterministic failure, retryable), or None;
+    killed=True means the child had to be SIGKILLed — its device claim
+    may linger as a stale tunnel lease (r05), so callers should
+    re-probe before the next spawn."""
     env = _child_env()
     if extra_env:
         env.update(extra_env)
@@ -547,6 +560,7 @@ def run_child(mode: str, preset: str, budget: float, extra_env=None):
         cmd, env=env, stdout=subprocess.PIPE,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
+    killed = False
     try:
         stdout, _ = proc.communicate(timeout=budget)
     except subprocess.TimeoutExpired:
@@ -558,23 +572,24 @@ def run_child(mode: str, preset: str, budget: float, extra_env=None):
             proc.kill()
             stdout, _ = proc.communicate()
             log(f"{mode}:{preset} KILLED at {budget:.0f}s (SIGTERM ignored)")
+            killed = True
         res = parse(stdout) if stdout else None
         if res:
             log(f"{mode}:{preset} salvaged banked result from killed child")
-        return res
+        return res, killed
     if proc.returncode != 0:
         res = parse(stdout)
         if res:
             log(f"{mode}:{preset} rc={proc.returncode} but phase-1 result "
                 "was banked — salvaged")
-            return res
+            return res, False
         log(f"{mode}:{preset} failed rc={proc.returncode}")
-        return "error"  # distinguishes fast failure (retryable) from hang
+        return "error", False  # fast failure (retryable), not a hang
     res = parse(stdout)
     if res is None:
         log(f"{mode}:{preset} unparseable stdout")
-        return "error"
-    return res
+        return "error", False
+    return res, False
 
 
 def child_probe() -> dict:
@@ -597,7 +612,7 @@ def wait_for_tunnel() -> bool:
     attempt = 0
     while remaining() > 200:
         attempt += 1
-        res = run_child("probe", "-", min(75, remaining() - 150))
+        res, _ = run_child("probe", "-", min(75, remaining() - 150))
         if isinstance(res, dict) and res.get("probe") == "ok":
             log(f"tunnel live (probe attempt {attempt})")
             return True
@@ -630,18 +645,22 @@ def main() -> None:
         emit({"metric": "bench_failed", "value": 0, "unit": "none",
               "vs_baseline": 0, "error": "tpu tunnel unreachable"}, 1)
 
-    # Stage 0 — per-kernel compile-smoke matrix (VERDICT r04 #1): cheap
-    # seconds-per-kernel compiles, banked before any large candidate so a
-    # slow-compile day still proves/falsifies every Pallas kernel on real
-    # Mosaic. Result rides along inside the final JSON line.
-    kernel_matrix = None
-    if remaining() > 420:
-        res = run_child("kernels", "-", min(300, remaining() - 360))
-        if isinstance(res, dict) and res.get("kernels"):
-            kernel_matrix = res["kernels"]
-            n_ok = sum(1 for v in kernel_matrix.values() if v.get("ok"))
-            log(f"kernel matrix banked: {n_ok}/{len(kernel_matrix)} ok")
-            banked.append(("kernels", res))
+    # r05 lesson: a child that has to be SIGKILLed leaves a stale tunnel
+    # lease that wedges every later claim for minutes — one over-budget
+    # child used to starve the whole ladder. Two structural answers:
+    # (a) the decode HEADLINE runs first, before the (many-compile)
+    # kernel matrix; (b) after any killed child, re-probe until the
+    # lease clears instead of burning the next child's budget against a
+    # wedged tunnel.
+    killed_last = False
+
+    def guarded(mode, preset, budget, extra_env=None):
+        nonlocal killed_last
+        if killed_last:
+            wait_for_tunnel()
+        res, killed_last = run_child(mode, preset, budget,
+                                     extra_env=extra_env)
+        return res
 
     # smallest-first; min_s = give up if less wall-clock than this remains.
     # llama2-7b is the headline (BASELINE <20 ms/token) and gets the bulk
@@ -649,27 +668,39 @@ def main() -> None:
     # through the tunnel) transfer ~100 s + decode compile must fit.
     candidates = [
         ("tiny_llama", "tiny-llama", 150, 60),
-        ("llama2_7b", "llama2-7b", 560, 150),
-        ("llama3_8b", "llama3-8b", 330, 200),
+        ("llama2_7b", "llama2-7b", 480, 150),
+        ("llama3_8b", "llama3-8b", 300, 200),
     ]
     for name, preset, budget, min_s in candidates:
         if remaining() < min_s:
             log(f"skip {name}: only {remaining():.0f}s left")
             continue
-        res = run_child("decode", preset, min(budget, remaining() - 20))
+        res = guarded("decode", preset, min(budget, remaining() - 20))
         if res == "error" and remaining() > min_s:
-            res = run_child("decode", preset, min(budget, remaining() - 20),
-                            extra_env={"BIGDL_TPU_PALLAS": "0"})
+            res = guarded("decode", preset, min(budget, remaining() - 20),
+                          extra_env={"BIGDL_TPU_PALLAS": "0"})
         if isinstance(res, dict):
             banked.append((preset, res))
             log(f"banked {res['metric']} = {res['value']} {res['unit']}")
+
+    # per-kernel compile-smoke matrix (VERDICT r04 #1): synthetic packed
+    # fields make each entry seconds; banked after the headline so a
+    # slow-compile day costs the matrix, not the ms/token number.
+    kernel_matrix = None
+    if remaining() > 180:
+        res = guarded("kernels", "-", min(300, remaining() - 60))
+        if isinstance(res, dict) and res.get("kernels"):
+            kernel_matrix = res["kernels"]
+            n_ok = sum(1 for v in kernel_matrix.values() if v.get("ok"))
+            log(f"kernel matrix banked: {n_ok}/{len(kernel_matrix)} ok")
+            banked.append(("kernels", res))
 
     decoded = [b for b in banked if b[0] != "kernels"]
     train_res = None
     if decoded and remaining() > 200:
         # train MFU on the biggest preset that already decoded fine
         preset = decoded[-1][0]
-        res = run_child("train", preset, remaining() - 30)
+        res = guarded("train", preset, remaining() - 30)
         if isinstance(res, dict):
             train_res = res
             log(f"banked train MFU {res.get('train_mfu')}")

@@ -47,6 +47,13 @@ def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
     block = w.spec.block_size
     if out % 128 != 0:
         return False
+    # the kernels tile O at >= 128 rows (Mosaic lane rule forbids
+    # smaller output tiles); if even a 128-row tile's persistent weight
+    # block cannot fit the scoped-VMEM budget half, fall back to the
+    # XLA dequant path rather than compile a kernel that overflows vmem
+    row_bytes = kw_ * w.data.dtype.itemsize
+    if 128 * row_bytes > 5 * 1024 * 1024:
+        return False
     if w.qtype == "sym_int8":  # unpacked: K = data's last dim directly
         if kw_ % block != 0:
             return False

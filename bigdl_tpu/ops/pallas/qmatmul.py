@@ -1,4 +1,4 @@
-"""Pallas fused dequant-matmul for packed int4 weights.
+"""Pallas fused dequant-matmul for packed low-bit weights.
 
 TPU-native counterpart of the reference's low-bit GEMM/GEMV kernels
 (`xe_linear.forward_new` for prefill, `xe_batch.batch_forward` for
@@ -20,10 +20,23 @@ per decode step — measured on v5e, round 3 — which dominated the kernel
 itself.)
 
 Mosaic constraints found on real TPU (the CPU interpreter accepts all of
-these, silently): no f16 vector type -> scales cross as uint16 bits and
-are decoded to f32 with integer ops in-kernel; no lane-collapsing
-reshape -> per-block scales expand to per-element via a one-hot matmul
-(iota compare + MXU dot), not broadcast+reshape.
+these, silently):
+
+* no f16 vector type -> scales cross as uint16 bits and are decoded to
+  f32 with integer ops in-kernel (r03);
+* no lane-collapsing reshape -> per-block scales expand to per-element
+  via a one-hot matmul (iota compare + MXU dot), not broadcast+reshape
+  (r03);
+* the last two dims of every BlockSpec must be (sublane, 128)-aligned
+  UNLESS the block covers the whole array dim (r05). This outlaws both
+  the old VMEM fix (shrinking block_o below 128 put a 32/64-lane tile
+  on the OUTPUT spec) and any lane-tiling of the skinny scale arrays
+  (K/32 columns: tiles of 112/224 lanes). The design that satisfies the
+  rule at every real shape: grid over O only, every operand block FULL
+  in the lane dim (full-dim blocks are always legal), and VMEM bounded
+  by an in-kernel statically-unrolled chunk loop over K — per-chunk
+  dequant temporaries are dead after their dot, so live VMEM is
+  O(block_o * chunk) regardless of K.
 """
 
 from __future__ import annotations
@@ -38,6 +51,7 @@ from jax.experimental.pallas import tpu as pltpu
 from bigdl_tpu.utils import round_up
 
 BLOCK = 32  # quant block (elements per scale) for sym_int4; nf4/fp4 use 64
+_VMEM_BUDGET = 10 * 1024 * 1024  # leave scoped-VMEM headroom under 16 MiB
 
 
 def _f16_bits_to_f32(bits):
@@ -58,18 +72,34 @@ def _f16_bits_to_f32(bits):
     return jnp.where(exp == 0, sub, val)
 
 
-def _expand_scales(s, kh: int, base_block: int, block: int = BLOCK):
-    """[block_o, nb] per-block scales -> [block_o, kh] per-element, where
-    element j of this nibble plane belongs to quant block
-    (j + base_block * kh) // block. One-hot matmul: iota/compare/dot only."""
-    nb = s.shape[-1]
+def _expand_scales(s, ck: int, block: int):
+    """[rows, nbc] per-block scales -> [rows, ck] per-element for one
+    chunk whose start is block-aligned: element j belongs to local block
+    j // block. One-hot matmul: iota/compare/dot only."""
+    nbc = s.shape[-1]
     sel = (
-        jax.lax.broadcasted_iota(jnp.int32, (nb, kh), 1) // block
-        + base_block * (kh // block)
-        == jax.lax.broadcasted_iota(jnp.int32, (nb, kh), 0)
+        jax.lax.broadcasted_iota(jnp.int32, (nbc, ck), 1) // block
+        == jax.lax.broadcasted_iota(jnp.int32, (nbc, ck), 0)
     ).astype(jnp.float32)
     return jax.lax.dot_general(
         s, sel, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _expand_super(d, n_sub: int, offset_sub: int, per_super: int):
+    """[bo, nb_super] f32 super-scales -> [bo, n_sub] per-sub-block:
+    sub-block s (global index s + offset_sub) belongs to super-block
+    (s + offset_sub) // per_super. One-hot matmul (iota/compare/dot);
+    the offset form handles chunks that start mid-super-block (odd
+    super-block counts, e.g. llama2's K=11008 -> 43 blocks per row)."""
+    nb = d.shape[-1]
+    sel = (
+        (jax.lax.broadcasted_iota(jnp.int32, (nb, n_sub), 1) + offset_sub)
+        // per_super
+        == jax.lax.broadcasted_iota(jnp.int32, (nb, n_sub), 0)
+    ).astype(jnp.float32)
+    return jax.lax.dot_general(
+        d, sel, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
 
 
@@ -92,172 +122,131 @@ def _decode_nibbles(w, codebook):
     return lut(lo_c), lut(hi_c)
 
 
-def _kernel(xl_ref, xh_ref, w_ref, s_ref, o_ref, *, kh: int,
+def _chunks(total: int, target: int):
+    """Static chunk spans (start, size) covering [0, total); every
+    boundary is a multiple of 128 (x/w lane alignment) and therefore
+    aligned to the 16/32/64-element scale blocks. 256-element
+    SUPER-block boundaries are NOT respected (128-multiples can start
+    mid-super-block, e.g. c0=6144 in kh=7168) — super-scale expansion
+    must use the offset form of _expand_super."""
+    spans = []
+    c0 = 0
+    while c0 < total:
+        ck = min(target, total - c0)
+        spans.append((c0, ck))
+        c0 += ck
+    return spans
+
+
+def _slc(a, c0: int, ck: int):
+    """Static lane-dim slice of a loaded rank-2 array."""
+    return jax.lax.slice(a, (0, c0), (a.shape[0], c0 + ck))
+
+
+def _pick_block_o(O: int, persist_per_row: int, cap: int = 256) -> int:
+    """Largest lane-legal O tile: a multiple of 128 dividing O (256
+    preferred, 128 if the per-row persistent footprint is large or the
+    caller caps it), else the full dim (always legal — Mosaic pads)."""
+    for bo in (256, 128):
+        if bo <= cap and O % bo == 0 and (
+            bo * persist_per_row <= _VMEM_BUDGET // 2
+        ):
+            return bo
+    if O % 128 == 0:
+        return 128
+    return O
+
+
+def _chunk_target(block_o: int, persist_bytes: int, kh: int,
+                  temp_bpe: int = 12) -> int:
+    """Largest chunk whose per-chunk temporaries (temp_bpe B/element of
+    dequant intermediates — ~12 for the sym nibble kernel's lo/hi f32 +
+    wl/wh bf16, ~28 for asym/q4k whose stacked 4-way expansion adds
+    [4*bo, ck] f32 — plus the one-hot sel) fit beside the persistent
+    blocks in the scoped-VMEM budget."""
+    for ck in (2048, 1024, 512, 256, 128):
+        if ck > kh:
+            continue
+        temp = block_o * ck * temp_bpe + (ck // 16) * ck * 4
+        if persist_bytes + temp <= _VMEM_BUDGET:
+            return ck
+    return 128
+
+
+# ---------------------------------------------------------------------------
+# sym_int4 / nf4 / fp4: packed nibbles, single-level per-block scales
+# ---------------------------------------------------------------------------
+
+def _kernel(xl_ref, xh_ref, w_ref, s_ref, o_ref, *, kh: int, ck: int,
             block: int = BLOCK, codebook=None):
-    """One O-tile: o = x_lo @ dq(lo)^T + x_hi @ dq(hi)^T."""
-    w = w_ref[:].astype(jnp.int32)  # [block_o, kh] packed bytes
-    lo, hi = _decode_nibbles(w, codebook)
+    """One O-tile: o = x_lo @ dq(lo)^T + x_hi @ dq(hi)^T, accumulated
+    over statically-unrolled K chunks so live dequant temporaries stay
+    O(block_o * ck)."""
+    M = xl_ref.shape[0]
+    bo = w_ref.shape[0]
+    nbp = kh // block  # scale blocks per nibble plane
+    w = w_ref[:]  # [bo, kh] packed bytes — upcast PER CHUNK, not here:
+    # a hoisted full-row int32 copy would keep 4 B/packed-byte live
+    # across the whole unrolled loop and defeat the O(bo*ck) VMEM bound
+    s = _f16_bits_to_f32(s_ref[:])  # [bo, 2*nbp]
+    xl = xl_ref[:].astype(jnp.bfloat16)
+    xh = xh_ref[:].astype(jnp.bfloat16)
 
-    s = _f16_bits_to_f32(s_ref[:])  # [block_o, nb]
-    wl = (lo * _expand_scales(s, kh, 0, block)).astype(jnp.bfloat16)
-    wh = (hi * _expand_scales(s, kh, 1, block)).astype(jnp.bfloat16)
-
-    xl = xl_ref[:].astype(jnp.bfloat16)  # [M, kh] first half of x
-    xh = xh_ref[:].astype(jnp.bfloat16)  # [M, kh] second half
-    acc = jax.lax.dot_general(
-        xl, wl, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    acc += jax.lax.dot_general(
-        xh, wh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    acc = jnp.zeros((M, bo), jnp.float32)
+    for c0, c in _chunks(kh, ck):
+        lo, hi = _decode_nibbles(_slc(w, c0, c).astype(jnp.int32), codebook)
+        sb0, nbc = c0 // block, c // block
+        wl = (lo * _expand_scales(_slc(s, sb0, nbc), c, block)
+              ).astype(jnp.bfloat16)
+        wh = (hi * _expand_scales(_slc(s, nbp + sb0, nbc), c, block)
+              ).astype(jnp.bfloat16)
+        acc += jax.lax.dot_general(
+            _slc(xl, c0, c), wl, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc += jax.lax.dot_general(
+            _slc(xh, c0, c), wh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
     o_ref[:] = acc.astype(o_ref.dtype)
 
 
-def _kernel_i8(x_ref, w_ref, s_ref, o_ref, *, block: int):
-    """One (O, K) tile of the int8 GEMV, accumulating over the K grid
-    axis: o += x_k @ (w_k * scale_k)^T. Unlike the nibble kernel there
-    is no packing — w is [block_o, block_k] int8; the per-block scales
-    expand with the same one-hot matmul, whose sel matrix is
-    [block_k/32, block_k] and thus bounded by the K tile (a full-K sel
-    at llama3's K=14336 would alone be ~26 MB — over the scoped-VMEM
-    limit the int4 path already hit on real v5e)."""
-    w = w_ref[:].astype(jnp.float32)  # [block_o, block_k]
-    s = _f16_bits_to_f32(s_ref[:])  # [block_o, nb_k]
-    wd = (w * _expand_scales(s, w.shape[-1], 0, block)).astype(jnp.bfloat16)
-    acc = jax.lax.dot_general(
-        x_ref[:].astype(jnp.bfloat16), wd, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-
-    @pl.when(pl.program_id(1) == 0)
-    def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
-
-    o_ref[:] += acc.astype(o_ref.dtype)
-
-
-@functools.partial(
-    jax.jit, static_argnames=("out_dtype", "block_o", "block_k", "interpret",
-                              "block")
-)
-def _qmm_i8(x2, w, s_bits, out_dtype, block_o: int, block_k: int,
-            interpret: bool, block: int):
-    M, K = x2.shape
-    O = w.shape[0]
-    return pl.pallas_call(
-        functools.partial(_kernel_i8, block=block),
-        grid=(O // block_o, K // block_k),
-        in_specs=[
-            pl.BlockSpec((M, block_k), lambda o, k: (0, k),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_o, block_k), lambda o, k: (o, k),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_o, block_k // block), lambda o, k: (o, k),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (M, block_o), lambda o, k: (0, o), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((M, O), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(x2, w, s_bits).astype(out_dtype)
-
-
-def qmatmul_int8(
-    x: jax.Array,  # [..., K]
-    data: jax.Array,  # [O, K] int8 (sym_int8 / imported q8_0)
-    scales: jax.Array,  # [O, K // 32] f16 (or bf16)
-    out_dtype=jnp.bfloat16,
-    block_o: int = 256,
-    interpret: bool | None = None,
-) -> jax.Array:
-    """y[..., O] = x @ dequant(W)^T for a sym_int8 QTensor's fields:
-    weights cross HBM as int8 — half the traffic of bf16, which is the
-    whole cost of a decode GEMV."""
-    from bigdl_tpu.ops.pallas import interpret_mode
-
-    if interpret is None:
-        interpret = interpret_mode()
-    *lead, K = x.shape
-    O, Kw = data.shape
-    assert Kw == K and K % BLOCK == 0
-
-    M = 1
-    for d in lead:
-        M *= d
-    Mp = round_up(max(M, 1), 8)
-    x2 = x.reshape(M, K)
-    if Mp != M:
-        x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
-
-    block_o = min(block_o, O)
-    # K tile: sel matrix (block_k/32 x block_k f32) + w expansion fit
-    # comfortably at 4096
-    block_k = K
-    while block_k > 4096 and K % (block_k // 2) == 0 and block_k % 2 == 0:
-        block_k //= 2
-    # VMEM model: w i8 + f32 expansion + bf16 copy ≈ 7 B per element,
-    # plus the one-hot sel at ~block_k^2/8 B
-    VMEM_BUDGET = 10 * 1024 * 1024
-    while block_o > 8 and (
-        block_o * block_k * 7 + block_k * block_k // 8 > VMEM_BUDGET
-        or O % block_o
-    ):
-        block_o //= 2
-    assert O % block_o == 0, f"O={O} not divisible by block_o={block_o}"
-    assert K % block_k == 0
-
-    if scales.dtype == jnp.float16:
-        s_bits = jax.lax.bitcast_convert_type(scales, jnp.uint16)
-    else:
-        s_bits = jax.lax.bitcast_convert_type(
-            scales.astype(jnp.float16), jnp.uint16
-        )
-    y = _qmm_i8(x2, data, s_bits, jnp.dtype(out_dtype), block_o, block_k,
-                interpret, BLOCK)
-    return y[:M].reshape(*lead, O)
-
-
-@functools.partial(
-    jax.jit, static_argnames=("out_dtype", "block_o", "interpret", "two_view",
-                              "block", "codebook")
-)
-def _qmm(x2, w, s_bits, out_dtype, block_o: int, interpret: bool,
-         two_view: bool, block: int = BLOCK, codebook=None):
-    """two_view=True: x2 is [M, K] and the kernel's two x operands are
-    delivered as half-lane views of the same array by BlockSpec index
-    maps — zero data movement. Requires kh % 128 == 0 (Mosaic lane
-    rule); small-K callers pre-slice instead (still contiguous)."""
+def _x_specs(x2, two_view: bool):
+    """x delivered as two half-lane views of one array (two_view) or as
+    two pre-sliced halves; both are full-lane blocks."""
     if two_view:
         M, K = x2.shape
         kh = K // 2
-        x_args = (x2, x2)
-        x_specs = [
+        return (x2, x2), [
             pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((M, kh), lambda o: (0, 1), memory_space=pltpu.VMEM),
-        ]
-    else:
-        xl, xh = x2
-        M, kh = xl.shape
-        x_args = (xl, xh)
-        x_specs = [
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
-        ]
+        ], M, kh
+    xl, xh = x2
+    M, kh = xl.shape
+    return (xl, xh), [
+        pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
+    ], M, kh
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "ck", "interpret",
+                              "two_view", "block", "codebook")
+)
+def _qmm(x2, w, s_bits, out_dtype, block_o: int, ck: int, interpret: bool,
+         two_view: bool, block: int = BLOCK, codebook=None):
+    x_args, x_specs, M, kh = _x_specs(x2, two_view)
     O = w.shape[0]
-    grid = (O // block_o,)
+    nb = s_bits.shape[1]  # == K // block, full row (lane-legal: full dim)
     return pl.pallas_call(
-        functools.partial(_kernel, kh=kh, block=block, codebook=codebook),
-        grid=grid,
+        functools.partial(_kernel, kh=kh, ck=ck, block=block,
+                          codebook=codebook),
+        grid=(O // block_o,),
         in_specs=x_specs + [
-            pl.BlockSpec((block_o, kh), lambda o: (o, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (block_o, kh // (block // 2)), lambda o: (o, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            pl.BlockSpec((block_o, kh), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nb), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
             (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
@@ -281,122 +270,6 @@ def qmatmul_int4(
     """y[..., O] = x @ dequant(W)^T for a sym_int4 QTensor's fields."""
     return _qmatmul_nibble(x, data, scales, out_dtype, block_o, interpret,
                            block=BLOCK, codebook=None)
-
-
-def _expand_super(d, n_sub: int, offset_sub: int, per_super: int):
-    """[bo, nb_super] f32 super-scales -> [bo, n_sub] per-sub-block:
-    sub-block s (global index s + offset_sub) belongs to super-block
-    (s + offset_sub) // per_super. One-hot matmul (iota/compare/dot),
-    same Mosaic-safe expansion idiom as _expand_scales; the offset form
-    handles nibble planes that start mid-super-block (odd super-block
-    counts, e.g. llama2's K=11008 -> 43 blocks per row)."""
-    nb = d.shape[-1]
-    sel = (
-        (jax.lax.broadcasted_iota(jnp.int32, (nb, n_sub), 1) + offset_sub)
-        // per_super
-        == jax.lax.broadcasted_iota(jnp.int32, (nb, n_sub), 0)
-    ).astype(jnp.float32)
-    return jax.lax.dot_general(
-        d, sel, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-
-
-def _kernel_asym(xl_ref, xh_ref, w_ref, sl_ref, sh_ref, ml_ref, mh_ref,
-                 o_ref, *, kh: int, block: int):
-    """asym_int4 O-tile: w = q*d + m (q in 0..15, per-block f16 d/m,
-    mins stored as the raw block minimum — the `+ m` convention of
-    quant/numerics). Scales arrive pre-sliced per nibble plane, so the
-    one-hot expansion sel is (kh/block, kh) — half the full-row sel.
-    The four expansions (s/m x lo/hi) share that one sel via a single
-    stacked dot, keeping one sel materialization live."""
-    w = w_ref[:].astype(jnp.int32)
-    lo = (w & 0xF).astype(jnp.float32)
-    hi = (w >> 4).astype(jnp.float32)
-
-    stacked = jnp.concatenate(
-        [_f16_bits_to_f32(r[:]) for r in (sl_ref, ml_ref, sh_ref, mh_ref)],
-        axis=0,
-    )  # [4*bo, kh/block]
-    exp = _expand_scales(stacked, kh, 0, block)  # [4*bo, kh]
-    bo = w.shape[0]
-    s_lo, m_lo = exp[:bo], exp[bo:2 * bo]
-    s_hi, m_hi = exp[2 * bo:3 * bo], exp[3 * bo:]
-
-    wl = (lo * s_lo + m_lo).astype(jnp.bfloat16)
-    wh = (hi * s_hi + m_hi).astype(jnp.bfloat16)
-    acc = jax.lax.dot_general(
-        xl_ref[:].astype(jnp.bfloat16), wl, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc += jax.lax.dot_general(
-        xh_ref[:].astype(jnp.bfloat16), wh, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[:] = acc.astype(o_ref.dtype)
-
-
-def _kernel_q4k(xl_ref, xh_ref, w_ref, d_ref, dmin_ref, scl_ref, sch_ref,
-                mnl_ref, mnh_ref, o_ref, *, kh: int):
-    """q4_k O-tile: w = (d*sc)*q - (dmin*mn) per 32-element sub-block.
-    d/dmin are FULL per-super-block rows [bo, nb] (f16 bits) expanded
-    in-kernel with an offset one-hot — BlockSpec slicing them per plane
-    would need fractional offsets when nb is odd. sc/mn arrive pre-
-    sliced per plane ([bo, kh/32] uint8). All four per-element
-    expansions share one (kh/32, kh) sel via a stacked dot."""
-    w = w_ref[:].astype(jnp.int32)
-    lo = (w & 0xF).astype(jnp.float32)
-    hi = (w >> 4).astype(jnp.float32)
-
-    d32 = _f16_bits_to_f32(d_ref[:])  # [bo, nb]
-    dmin32 = _f16_bits_to_f32(dmin_ref[:])
-    n_sub = kh // 32  # sub-blocks per plane
-    per_super = 8  # 256-element super-block = 8 sub-blocks of 32
-    s_lo = _expand_super(d32, n_sub, 0, per_super) * (
-        scl_ref[:].astype(jnp.float32))
-    s_hi = _expand_super(d32, n_sub, n_sub, per_super) * (
-        sch_ref[:].astype(jnp.float32))
-    m_lo = _expand_super(dmin32, n_sub, 0, per_super) * (
-        mnl_ref[:].astype(jnp.float32))
-    m_hi = _expand_super(dmin32, n_sub, n_sub, per_super) * (
-        mnh_ref[:].astype(jnp.float32))
-
-    stacked = jnp.concatenate([s_lo, m_lo, s_hi, m_hi], axis=0)
-    exp = _expand_scales(stacked, kh, 0, 32)  # [4*bo, kh]
-    bo = w.shape[0]
-
-    wl = (lo * exp[:bo] - exp[bo:2 * bo]).astype(jnp.bfloat16)
-    wh = (hi * exp[2 * bo:3 * bo] - exp[3 * bo:]).astype(jnp.bfloat16)
-    acc = jax.lax.dot_general(
-        xl_ref[:].astype(jnp.bfloat16), wl, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc += jax.lax.dot_general(
-        xh_ref[:].astype(jnp.bfloat16), wh, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[:] = acc.astype(o_ref.dtype)
-
-
-def _kernel_q6k(x_ref, w_ref, d_ref, sc_ref, o_ref, *, block_k: int):
-    """One (O, K) tile of the q6_k GEMV, accumulating over the K grid
-    axis: w = (d*sc)*q per 16-element sub-block, codes already centered
-    int8. K tiles align to 256-element super-blocks so d needs no
-    offset; sel is (block_k/16, block_k), bounded by the K tile."""
-    w = w_ref[:].astype(jnp.float32)  # [bo, bk] int8 codes
-    d32 = _f16_bits_to_f32(d_ref[:])  # [bo, bk/256]
-    n_sub = block_k // 16
-    s_sub = _expand_super(d32, n_sub, 0, 16) * sc_ref[:].astype(jnp.float32)
-    wd = (w * _expand_scales(s_sub, block_k, 0, 16)).astype(jnp.bfloat16)
-    acc = jax.lax.dot_general(
-        x_ref[:].astype(jnp.bfloat16), wd, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-
-    @pl.when(pl.program_id(1) == 0)
-    def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
-
-    o_ref[:] += acc.astype(o_ref.dtype)
 
 
 def qmatmul_codebook(
@@ -432,7 +305,7 @@ def _qmatmul_nibble(x, data, scales, out_dtype, block_o, interpret,
     *lead, K = x.shape
     O, kh = data.shape
     # K % (2*block): with half-split packing each nibble plane must cover
-    # whole quant blocks, or _expand_scales' j//block math is wrong
+    # whole quant blocks, or the chunked scale slicing is wrong
     assert kh * 2 == K and K % (2 * block) == 0
 
     M = 1
@@ -443,25 +316,10 @@ def _qmatmul_nibble(x, data, scales, out_dtype, block_o, interpret,
     if Mp != M:
         x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
 
-    block_o = min(block_o, O)
-    # Mosaic scoped-VMEM budget: the kernel materializes lo/hi f32 and
-    # wl/wh bf16 expansions of the weight tile — ~12 bytes per packed
-    # element on the stack. At block_o=256, K=14336 (llama3-8b down_proj)
-    # that overflows the 16 MiB scoped limit on real v5e ("Ran out of
-    # memory in memory space vmem", BENCH r03) — a failure interpret-mode
-    # CPU tests cannot see. Shrink the O tile until the model fits in
-    # ~10 MiB, leaving headroom for x views and the scale one-hot.
-    VMEM_BUDGET = 10 * 1024 * 1024
-    # block_o-dependent tile (~12 B/packed element) + the block_o-
-    # INDEPENDENT one-hot sel matrix ((kh/32) x kh f32 = kh^2/8 B);
-    # shrinking the O tile cannot shrink the sel — if a future shape
-    # overflows even at block_o=8, the fix is K-tiling like _qmm_i8
-    sel_bytes = kh * kh // 8
-    while block_o > 8 and (
-        block_o * kh * 12 + sel_bytes > VMEM_BUDGET or O % block_o
-    ):
-        block_o //= 2
-    assert O % block_o == 0, f"O={O} not divisible by block_o={block_o}"
+    # persistent VMEM per O row: w bytes (kh) + scale bits (K/block * 2)
+    persist_row = kh + (K // block) * 2
+    block_o = _pick_block_o(O, persist_row, cap=block_o)
+    ck = _chunk_target(block_o, block_o * persist_row + Mp * K * 2, kh)
 
     if scales.dtype == jnp.float16:
         s_bits = jax.lax.bitcast_convert_type(scales, jnp.uint16)
@@ -471,8 +329,106 @@ def _qmatmul_nibble(x, data, scales, out_dtype, block_o, interpret,
         )
     two_view = kh % 128 == 0
     xa = x2 if two_view else (x2[:, :kh], x2[:, kh:])
-    y = _qmm(xa, data, s_bits, jnp.dtype(out_dtype), block_o, interpret,
+    y = _qmm(xa, data, s_bits, jnp.dtype(out_dtype), block_o, ck, interpret,
              two_view, block, codebook)
+    return y[:M].reshape(*lead, O)
+
+
+# ---------------------------------------------------------------------------
+# sym_int8
+# ---------------------------------------------------------------------------
+
+def _kernel_i8(x_ref, w_ref, s_ref, o_ref, *, ck: int, block: int):
+    """One O-tile of the int8 GEMV: o = x @ (w * scale)^T, chunked over
+    K in-kernel. No packing — w is [bo, K] int8."""
+    M = x_ref.shape[0]
+    bo = w_ref.shape[0]
+    K = w_ref.shape[1]
+    w = w_ref[:]
+    s = _f16_bits_to_f32(s_ref[:])  # [bo, K/block]
+    x = x_ref[:].astype(jnp.bfloat16)
+
+    acc = jnp.zeros((M, bo), jnp.float32)
+    for c0, c in _chunks(K, ck):
+        wc = _slc(w, c0, c).astype(jnp.float32)
+        sc = _slc(s, c0 // block, c // block)
+        wd = (wc * _expand_scales(sc, c, block)).astype(jnp.bfloat16)
+        acc += jax.lax.dot_general(
+            _slc(x, c0, c), wd, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "ck", "interpret",
+                              "block")
+)
+def _qmm_i8(x2, w, s_bits, out_dtype, block_o: int, ck: int,
+            interpret: bool, block: int):
+    M, K = x2.shape
+    O = w.shape[0]
+    nb = s_bits.shape[1]
+    return pl.pallas_call(
+        functools.partial(_kernel_i8, ck=ck, block=block),
+        grid=(O // block_o,),
+        in_specs=[
+            pl.BlockSpec((M, K), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, K), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nb), lambda o: (o, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(x2, w, s_bits)
+
+
+def qmatmul_int8(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K] int8 (sym_int8 / imported q8_0)
+    scales: jax.Array,  # [O, K // 32] f16 (or bf16)
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """y[..., O] = x @ dequant(W)^T for a sym_int8 QTensor's fields:
+    weights cross HBM as int8 — half the traffic of bf16, which is the
+    whole cost of a decode GEMV."""
+    from bigdl_tpu.ops.pallas import interpret_mode
+
+    if interpret is None:
+        interpret = interpret_mode()
+    *lead, K = x.shape
+    O, Kw = data.shape
+    assert Kw == K and K % BLOCK == 0
+
+    M = 1
+    for d in lead:
+        M *= d
+    Mp = round_up(max(M, 1), 8)
+    x2 = x.reshape(M, K)
+    if Mp != M:
+        x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
+
+    persist_row = K + (K // BLOCK) * 2
+    block_o = _pick_block_o(O, persist_row, cap=block_o)
+    ck = _chunk_target(block_o, block_o * persist_row + Mp * K * 2, K)
+
+    if scales.dtype == jnp.float16:
+        s_bits = jax.lax.bitcast_convert_type(scales, jnp.uint16)
+    else:
+        s_bits = jax.lax.bitcast_convert_type(
+            scales.astype(jnp.float16), jnp.uint16
+        )
+    y = _qmm_i8(x2, data, s_bits, jnp.dtype(out_dtype), block_o, ck,
+                interpret, BLOCK)
     return y[:M].reshape(*lead, O)
 
 
@@ -494,20 +450,7 @@ def _gemv_prep(x, block_o: int, O: int, interpret):
     x2 = x.reshape(M, K)
     if Mp != M:
         x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
-    return x2, lead, M, K, min(block_o, O), interpret
-
-
-def _shrink_block_o(block_o: int, O: int, bytes_per_row: int,
-                    fixed_bytes: int, budget: int = 10 * 1024 * 1024) -> int:
-    """Largest power-of-two O tile whose VMEM model fits the scoped
-    budget (round-3 lesson: model VMEM explicitly — Mosaic overflows at
-    shapes the CPU interpreter happily accepts)."""
-    while block_o > 8 and (
-        block_o * bytes_per_row + fixed_bytes > budget or O % block_o
-    ):
-        block_o //= 2
-    assert O % block_o == 0, f"O={O} not divisible by block_o={block_o}"
-    return block_o
+    return x2, lead, M, K, Mp, interpret
 
 
 def _f16_bits(a: jax.Array) -> jax.Array:
@@ -516,45 +459,62 @@ def _f16_bits(a: jax.Array) -> jax.Array:
     return jax.lax.bitcast_convert_type(a, jnp.uint16)
 
 
+def _kernel_asym(xl_ref, xh_ref, w_ref, s_ref, m_ref, o_ref, *, kh: int,
+                 ck: int, block: int):
+    """asym_int4 O-tile: w = q*d + m (q in 0..15, per-block f16 d/m,
+    mins stored as the raw block minimum — the `+ m` convention of
+    quant/numerics). Per chunk, the four expansions (s/m x lo/hi) share
+    one (nbc, ck) sel via a single stacked dot."""
+    M = xl_ref.shape[0]
+    bo = w_ref.shape[0]
+    nbp = kh // block
+    w = w_ref[:]  # packed bytes; upcast per chunk (VMEM bound)
+    s = _f16_bits_to_f32(s_ref[:])  # [bo, 2*nbp]
+    m = _f16_bits_to_f32(m_ref[:])
+    xl = xl_ref[:].astype(jnp.bfloat16)
+    xh = xh_ref[:].astype(jnp.bfloat16)
+
+    acc = jnp.zeros((M, bo), jnp.float32)
+    for c0, c in _chunks(kh, ck):
+        wc = _slc(w, c0, c).astype(jnp.int32)
+        lo = (wc & 0xF).astype(jnp.float32)
+        hi = (wc >> 4).astype(jnp.float32)
+        sb0, nbc = c0 // block, c // block
+        stacked = jnp.concatenate([
+            _slc(s, sb0, nbc), _slc(m, sb0, nbc),
+            _slc(s, nbp + sb0, nbc), _slc(m, nbp + sb0, nbc),
+        ], axis=0)  # [4*bo, nbc]
+        exp = _expand_scales(stacked, c, block)  # [4*bo, c]
+        wl = (lo * exp[:bo] + exp[bo:2 * bo]).astype(jnp.bfloat16)
+        wh = (hi * exp[2 * bo:3 * bo] + exp[3 * bo:]).astype(jnp.bfloat16)
+        acc += jax.lax.dot_general(
+            _slc(xl, c0, c), wl, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc += jax.lax.dot_general(
+            _slc(xh, c0, c), wh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("out_dtype", "block_o", "interpret",
+    jax.jit, static_argnames=("out_dtype", "block_o", "ck", "interpret",
                               "two_view", "block")
 )
-def _qmm_asym(x2, w, s_bits, m_bits, out_dtype, block_o: int,
+def _qmm_asym(x2, w, s_bits, m_bits, out_dtype, block_o: int, ck: int,
               interpret: bool, two_view: bool, block: int):
-    if two_view:
-        M, K = x2.shape
-        kh = K // 2
-        x_args = (x2, x2)
-        x_specs = [
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((M, kh), lambda o: (0, 1), memory_space=pltpu.VMEM),
-        ]
-    else:
-        xl, xh = x2
-        M, kh = xl.shape
-        x_args = (xl, xh)
-        x_specs = [
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
-        ]
+    x_args, x_specs, M, kh = _x_specs(x2, two_view)
     O = w.shape[0]
-    nbp = kh // block  # scale blocks per nibble plane
-    sm_specs = [
-        pl.BlockSpec((block_o, nbp), lambda o: (o, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((block_o, nbp), lambda o: (o, 1), memory_space=pltpu.VMEM),
-    ]
+    nb = s_bits.shape[1]
+    row = lambda o: (o, 0)
     return pl.pallas_call(
-        functools.partial(_kernel_asym, kh=kh, block=block),
+        functools.partial(_kernel_asym, kh=kh, ck=ck, block=block),
         grid=(O // block_o,),
         in_specs=x_specs + [
-            pl.BlockSpec((block_o, kh), lambda o: (o, 0),
-                         memory_space=pltpu.VMEM),
-            sm_specs[0], sm_specs[1],  # s lo/hi plane
-            pl.BlockSpec((block_o, nbp), lambda o: (o, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_o, nbp), lambda o: (o, 1),
-                         memory_space=pltpu.VMEM),  # m lo/hi plane
+            pl.BlockSpec((block_o, kh), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nb), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nb), row, memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
             (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
@@ -564,7 +524,7 @@ def _qmm_asym(x2, w, s_bits, m_bits, out_dtype, block_o: int,
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,
-    )(*x_args, w, s_bits, s_bits, m_bits, m_bits)
+    )(*x_args, w, s_bits, m_bits)
 
 
 def qmatmul_asym_int4(
@@ -580,58 +540,88 @@ def qmatmul_asym_int4(
     rank-1-per-block term, folded into the bf16 weight expansion before
     the dot (same HBM story as sym_int4 + 0.5 bit/weight for mins)."""
     O, kh = data.shape
-    x2, lead, M, K, block_o, interpret = _gemv_prep(x, block_o, O, interpret)
+    x2, lead, M, K, Mp, interpret = _gemv_prep(x, block_o, O, interpret)
     assert kh * 2 == K and K % (2 * BLOCK) == 0 and (K // BLOCK) % 2 == 0
-    sel_bytes = kh * kh // 8
-    block_o = _shrink_block_o(block_o, O, kh * 30, sel_bytes)
+    persist_row = kh + (K // BLOCK) * 4
+    block_o = _pick_block_o(O, persist_row, cap=block_o)
+    ck = _chunk_target(block_o, block_o * persist_row + Mp * K * 2, kh,
+                       temp_bpe=28)
     two_view = kh % 128 == 0
     xa = x2 if two_view else (x2[:, :kh], x2[:, kh:])
     y = _qmm_asym(xa, data, _f16_bits(scales), _f16_bits(mins),
-                  jnp.dtype(out_dtype), block_o, interpret, two_view, BLOCK)
+                  jnp.dtype(out_dtype), block_o, ck, interpret, two_view,
+                  BLOCK)
     return y[:M].reshape(*lead, O)
 
 
+def _kernel_q4k(xl_ref, xh_ref, w_ref, d_ref, dmin_ref, sc_ref, mn_ref,
+                o_ref, *, kh: int, ck: int):
+    """q4_k O-tile: w = (d*sc)*q - (dmin*mn) per 32-element sub-block.
+    d/dmin are per-super-block rows [bo, nb] (f16 bits); sc/mn are full
+    global sub-block rows [bo, K/32] uint8. Per chunk the super-scale
+    expansion uses the offset one-hot (chunks may start mid-super-block
+    when nb is odd), and all four per-element expansions share one
+    (nsc, ck) sel via a stacked dot."""
+    M = xl_ref.shape[0]
+    bo = w_ref.shape[0]
+    nsp = kh // 32  # sub-blocks per nibble plane
+    per_super = 8  # 256-element super-block = 8 sub-blocks of 32
+    w = w_ref[:]  # packed bytes; upcast per chunk (VMEM bound)
+    d32 = _f16_bits_to_f32(d_ref[:])  # [bo, nb]
+    dmin32 = _f16_bits_to_f32(dmin_ref[:])
+    sc = sc_ref[:].astype(jnp.float32)  # [bo, 2*nsp]
+    mn = mn_ref[:].astype(jnp.float32)
+    xl = xl_ref[:].astype(jnp.bfloat16)
+    xh = xh_ref[:].astype(jnp.bfloat16)
+
+    acc = jnp.zeros((M, bo), jnp.float32)
+    for c0, c in _chunks(kh, ck):
+        wc = _slc(w, c0, c).astype(jnp.int32)
+        lo = (wc & 0xF).astype(jnp.float32)
+        hi = (wc >> 4).astype(jnp.float32)
+        sb0, nsc = c0 // 32, c // 32
+        s_lo = _expand_super(d32, nsc, sb0, per_super) * (
+            _slc(sc, sb0, nsc))
+        s_hi = _expand_super(d32, nsc, nsp + sb0, per_super) * (
+            _slc(sc, nsp + sb0, nsc))
+        m_lo = _expand_super(dmin32, nsc, sb0, per_super) * (
+            _slc(mn, sb0, nsc))
+        m_hi = _expand_super(dmin32, nsc, nsp + sb0, per_super) * (
+            _slc(mn, nsp + sb0, nsc))
+        stacked = jnp.concatenate([s_lo, m_lo, s_hi, m_hi], axis=0)
+        exp = _expand_scales(stacked, c, 32)  # [4*bo, c]
+        wl = (lo * exp[:bo] - exp[bo:2 * bo]).astype(jnp.bfloat16)
+        wh = (hi * exp[2 * bo:3 * bo] - exp[3 * bo:]).astype(jnp.bfloat16)
+        acc += jax.lax.dot_general(
+            _slc(xl, c0, c), wl, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc += jax.lax.dot_general(
+            _slc(xh, c0, c), wh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("out_dtype", "block_o", "interpret", "two_view")
+    jax.jit, static_argnames=("out_dtype", "block_o", "ck", "interpret",
+                              "two_view")
 )
 def _qmm_q4k(x2, w, d_bits, dmin_bits, sc, mn, out_dtype, block_o: int,
-             interpret: bool, two_view: bool):
-    if two_view:
-        M, K = x2.shape
-        kh = K // 2
-        x_args = (x2, x2)
-        x_specs = [
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((M, kh), lambda o: (0, 1), memory_space=pltpu.VMEM),
-        ]
-    else:
-        xl, xh = x2
-        M, kh = xl.shape
-        x_args = (xl, xh)
-        x_specs = [
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((M, kh), lambda o: (0, 0), memory_space=pltpu.VMEM),
-        ]
+             ck: int, interpret: bool, two_view: bool):
+    x_args, x_specs, M, kh = _x_specs(x2, two_view)
     O, nb = d_bits.shape  # nb = K/256 super-blocks
-    nsp = kh // 32  # sub-blocks per plane
+    nsub = sc.shape[1]  # K/32 global sub-blocks
+    row = lambda o: (o, 0)
     return pl.pallas_call(
-        functools.partial(_kernel_q4k, kh=kh),
+        functools.partial(_kernel_q4k, kh=kh, ck=ck),
         grid=(O // block_o,),
         in_specs=x_specs + [
-            pl.BlockSpec((block_o, kh), lambda o: (o, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_o, nb), lambda o: (o, 0),
-                         memory_space=pltpu.VMEM),  # d (full row)
-            pl.BlockSpec((block_o, nb), lambda o: (o, 0),
-                         memory_space=pltpu.VMEM),  # dmin
-            pl.BlockSpec((block_o, nsp), lambda o: (o, 0),
-                         memory_space=pltpu.VMEM),  # sc lo plane
-            pl.BlockSpec((block_o, nsp), lambda o: (o, 1),
-                         memory_space=pltpu.VMEM),  # sc hi plane
-            pl.BlockSpec((block_o, nsp), lambda o: (o, 0),
-                         memory_space=pltpu.VMEM),  # mn lo
-            pl.BlockSpec((block_o, nsp), lambda o: (o, 1),
-                         memory_space=pltpu.VMEM),  # mn hi
+            pl.BlockSpec((block_o, kh), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nb), row, memory_space=pltpu.VMEM),  # d
+            pl.BlockSpec((block_o, nb), row, memory_space=pltpu.VMEM),  # dmin
+            pl.BlockSpec((block_o, nsub), row, memory_space=pltpu.VMEM),  # sc
+            pl.BlockSpec((block_o, nsub), row, memory_space=pltpu.VMEM),  # mn
         ],
         out_specs=pl.BlockSpec(
             (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
@@ -641,7 +631,7 @@ def _qmm_q4k(x2, w, d_bits, dmin_bits, sc, mn, out_dtype, block_o: int,
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,
-    )(*x_args, w, d_bits, dmin_bits, sc, sc, mn, mn)
+    )(*x_args, w, d_bits, dmin_bits, sc, mn)
 
 
 def qmatmul_q4k(
@@ -660,49 +650,75 @@ def qmatmul_q4k(
     the reference's recommended quality format (README ppl table) served
     at sym_int4-class bandwidth instead of the 2.7x dequant fallback."""
     O, kh = data.shape
-    x2, lead, M, K, block_o, interpret = _gemv_prep(x, block_o, O, interpret)
+    x2, lead, M, K, Mp, interpret = _gemv_prep(x, block_o, O, interpret)
     # whole super-blocks per row and whole 32-element sub-blocks per
     # nibble plane; odd super-block counts are fine (offset expansion)
     assert kh * 2 == K and K % 256 == 0
-    sel_bytes = kh * kh // 8
-    block_o = _shrink_block_o(block_o, O, kh * 30, sel_bytes)
+    persist_row = kh + (K // 256) * 4 + (K // 32) * 2
+    block_o = _pick_block_o(O, persist_row, cap=block_o)
+    ck = _chunk_target(block_o, block_o * persist_row + Mp * K * 2, kh,
+                       temp_bpe=28)
     two_view = kh % 128 == 0
     xa = x2 if two_view else (x2[:, :kh], x2[:, kh:])
     y = _qmm_q4k(xa, data, _f16_bits(scales), _f16_bits(mins),
-                 sub_scales, sub_mins, jnp.dtype(out_dtype), block_o,
+                 sub_scales, sub_mins, jnp.dtype(out_dtype), block_o, ck,
                  interpret, two_view)
     return y[:M].reshape(*lead, O)
 
 
+def _kernel_q6k(x_ref, w_ref, d_ref, sc_ref, o_ref, *, ck: int):
+    """q6_k O-tile: w = (d*sc)*q per 16-element sub-block, codes already
+    centered int8, chunked over K in-kernel (chunks may start mid-
+    super-block: offset one-hot)."""
+    M = x_ref.shape[0]
+    bo = w_ref.shape[0]
+    K = w_ref.shape[1]
+    w = w_ref[:]
+    d32 = _f16_bits_to_f32(d_ref[:])  # [bo, K/256]
+    scf = sc_ref[:].astype(jnp.float32)  # [bo, K/16]
+    x = x_ref[:].astype(jnp.bfloat16)
+
+    acc = jnp.zeros((M, bo), jnp.float32)
+    for c0, c in _chunks(K, ck):
+        wc = _slc(w, c0, c).astype(jnp.float32)
+        sb0, nsc = c0 // 16, c // 16
+        s_sub = _expand_super(d32, nsc, sb0, 16) * _slc(scf, sb0, nsc)
+        wd = (wc * _expand_scales(s_sub, c, 16)).astype(jnp.bfloat16)
+        acc += jax.lax.dot_general(
+            _slc(x, c0, c), wd, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("out_dtype", "block_o", "block_k", "interpret")
+    jax.jit, static_argnames=("out_dtype", "block_o", "ck", "interpret")
 )
-def _qmm_q6k(x2, w, d_bits, sc, out_dtype, block_o: int, block_k: int,
+def _qmm_q6k(x2, w, d_bits, sc, out_dtype, block_o: int, ck: int,
              interpret: bool):
     M, K = x2.shape
     O = w.shape[0]
+    row = lambda o: (o, 0)
     return pl.pallas_call(
-        functools.partial(_kernel_q6k, block_k=block_k),
-        grid=(O // block_o, K // block_k),
+        functools.partial(_kernel_q6k, ck=ck),
+        grid=(O // block_o,),
         in_specs=[
-            pl.BlockSpec((M, block_k), lambda o, k: (0, k),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_o, block_k), lambda o, k: (o, k),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_o, block_k // 256), lambda o, k: (o, k),
+            pl.BlockSpec((M, K), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, K), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, K // 256), row,
                          memory_space=pltpu.VMEM),  # d
-            pl.BlockSpec((block_o, block_k // 16), lambda o, k: (o, k),
+            pl.BlockSpec((block_o, K // 16), row,
                          memory_space=pltpu.VMEM),  # sc
         ],
         out_specs=pl.BlockSpec(
-            (M, block_o), lambda o, k: (0, o), memory_space=pltpu.VMEM
+            (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((M, O), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=("parallel",),
         ),
         interpret=interpret,
-    )(x2, w, d_bits, sc).astype(out_dtype)
+    )(x2, w, d_bits, sc)
 
 
 def qmatmul_q6k(
@@ -715,23 +731,14 @@ def qmatmul_q6k(
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused GEMV for planar q6_k: w = (d*sc)*q per 16-element
-    sub-block, K-tiled accumulation (K tiles align to super-blocks so
-    the super-scale expansion needs no offset)."""
+    sub-block, K chunked in-kernel."""
     O, Kw = data.shape
-    x2, lead, M, K, block_o, interpret = _gemv_prep(x, block_o, O, interpret)
+    x2, lead, M, K, Mp, interpret = _gemv_prep(x, block_o, O, interpret)
     assert Kw == K and K % 256 == 0
 
-    # K tile: largest multiple-of-256 divisor of K that keeps the
-    # (bk/16, bk) one-hot sel within budget (<= 4096); prime super-block
-    # counts (llama2's 11008 = 43 blocks) degrade to 256-wide tiles
-    block_k = 256
-    nb = K // 256
-    for t in range(nb, 0, -1):
-        if nb % t == 0 and t * 256 <= 4096:
-            block_k = t * 256
-            break
-    sel_bytes = block_k * block_k // 4
-    block_o = _shrink_block_o(block_o, O, block_k * 11, sel_bytes)
+    persist_row = K + (K // 256) * 2 + (K // 16)
+    block_o = _pick_block_o(O, persist_row, cap=block_o)
+    ck = _chunk_target(block_o, block_o * persist_row + Mp * K * 2, K)
     y = _qmm_q6k(x2, data, _f16_bits(scales), sub_scales,
-                 jnp.dtype(out_dtype), block_o, block_k, interpret)
+                 jnp.dtype(out_dtype), block_o, ck, interpret)
     return y[:M].reshape(*lead, O)

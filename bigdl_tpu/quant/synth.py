@@ -1,0 +1,65 @@
+"""Synthetic QTensor fields for kernel smokes and benchmarks.
+
+The fused-GEMV kernels only see packed fields; running the real
+host-side quantizer at benchmark shapes costs minutes (the k-quant
+numpy pass on a 4096x14336 weight measured ~90 s on the bench host,
+r05) while random-but-valid fields cost milliseconds and exercise the
+identical compiled program. Used by bench.py's compile-smoke stage and
+scripts/tpu_smoke.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.quant.qtensor import QTensor
+from bigdl_tpu.quant.qtypes import resolve_qtype
+
+
+def synth_qtensor(qtype: str, O: int, K: int,
+                  rng: np.random.Generator | None = None) -> QTensor:
+    """Random-but-valid QTensor host-side fields (not device-put)."""
+    rng = rng or np.random.default_rng(0)
+    spec = resolve_qtype(qtype)
+    f16 = jnp.float16
+    if qtype == "sym_int8":
+        fields = dict(
+            data=jnp.asarray(rng.integers(-127, 128, (O, K), np.int8)),
+            scales=jnp.asarray(rng.random((O, K // 32), np.float32) * 0.01,
+                               f16),
+        )
+    elif qtype == "q6_k":
+        fields = dict(
+            data=jnp.asarray(rng.integers(-32, 32, (O, K), np.int8)),
+            scales=jnp.asarray(rng.random((O, K // 256), np.float32) * 0.01,
+                               f16),
+            sub_scales=jnp.asarray(
+                rng.integers(-64, 64, (O, K // 16), np.int8)),
+        )
+    elif qtype == "q4_k":
+        fields = dict(
+            data=jnp.asarray(rng.integers(0, 256, (O, K // 2), np.uint8)),
+            scales=jnp.asarray(rng.random((O, K // 256), np.float32) * 0.01,
+                               f16),
+            mins=jnp.asarray(rng.random((O, K // 256), np.float32) * 0.01,
+                             f16),
+            sub_scales=jnp.asarray(rng.integers(0, 64, (O, K // 32),
+                                                np.uint8)),
+            sub_mins=jnp.asarray(rng.integers(0, 64, (O, K // 32),
+                                              np.uint8)),
+        )
+    elif qtype == "asym_int4":
+        fields = dict(
+            data=jnp.asarray(rng.integers(0, 256, (O, K // 2), np.uint8)),
+            scales=jnp.asarray(rng.random((O, K // 32), np.float32) * 0.01,
+                               f16),
+            mins=jnp.asarray(rng.random((O, K // 32), np.float32) * -0.08,
+                             f16),
+        )
+    else:  # sym_int4 / nf4 / fp4: packed nibbles + one scale per block
+        nb = K // spec.block_size
+        fields = dict(
+            data=jnp.asarray(rng.integers(0, 256, (O, K // 2), np.uint8)),
+            scales=jnp.asarray(rng.random((O, nb), np.float32) * 0.01, f16),
+        )
+    return QTensor(qtype=qtype, **fields)
