@@ -117,6 +117,7 @@ class ApiServer:
         speculative: bool = False,  # in-engine draft-K-then-verify
         draft_params=None,  # None = sym_int4 self-draft of the model
         draft_k: int = 4,
+        journal: Optional[str] = None,  # crash-recovery request journal
     ):
         from bigdl_tpu.serving.metrics import Metrics
 
@@ -124,7 +125,7 @@ class ApiServer:
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, page_size=page_size, n_pages=n_pages,
             speculative=speculative, draft_params=draft_params,
-            draft_k=draft_k,
+            draft_k=draft_k, journal=journal,
         )
         self.tokenizer = tokenizer
         self.whisper = whisper
@@ -156,6 +157,24 @@ class ApiServer:
             def do_GET(self):
                 if self.path == "/health":
                     return self._json(200, {"status": "ok"})
+                if self.path == "/recovered":
+                    # journal-replayed requests from a previous process:
+                    # their original clients died with that process, so
+                    # the results are retrievable here instead of being
+                    # recomputed-and-discarded (decode happens once; the
+                    # operator or a reconciliation job collects them)
+                    out = []
+                    for r in outer.engine.recovered_requests:
+                        out.append({
+                            "rid": r.rid,
+                            "prompt": r.prompt,
+                            "done": r.done,
+                            "finish_reason": r.finish_reason,
+                            "tokens": list(r.out_tokens),
+                            "text": outer._decode_tok(r.out_tokens)
+                            if r.done else None,
+                        })
+                    return self._json(200, {"recovered": out})
                 if self.path == "/info":  # TGI-protocol model info
                     from bigdl_tpu import __version__
 

@@ -90,8 +90,13 @@ class InferenceEngine:
         draft_params=None,
         draft_k: int = 4,
         quantize_kv: bool = False,
+        journal: Optional[str] = None,
     ):
         self.model = model
+        self._journal = None  # attached at the END of __init__ (it
+        # replays the previous process's unfinished tail, which needs
+        # the queue and rid counter live)
+        self.recovered_requests: list[Request] = []
         self.config: ModelConfig = model.config
         self.n_slots = n_slots
         self.max_len = max_len
@@ -282,6 +287,22 @@ class InferenceEngine:
         # handler threads add, the engine thread frees the slot at the
         # top of its next step — no cross-thread _finish races
         self._cancelled: set[int] = set()
+
+        # crash-recovery request journal (serving/journal.py): accepted
+        # requests are appended as JSONL, completions tombstoned.
+        # Attaching to an existing journal AUTO-REPLAYS the previous
+        # process's unfinished tail (into self.recovered_requests) with
+        # the rid counter seeded past every journaled rid — replay-first
+        # is an engine invariant, not a per-caller dance, because a
+        # fresh rid=0 tombstone would otherwise cancel the old pending
+        # rid-0 entry and silently lose it.
+        if journal is not None:
+            from bigdl_tpu.serving.journal import RequestJournal, replay
+
+            entries, max_rid = RequestJournal.scan(journal)
+            self._rid = itertools.count(max_rid + 1)
+            self._journal = RequestJournal(journal)
+            self.recovered_requests = replay(self, entries)
 
     def _with_mesh(self, fn):
         if self._mesh is None:
@@ -565,8 +586,16 @@ class InferenceEngine:
             repetition_penalty=repetition_penalty,
             eos_token_id=eos_token_id,
         )
+        if self._journal is not None:
+            self._journal.record_submit(req)
         self._queue.put(req)
         return req
+
+    def recover(self) -> list:
+        """Requests auto-replayed from this engine's journal at attach
+        (serving-restart story: the process died mid-flight, the
+        replacement engine re-enqueued the journaled tail)."""
+        return self.recovered_requests
 
     def _slot_sampling(self, req: Request) -> tuple[float, int, float, bool]:
         """Resolve a request's sampling params against engine defaults."""
@@ -934,6 +963,8 @@ class InferenceEngine:
         s = self._slots[slot]
         s.req.finish_reason = reason
         s.req.done = True
+        if self._journal is not None:
+            self._journal.record_done(s.req.rid)
         if s.req.stream is not None:
             s.req.stream.put(None)
         self._slots[slot] = _Slot()
@@ -1062,6 +1093,8 @@ class InferenceEngine:
         req.error = msg
         req.finish_reason = "error"
         req.done = True
+        if self._journal is not None:
+            self._journal.record_done(req.rid)
         if req.stream is not None:
             req.stream.put(None)
 

@@ -55,10 +55,12 @@ class FastChatWorker:
         speculative: bool = False,
         draft_k: int = 4,
         heartbeat_s: float = HEARTBEAT_S,
+        journal: Optional[str] = None,  # crash-recovery request journal
     ):
         self.engine = InferenceEngine(
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, speculative=speculative, draft_k=draft_k,
+            journal=journal,
         )
         self.tokenizer = tokenizer
         self.controller_addr = controller_addr

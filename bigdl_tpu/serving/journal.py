@@ -1,0 +1,130 @@
+"""Crash-recovery request journal for the serving engine.
+
+The reference's serving stack restarts through its k8s job specs but
+loses every in-flight request on a worker crash (the FastAPI worker's
+queue and the PPModelWorker batch state are process-local,
+reference serving/fastapi/model_worker.py:28-200). TPU serving gets a
+first-class restart story instead: every accepted request is appended
+to a JSONL journal, completions append a tombstone, and a fresh engine
+replays the unfinished tail with `engine.recover()` — pairing with
+deploy/'s restartPolicy so a killed pod resumes its queue instead of
+dropping it.
+
+Format: one JSON object per line.
+  {"op": "submit", "rid": 7, "prompt": [...], "max_new_tokens": 64, ...}
+  {"op": "done", "rid": 7}
+
+A request is pending iff its last submit has no matching done. Replayed
+requests get NEW rids (the journal is compacted through the normal
+submit path), and streaming consumers are not resurrected — a replayed
+request completes as a plain buffered request.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+# sampling/stop fields that survive a restart (stream deliberately not)
+_REPLAY_FIELDS = (
+    "max_new_tokens", "do_sample", "temperature", "top_k", "top_p",
+    "repetition_penalty", "eos_token_id",
+)
+
+
+class RequestJournal:
+    """Append-only JSONL journal; thread-safe (submit can come from any
+    request thread while the engine thread records completions)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def _append(self, obj: dict) -> None:
+        line = json.dumps(obj, separators=(",", ":"))
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def record_submit(self, req) -> None:
+        entry = {"op": "submit", "rid": req.rid, "prompt": list(req.prompt)}
+        for f in _REPLAY_FIELDS:
+            v = getattr(req, f)
+            if v is not None:
+                entry[f] = v
+        self._append(entry)
+
+    def record_done(self, rid: int) -> None:
+        self._append({"op": "done", "rid": rid})
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+    @staticmethod
+    def scan(path: str) -> tuple[list[dict], int]:
+        """Parse a journal file -> (submit entries with no done marker,
+        in submission order; highest rid seen). Torn trailing lines
+        (crash mid-append) are skipped."""
+        if not os.path.exists(path):
+            return [], -1
+        submits: dict[int, dict] = {}
+        max_rid = -1
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn write at crash point
+                rid = obj.get("rid")
+                if isinstance(rid, int):
+                    max_rid = max(max_rid, rid)
+                if obj.get("op") == "submit":
+                    submits[obj["rid"]] = obj
+                elif obj.get("op") == "done":
+                    submits.pop(obj.get("rid"), None)
+        return list(submits.values()), max_rid
+
+    @staticmethod
+    def pending(path: str) -> list[dict]:
+        return RequestJournal.scan(path)[0]
+
+    @staticmethod
+    def compact(path: str) -> None:
+        """Atomic rewrite keeping only pending submits. Offline
+        maintenance ONLY — the os.replace swaps the inode out from
+        under any live engine's open append handle."""
+        pending = RequestJournal.pending(path)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for e in pending:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+        os.replace(tmp, path)
+
+
+def replay(engine, entries: list[dict]) -> list:
+    """Re-submit unfinished journaled entries into `engine` (fresh
+    rids, no streams), superseding each old entry with a tombstone the
+    moment its replacement submit is recorded. No truncate-first window:
+    a crash mid-replay leaves every not-yet-resubmitted entry pending
+    for the NEXT recovery. The crash window between a replacement's
+    submit record and the old tombstone yields at-least-once semantics
+    (a later recovery may replay that request twice), never loss.
+    Requires the engine's rid counter to be seeded past every journaled
+    rid (the engine does this at journal attach) so old-rid tombstones
+    cannot collide with fresh submissions."""
+    j = getattr(engine, "_journal", None)
+    out = []
+    for e in entries:
+        kwargs = {f: e[f] for f in _REPLAY_FIELDS if f in e}
+        out.append(engine.submit(e["prompt"], **kwargs))
+        if j is not None:
+            j.record_done(e["rid"])  # superseded by the new record
+    return out

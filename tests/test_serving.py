@@ -506,3 +506,49 @@ def test_engine_speculative_mla_family():
         )
     assert eng.spec_rounds > 0
     assert eng.spec_emitted / eng.spec_rounds > 1.0
+
+
+# ---------------------------------------------------------------------------
+# crash-recovery request journal (serving/journal.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_journal_recovery_replays_unfinished(model, tmp_path):
+    """Serving-restart story: a journaled engine dies mid-flight; a
+    replacement engine pointed at the same journal replays exactly the
+    unfinished requests and produces the same greedy tokens the plain
+    generate path yields. Completed requests are tombstoned and must
+    NOT replay."""
+    jpath = str(tmp_path / "requests.jsonl")
+    want = {
+        tuple(p): model.generate([p], max_new_tokens=8)[0].tolist()
+        for p in PROMPTS
+    }
+
+    eng1 = InferenceEngine(model, n_slots=2, max_len=128, journal=jpath)
+    r_done = eng1.submit(PROMPTS[0], max_new_tokens=8)
+    eng1.run_until_idle(max_steps=200)  # completes + tombstones request 0
+    assert r_done.done
+    # two more accepted, then the process "dies" before serving them
+    eng1.submit(PROMPTS[1], max_new_tokens=8)
+    eng1.submit(PROMPTS[2], max_new_tokens=8, temperature=None)
+    # torn trailing line (crash mid-append) must not break recovery
+    with open(jpath, "a") as f:
+        f.write('{"op": "sub')
+
+    eng2 = InferenceEngine(model, n_slots=2, max_len=128, journal=jpath)
+    replayed = eng2.recovered_requests  # auto-replayed at attach
+    assert [r.prompt for r in replayed] == [PROMPTS[1], PROMPTS[2]]
+    # rid counter seeded past every journaled rid: a fresh submit must
+    # not collide with (and tombstone) an old journal entry
+    old_rids = {r.rid for r in [r_done]} | {1, 2}
+    assert all(r.rid not in old_rids for r in replayed)
+    eng2.run_until_idle(max_steps=200)
+    for p, r in zip(PROMPTS[1:], replayed):
+        assert r.done and r.finish_reason != "error"
+        assert r.out_tokens == want[tuple(p)]
+
+    # the replayed generation re-journaled and tombstoned: a third
+    # engine finds nothing to replay
+    eng3 = InferenceEngine(model, n_slots=2, max_len=128, journal=jpath)
+    assert eng3.recovered_requests == []
