@@ -591,12 +591,6 @@ class InferenceEngine:
         self._queue.put(req)
         return req
 
-    def recover(self) -> list:
-        """Requests auto-replayed from this engine's journal at attach
-        (serving-restart story: the process died mid-flight, the
-        replacement engine re-enqueued the journaled tail)."""
-        return self.recovered_requests
-
     def _slot_sampling(self, req: Request) -> tuple[float, int, float, bool]:
         """Resolve a request's sampling params against engine defaults."""
         g = self.gen

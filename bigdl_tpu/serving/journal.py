@@ -6,7 +6,7 @@ queue and the PPModelWorker batch state are process-local,
 reference serving/fastapi/model_worker.py:28-200). TPU serving gets a
 first-class restart story instead: every accepted request is appended
 to a JSONL journal, completions append a tombstone, and a fresh engine
-replays the unfinished tail with `engine.recover()` — pairing with
+replays the unfinished tail into `engine.recovered_requests` — pairing with
 deploy/'s restartPolicy so a killed pod resumes its queue instead of
 dropping it.
 
@@ -15,9 +15,10 @@ Format: one JSON object per line.
   {"op": "done", "rid": 7}
 
 A request is pending iff its last submit has no matching done. Replayed
-requests get NEW rids (the journal is compacted through the normal
-submit path), and streaming consumers are not resurrected — a replayed
-request completes as a plain buffered request.
+requests get NEW rids (each old entry is superseded by a tombstone once
+its replacement is recorded), and streaming consumers are not
+resurrected — a replayed request completes as a plain buffered request
+retrievable via the API server's GET /recovered.
 """
 
 from __future__ import annotations
@@ -84,12 +85,15 @@ class RequestJournal:
                 except json.JSONDecodeError:
                     continue  # torn write at crash point
                 rid = obj.get("rid")
-                if isinstance(rid, int):
-                    max_rid = max(max_rid, rid)
-                if obj.get("op") == "submit":
-                    submits[obj["rid"]] = obj
+                if not isinstance(rid, int):
+                    continue  # malformed entry must not block recovery
+                max_rid = max(max_rid, rid)
+                if obj.get("op") == "submit" and isinstance(
+                    obj.get("prompt"), list
+                ):
+                    submits[rid] = obj
                 elif obj.get("op") == "done":
-                    submits.pop(obj.get("rid"), None)
+                    submits.pop(rid, None)
         return list(submits.values()), max_rid
 
     @staticmethod

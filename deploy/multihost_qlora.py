@@ -99,7 +99,7 @@ def main(argv=None) -> int:
     from bigdl_tpu.parallel.sharding import (
         expand_specs_for_params, lora_specs, param_specs, shard_params,
     )
-    from bigdl_tpu.train import init_lora, make_train_step
+    from bigdl_tpu.train import init_lora, make_train_step, watchdog
     from bigdl_tpu.train.checkpoint import load_train_state, save_train_state
 
     pid, nproc = jax.process_index(), jax.process_count()
@@ -172,6 +172,11 @@ def main(argv=None) -> int:
     data_sharding = NamedSharding(mesh, P("dp", None))
 
     t0 = time.time()
+    # hung-step detection: a lost peer blocks every other host inside a
+    # collective with no exception; the watchdog converts that into
+    # exit 42 so the job restarts and resumes from the atomic
+    # checkpoint (BIGDL_TPU_WATCHDOG_S, set in the k8s job spec)
+    wd = watchdog.from_env()
     for step in range(start_step, args.steps):
         batch = next_local_batch()
         tokens = jax.make_array_from_process_local_data(
@@ -190,9 +195,19 @@ def main(argv=None) -> int:
             dt = time.time() - t0
             print(f"[qlora] step {step}: loss {float(loss):.4f} "
                   f"({dt:.1f}s)", flush=True)
+        if wd is not None:
+            # beat every step: dispatch is async, but the in-flight
+            # program queue is shallow, so a hung collective stalls the
+            # step call itself within a few iterations; sync only every
+            # 10th beat to keep per-step overhead off the hot path
+            if step % 10 == 0:
+                jax.block_until_ready(loss)
+            wd.beat(step)
         if pid == 0 and args.save_every and (step + 1) % args.save_every == 0:
             save_train_state(ckpt_path, lora=lora, opt_state=opt_state,
                              step=step + 1, rng=rng)
+    if wd is not None:
+        wd.stop()  # the final save below must not race the timeout
     if pid == 0:
         save_train_state(ckpt_path, lora=lora, opt_state=opt_state,
                          step=args.steps, rng=rng)

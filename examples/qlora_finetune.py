@@ -14,7 +14,7 @@ import optax
 
 from bigdl_tpu.models import llama
 from bigdl_tpu.models.config import PRESETS
-from bigdl_tpu.train import init_lora, make_train_step
+from bigdl_tpu.train import init_lora, make_train_step, watchdog
 
 
 def main():
@@ -38,6 +38,10 @@ def main():
 
     rng = np.random.default_rng(0)
     B, T = 2, 64
+    # hung-step watchdog (train/watchdog.py): on a multi-host job a
+    # lost peer blocks collectives forever; BIGDL_TPU_WATCHDOG_S turns
+    # that into an exit the orchestrator restarts from checkpoint
+    wd = watchdog.from_env()
     for i in range(5):
         tokens = jnp.asarray(
             rng.integers(1, config.vocab_size, (B, T + 1)), jnp.int32
@@ -45,6 +49,10 @@ def main():
         mask = jnp.ones((B, T + 1), jnp.float32)
         lora, opt_state, loss = step(params, lora, opt_state, tokens, mask)
         print(f"step {i}: loss {float(loss):.4f}")
+        if wd is not None:
+            wd.beat(i)  # loss was fetched: the step really finished
+    if wd is not None:
+        wd.stop()
 
 
 if __name__ == "__main__":

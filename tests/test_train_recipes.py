@@ -285,3 +285,55 @@ def test_checkpoint_typed_prng_key_and_dtype_gate(rng, qbase, tmp_path):
     bad = jax.tree.map(lambda a: a.astype(jnp.float16), lora)
     with pytest.raises(ValueError, match="dtype"):
         load_train_state(path, like_lora=bad, like_opt_state=opt)
+
+
+# ---------------------------------------------------------------------------
+# hung-step watchdog (train/watchdog.py): failure DETECTION half of the
+# recovery story (checkpoint/resume above is the state half)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_on_silence_and_not_on_beats():
+    import time as _t
+
+    from bigdl_tpu.train.watchdog import StepWatchdog
+
+    fired = []
+    # wide margins (2s timeout vs 0.2s beats): a CI scheduler stall must
+    # not fire the watchdog during the must-stay-quiet phase
+    wd = StepWatchdog(timeout_s=2.0, check_interval_s=0.1,
+                      on_timeout=lambda idle: fired.append(idle))
+    for i in range(6):  # beats far faster than the timeout
+        _t.sleep(0.2)
+        wd.beat(i)
+    assert not fired
+    _t.sleep(3.0)  # silence past the timeout: must fire exactly once
+    assert len(fired) == 1 and fired[0] > 2.0
+    wd.stop()
+
+    wd2 = StepWatchdog(timeout_s=0.5, check_interval_s=0.1,
+                       on_timeout=lambda idle: fired.append(idle))
+    wd2.stop()  # stopped before the timeout: never fires
+    _t.sleep(1.0)
+    assert len(fired) == 1
+
+
+def test_watchdog_hard_exits_blocked_process():
+    """The real exit path: a subprocess whose 'training step' blocks
+    forever must die with the watchdog's exit code 42 — os._exit works
+    even though the main thread never returns to Python."""
+    import subprocess
+    import sys as _sys
+
+    from bigdl_tpu.train.watchdog import StepWatchdog
+
+    code = (
+        "import time\n"
+        "from bigdl_tpu.train.watchdog import StepWatchdog\n"
+        "wd = StepWatchdog(timeout_s=0.5, check_interval_s=0.1)\n"
+        "time.sleep(60)  # a blocked collective never returns\n"
+    )
+    proc = subprocess.run(
+        [_sys.executable, "-c", code], timeout=30, capture_output=True,
+    )
+    assert proc.returncode == StepWatchdog.EXIT_CODE, proc.stderr[-300:]
+    assert b"watchdog" in proc.stderr
