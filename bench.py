@@ -288,7 +288,6 @@ def child_kernels() -> dict:
     import jax.numpy as jnp
 
     from bigdl_tpu.ops.linear import _use_qgemv, linear
-    from bigdl_tpu.quant import quantize
 
     matrix: dict[str, dict] = {}
 
@@ -321,7 +320,6 @@ def child_kernels() -> dict:
     # --- fused dequant-GEMV, every qtype the dispatcher routes to Pallas,
     # at the hardest real shape: llama3-8b down-proj K=14336 (the VMEM-
     # budget case), plus the hidden-size K=4096 for the headline format.
-    key = jax.random.PRNGKey(0)
     x_cache: dict[int, jax.Array] = {}
 
     def gemv_smoke(qtype: str, O: int, K: int):

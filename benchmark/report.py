@@ -1,0 +1,128 @@
+"""CSV -> HTML benchmark report.
+
+Counterpart of the reference's reporting step
+(test/benchmark/csv_to_html.py, wired after the all-in-one runner in
+its nightly workflows): renders `benchmark/run.py`'s CSV as a sortable
+standalone HTML table, optionally highlighting regressions against a
+previous CSV.
+
+    python benchmark/report.py bench_results.csv [-o report.html]
+        [--baseline previous.csv] [--threshold 5.0]
+
+A cell turns red when its `rest_cost_mean_ms` regressed more than
+`--threshold` percent vs the baseline row with the same
+(model, api, in_out, batch) key, green when it improved by more.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import html
+import sys
+
+_KEY = ("model", "api", "in_out", "batch")
+_METRIC = "rest_cost_mean_ms"
+
+_STYLE = """
+body { font-family: system-ui, sans-serif; margin: 2rem; }
+table { border-collapse: collapse; }
+th, td { border: 1px solid #ccc; padding: 0.35rem 0.7rem; text-align: right; }
+th { background: #f0f0f3; cursor: pointer; }
+td:first-child, th:first-child { text-align: left; }
+tr:nth-child(even) { background: #fafafa; }
+.regress { background: #ffd9d9 !important; }
+.improve { background: #d9f5d9 !important; }
+caption { margin-bottom: 0.8rem; font-size: 1.1rem; text-align: left; }
+"""
+
+_SORT_JS = """
+document.querySelectorAll('th').forEach((th, i) => th.onclick = () => {
+  const tb = th.closest('table').tBodies[0];
+  const rows = [...tb.rows];
+  const num = rows.every(r => r.cells[i] &&
+      !isNaN(parseFloat(r.cells[i].textContent)));
+  const dir = th.dataset.dir = th.dataset.dir === 'a' ? 'd' : 'a';
+  rows.sort((a, b) => {
+    const x = a.cells[i].textContent, y = b.cells[i].textContent;
+    const c = num ? parseFloat(x) - parseFloat(y) : x.localeCompare(y);
+    return dir === 'a' ? c : -c;
+  });
+  rows.forEach(r => tb.appendChild(r));
+});
+"""
+
+
+def load(path: str) -> list[dict]:
+    with open(path, newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def render(rows: list[dict], baseline: list[dict] | None,
+           threshold: float, title: str) -> str:
+    base = {}
+    for r in baseline or []:
+        base[tuple(r.get(k, "") for k in _KEY)] = r
+
+    fields: list[str] = []
+    for r in rows:
+        for k in r:
+            if k not in fields:
+                fields.append(k)
+
+    out = ["<!doctype html><meta charset='utf-8'>",
+           f"<title>{html.escape(title)}</title>",
+           f"<style>{_STYLE}</style><table>",
+           f"<caption>{html.escape(title)}</caption><thead><tr>"]
+    out += [f"<th>{html.escape(f)}</th>" for f in fields]
+    out.append("</tr></thead><tbody>")
+    for r in rows:
+        prev = base.get(tuple(r.get(k, "") for k in _KEY))
+        out.append("<tr>")
+        for f in fields:
+            v = r.get(f, "")
+            cls = ""
+            if f == _METRIC and prev and prev.get(f) and v:
+                try:
+                    delta = (float(v) - float(prev[f])) / float(prev[f]) * 100
+                    if delta > threshold:
+                        cls = " class='regress'"
+                        v = f"{v} (+{delta:.1f}%)"
+                    elif delta < -threshold:
+                        cls = " class='improve'"
+                        v = f"{v} ({delta:.1f}%)"
+                except ValueError:
+                    pass
+            out.append(f"<td{cls}>{html.escape(str(v))}</td>")
+        out.append("</tr>")
+    out.append(f"</tbody></table><script>{_SORT_JS}</script>")
+    return "".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("csv", help="bench_results.csv from benchmark/run.py")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output HTML path (default: <csv>.html)")
+    ap.add_argument("--baseline", default=None,
+                    help="previous CSV to diff against")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    help="percent change that flags a cell (default 5)")
+    args = ap.parse_args(argv)
+
+    rows = load(args.csv)
+    if not rows:
+        print(f"{args.csv}: no rows", file=sys.stderr)
+        return 1
+    baseline = load(args.baseline) if args.baseline else None
+    out = args.output or args.csv.rsplit(".", 1)[0] + ".html"
+    doc = render(rows, baseline, args.threshold,
+                 title=f"bigdl-tpu benchmark — {args.csv}")
+    with open(out, "w", encoding="utf-8") as f:
+        f.write(doc)
+    print(f"wrote {out} ({len(rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,3 +101,34 @@ def test_run_case_new_modes(qmodel):
     tp_model = shard_for_api(qmodel, "tensor_parallel", tp=2)
     r = run_case(tp_model, "tensor_parallel", in_len=8, out_len=4, batch=1)
     assert r["rest_cost_mean_ms"] > 0
+
+
+def test_benchmark_html_report(tmp_path):
+    """CSV -> HTML report (benchmark/report.py, the reference's
+    csv_to_html step): renders rows, flags regressions vs a baseline."""
+    import csv as _csv
+
+    from benchmark.report import main as report_main
+
+    cur, prev = tmp_path / "cur.csv", tmp_path / "prev.csv"
+    rows_prev = [
+        {"model": "m", "api": "transformer_int4", "in_out": "32-32",
+         "batch": "1", "rest_cost_mean_ms": "10.0"},
+        {"model": "m", "api": "fp8_kv", "in_out": "32-32",
+         "batch": "1", "rest_cost_mean_ms": "12.0"},
+    ]
+    rows_cur = [dict(rows_prev[0], rest_cost_mean_ms="11.5"),  # +15% regress
+                dict(rows_prev[1], rest_cost_mean_ms="9.0")]   # -25% improve
+    for path, rows in ((cur, rows_cur), (prev, rows_prev)):
+        with open(path, "w", newline="") as f:
+            w = _csv.DictWriter(f, fieldnames=list(rows[0]))
+            w.writeheader()
+            w.writerows(rows)
+
+    out = tmp_path / "r.html"
+    assert report_main([str(cur), "-o", str(out),
+                        "--baseline", str(prev)]) == 0
+    doc = out.read_text()
+    assert "regress" in doc and "+15.0%" in doc
+    assert "improve" in doc and "-25.0%" in doc
+    assert doc.count("<tr>") == 3  # header + one row per case
