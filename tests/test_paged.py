@@ -395,3 +395,22 @@ def test_subpage_sharing_source_page_protected_from_eviction(model):
     d2 = dense.submit(p2, max_new_tokens=4)
     dense.run_until_idle()
     assert r2.out_tokens == d2.out_tokens
+
+
+def test_speculative_paged_fp8_composes(model):
+    """The triple combination — speculative verify over fp8-quantized
+    paged KV — matches non-speculative fp8-paged serving exactly for
+    greedy rows (identical pool quantization, identical acceptance
+    math), and speculation genuinely fires."""
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8]]
+    ref = _run(InferenceEngine(model, n_slots=2, max_len=128, paged=True,
+                               page_size=16, quantize_kv=True),
+               prompts, maxnt=10)
+    eng = InferenceEngine(
+        model, n_slots=2, max_len=128, paged=True, page_size=16,
+        quantize_kv=True, speculative=True, draft_params=model.params,
+        draft_k=4,
+    )
+    out = _run(eng, prompts, maxnt=10)
+    assert out == ref
+    assert eng.spec_rounds > 0 and eng.spec_emitted / eng.spec_rounds > 1.0
