@@ -1012,6 +1012,7 @@ _FAMILY_LAYER = {
     "cohere": _cohere_layer,
     "yuan": _yuan_layer,
     "minicpmv": _minicpmv_layer,
+    "minicpmo": _minicpmv_layer,  # same llm. prefix, qwen2 layout
     "internvl": _internvl_layer,
     "janus": _janus_layer,
     "qwen": _qwen_layer,
@@ -1038,6 +1039,7 @@ _FAMILY_TOP = {
     "gemma3": _gemma3_top,
     "gemma3_text": _gemma3_top,
     "minicpmv": _minicpmv_top,
+    "minicpmo": _minicpmv_top,  # same llm. prefix
     "internvl": _internvl_top,
     "janus": _janus_top,
     "qwen": _qwen_top,

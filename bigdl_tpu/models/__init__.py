@@ -70,6 +70,12 @@ from bigdl_tpu.models import minicpmv  # noqa: E402  (delegates text to llama)
 
 _FAMILIES["minicpmv"] = minicpmv
 
+from bigdl_tpu.models import minicpmo  # noqa: E402  (adds whisper-apm audio)
+
+# MiniCPM-o 2.6: minicpmv's vision path + a Whisper-encoder audio tower
+# projected into the qwen2-shaped LLM (models/minicpmo.py)
+_FAMILIES["minicpmo"] = minicpmo
+
 from bigdl_tpu.models import mllama  # noqa: E402  (cross-attn decoder)
 
 _FAMILIES["mllama"] = mllama

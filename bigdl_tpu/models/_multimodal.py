@@ -1,9 +1,11 @@
-"""Shared multimodal glue: scatter projected image features over
-placeholder tokens — used by minicpmv, internvl, and janus (qwen2_vl
-needs its own path: its features are globally concatenated across
-images, not per-row)."""
+"""Shared multimodal glue: scatter projected image/audio features over
+placeholder tokens — used by minicpmv, internvl, janus, and minicpmo
+(qwen2_vl needs its own path: its features are globally concatenated
+across images, not per-row)."""
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -12,32 +14,69 @@ from bigdl_tpu.models import llama
 from bigdl_tpu.models.config import ModelConfig
 
 
-def scatter_image_features(
-    config: ModelConfig,
-    params: dict,
+def scatter_features(
+    h: jnp.ndarray,  # [B, T, E] token embeddings (already computed)
     input_ids: np.ndarray,  # [B, T]
-    img: jnp.ndarray,  # [B, Q, E] per-row projected image features
+    feats: jnp.ndarray,  # [B, Q, E] per-row projected features
+    token_id: int,
     compute_dtype,
     allow_text_rows: bool = True,
+    what: str = "image",
 ) -> jnp.ndarray:
-    """Token embeddings with row b's Q features scattered over its
-    image_token_id placeholders (per-row indexing — a global cumsum
-    would misassign in mixed batches). Rows must carry exactly Q
-    placeholders (or zero, when allow_text_rows — their patches are
-    ignored); anything else raises like HF's masked_scatter path."""
-    h = llama.embed_tokens(config, params, jnp.asarray(input_ids), compute_dtype)
-    mask = jnp.asarray(input_ids == config.image_token_id)
+    """Replace row b's `token_id` placeholder embeddings with its Q
+    features (per-row indexing — a global cumsum would misassign in
+    mixed batches). Rows must carry exactly Q placeholders (or zero,
+    when allow_text_rows — their features are ignored); anything else
+    raises like HF's masked_scatter path."""
+    mask = jnp.asarray(input_ids == token_id)
     B = input_ids.shape[0]
-    Q = img.shape[1]
-    counts = np.asarray(input_ids == config.image_token_id).sum(axis=1)
+    Q = feats.shape[1]
+    counts = np.asarray(input_ids == token_id).sum(axis=1)
     ok = (counts == Q) | ((counts == 0) if allow_text_rows else False)
     if not np.all(ok):
         raise ValueError(
-            f"image placeholder count per row {counts.tolist()} must be "
+            f"{what} placeholder count per row {counts.tolist()} must be "
             f"{'0 or ' if allow_text_rows else ''}exactly {Q} "
             "(the projected feature count)"
         )
     row_cum = jnp.cumsum(mask, axis=1) - 1
     idx = jnp.arange(B)[:, None] * Q + jnp.clip(row_cum, 0, Q - 1)
-    flat = img.reshape(-1, img.shape[-1])
+    flat = feats.reshape(-1, feats.shape[-1])
     return jnp.where(mask[..., None], flat[idx].astype(compute_dtype), h)
+
+
+def scatter_image_features(
+    config: ModelConfig,
+    params: dict,
+    input_ids: np.ndarray,  # [B, T]
+    img: Optional[jnp.ndarray],  # [B, Q, E] per-row projected image features
+    compute_dtype,
+    allow_text_rows: bool = True,
+    audio: Optional[jnp.ndarray] = None,  # [B, Qa, E] audio features
+) -> jnp.ndarray:
+    """Token embeddings with image (and optionally audio) features
+    scattered over their placeholder ids."""
+    h = llama.embed_tokens(config, params, jnp.asarray(input_ids), compute_dtype)
+    if (
+        img is not None
+        and audio is not None
+        and config.image_token_id == config.audio_token_id
+    ):
+        raise ValueError(
+            f"image_token_id == audio_token_id == {config.image_token_id}: "
+            "set distinct placeholder ids (from the tokenizer) before "
+            "passing both modalities"
+        )
+    if img is not None:
+        h = scatter_features(
+            h, input_ids, img, config.image_token_id, compute_dtype,
+            allow_text_rows, what="image",
+        )
+    if audio is not None:
+        if config.audio_token_id is None:
+            raise ValueError("audio features given but audio_token_id unset")
+        h = scatter_features(
+            h, input_ids, audio, config.audio_token_id, compute_dtype,
+            allow_text_rows, what="audio",
+        )
+    return h

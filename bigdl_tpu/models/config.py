@@ -118,6 +118,8 @@ class ModelConfig:
     image_token_id: Optional[int] = None
     video_token_id: Optional[int] = None
     vision_start_token_id: Optional[int] = None
+    audio_token_id: Optional[int] = None  # minicpmo audio placeholders
+    audio_pool_step: Optional[int] = None  # minicpmo post-projection pool
 
     def __post_init__(self):
         if self.moe_dispatch not in (None, "dense", "ragged"):
@@ -709,6 +711,23 @@ def _hf_minicpmv(hf, kw):
     kw.setdefault("image_token_id", hf.get("image_token_id", 0))
 
 
+def _hf_minicpmo(hf, kw):
+    """MiniCPM-o 2.6 (reference convert.py:1030-1041, 1963-1983): the
+    LLM half is qwen2-shaped at the top level of config.json; vision
+    (SigLIP + resampler) and audio (Whisper encoder + projection)
+    configs are consumed separately by models/minicpmo.py."""
+    kw.setdefault("attention_bias", True)  # qwen2 qkv bias
+    kw.setdefault("image_token_id", hf.get("image_token_id", 0))
+    # no silent default: the published config carries no audio_token_id,
+    # and defaulting it to 0 would collide with the image placeholder —
+    # callers set it from their tokenizer (models/minicpmo.py docstring)
+    if "audio_token_id" in hf:
+        kw.setdefault("audio_token_id", hf["audio_token_id"])
+    # default (2) lives in one place: models/minicpmo.DEFAULT_AUDIO_POOL_STEP
+    if "audio_pool_step" in hf:
+        kw.setdefault("audio_pool_step", hf["audio_pool_step"])
+
+
 def _hf_yuan(hf, kw):
     """Yuan-2 (reference models/yuan.py; original schema in
     gguf/models/model_implement/yuan2/configuration_yuan.py): llama
@@ -813,6 +832,7 @@ _HF_BUILDERS = {
     "falcon": _hf_falcon,
     "yuan": _hf_yuan,
     "minicpmv": _hf_minicpmv,
+    "minicpmo": _hf_minicpmo,
     "mllama": _hf_mllama,
     "mllama_text_model": _hf_mllama,
     "deepseek_v2": _hf_deepseek_v2,

@@ -1,0 +1,142 @@
+"""MiniCPM-o 2.6: MiniCPM-V's SigLIP tower + resampler, plus a
+Whisper-encoder audio tower ("apm") projected into the qwen2-shaped LLM.
+
+Reference support lives in convert.py:1030-1041 (_optimize_pre: vpm
+merge_qkv, tts optimized as its own model, llm treated as qwen2) and
+convert.py:1963-1983 (_optimize_post: patches the vpm's SiglipAttention
+and the apm's WhisperSdpaAttention); the modeling itself is OpenBMB
+remote code. The audio path follows the published MiniCPM-o
+architecture:
+
+    apm (Whisper encoder over mel chunks)
+      -> audio_projection_layer (linear -> relu -> linear, apm hidden ->
+         LLM hidden)
+      -> AvgPool1d(audio_pool_step) over time
+      -> scattered over the prompt's audio placeholder tokens
+
+The vision path is identical to minicpmv (vpm + resampler, re-exported
+below). Only the LLM quantizes; towers stay dense, as the reference
+does for multimodal families. The TTS head is out of scope — it is a
+separate generation model the reference merely re-optimizes, not part
+of the language-understanding path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import llama, whisper
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.models.minicpmv import (  # noqa: F401 — re-exported vision path
+    ResamplerConfig,
+    SiglipConfig,
+    resampler_forward,
+    resampler_params_from_state_dict,
+    siglip_forward,
+    vision_params_from_state_dict,
+)
+from bigdl_tpu.models.whisper import WhisperConfig
+
+# the text side delegates wholesale to the llama family (qwen2-shaped)
+init_params = llama.init_params
+quantize_params = llama.quantize_params
+forward = llama.forward
+merge_fused_params = llama.merge_fused_params
+unmerge_fused_params = llama.unmerge_fused_params
+
+DEFAULT_AUDIO_POOL_STEP = 2
+
+
+def apm_params_from_state_dict(wcfg: WhisperConfig, get, prefix: str = "apm.") -> dict:
+    """Translate the checkpoint's WhisperEncoder weights (stored directly
+    under `apm.` — conv1/conv2, embed_positions, layers.N.*, layer_norm)
+    into the encoder subset of models/whisper.py's param tree, so
+    whisper.encode runs the tower unchanged. Delegates to the shared
+    translator (whisper.encoder_params_from_state_dict); the tower stays
+    dense, like the vision path."""
+    return whisper.encoder_params_from_state_dict(wcfg, get, prefix)
+
+
+def audio_proj_params_from_state_dict(
+    get, prefix: str = "audio_projection_layer.",
+) -> dict:
+    """MultiModalProjector: linear1 -> relu -> linear2."""
+
+    def g(name):
+        return jnp.asarray(np.asarray(get(prefix + name), np.float32))
+
+    return {
+        "w1": g("linear1.weight"), "b1": g("linear1.bias"),
+        "w2": g("linear2.weight"), "b2": g("linear2.bias"),
+    }
+
+
+def audio_embed(
+    wcfg: WhisperConfig,
+    aparams: dict,
+    pparams: dict,
+    mel: jax.Array,  # [B, n_mels, T_audio]
+    pool_step: int = DEFAULT_AUDIO_POOL_STEP,
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """mel -> [B, floor(T_audio/2/pool_step), E_llm] audio embeddings:
+    Whisper encoder, MultiModalProjector, then non-overlapping mean pool
+    over time (AvgPool1d(pool_step, stride=pool_step) semantics — a
+    trailing partial window is dropped)."""
+    enc = whisper.encode(wcfg, aparams, mel)  # [B, S, H]
+    x = jnp.einsum("bsh,eh->bse", enc.astype(jnp.float32), pparams["w1"])
+    x = jax.nn.relu(x + pparams["b1"])
+    x = jnp.einsum("bse,fe->bsf", x, pparams["w2"]) + pparams["b2"]
+    B, S, E = x.shape
+    S_out = S // pool_step
+    x = x[:, : S_out * pool_step].reshape(B, S_out, pool_step, E).mean(axis=2)
+    return x.astype(out_dtype)
+
+
+def multimodal_prefill(
+    config: ModelConfig,
+    params: dict,
+    input_ids: np.ndarray,  # [B, T] with image/audio placeholder ids
+    cache,
+    vcfg: Optional[SiglipConfig] = None,
+    rcfg: Optional[ResamplerConfig] = None,
+    vparams: Optional[dict] = None,
+    rparams: Optional[dict] = None,
+    patches: Optional[jax.Array] = None,  # [B, N, patch_dim]
+    tgt_size: Optional[tuple] = None,
+    wcfg: Optional[WhisperConfig] = None,
+    aparams: Optional[dict] = None,
+    pparams: Optional[dict] = None,
+    mel: Optional[jax.Array] = None,  # [B, n_mels, T_audio]
+    pool_step: Optional[int] = None,  # default: config.audio_pool_step
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = True,
+):
+    """Vision and/or audio towers -> scatter over placeholders ->
+    standard 1-D-rope prefill (the minicpm-o LLM uses plain rope)."""
+    from bigdl_tpu.models._multimodal import scatter_image_features
+
+    img = None
+    if patches is not None:
+        feats = siglip_forward(vcfg, vparams, patches)
+        img = resampler_forward(rcfg, rparams, feats, tgt_size)
+    audio = None
+    if mel is not None:
+        if pool_step is None:
+            pool_step = (
+                config.audio_pool_step
+                if config.audio_pool_step is not None
+                else DEFAULT_AUDIO_POOL_STEP
+            )
+        audio = audio_embed(wcfg, aparams, pparams, mel, pool_step)
+    h = scatter_image_features(
+        config, params, input_ids, img, compute_dtype, audio=audio,
+    )
+    return llama.forward(
+        config, params, h, cache, mode="prefill", input_is_hidden=True,
+        compute_dtype=compute_dtype, last_logits_only=last_logits_only,
+    )

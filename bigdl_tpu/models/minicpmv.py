@@ -250,13 +250,13 @@ def multimodal_prefill(
 ):
     """Vision tower -> resampler -> scatter the query embeddings over the
     placeholder tokens -> standard 1-D-rope prefill (minicpm-v's LLM uses
-    plain rope — no M-RoPE)."""
-    from bigdl_tpu.models._multimodal import scatter_image_features
+    plain rope — no M-RoPE). Shares the tower/scatter/prefill glue with
+    minicpm-o (the image-only case of minicpmo.multimodal_prefill)."""
+    from bigdl_tpu.models import minicpmo  # lazy: minicpmo imports us
 
-    feats = siglip_forward(vcfg, vparams, patches)
-    img = resampler_forward(rcfg, rparams, feats, tgt_size)  # [B, Q, E]
-    h = scatter_image_features(config, params, input_ids, img, compute_dtype)
-    return llama.forward(
-        config, params, h, cache, mode="prefill", input_is_hidden=True,
+    return minicpmo.multimodal_prefill(
+        config, params, input_ids, cache,
+        vcfg=vcfg, rcfg=rcfg, vparams=vparams, rparams=rparams,
+        patches=patches, tgt_size=tgt_size,
         compute_dtype=compute_dtype, last_logits_only=last_logits_only,
     )

@@ -198,6 +198,69 @@ def init_params(config: WhisperConfig, key: jax.Array, dtype=jnp.float32,
     }
 
 
+def _attn_names(p, pre, q, d):
+    return {
+        f"{pre}wq": [p + "q_proj.weight", q],
+        f"{pre}bq": [p + "q_proj.bias", d],
+        f"{pre}wk": [p + "k_proj.weight", q],  # k_proj: no bias in HF
+        f"{pre}wv": [p + "v_proj.weight", q],
+        f"{pre}bv": [p + "v_proj.bias", d],
+        f"{pre}wo": [p + "out_proj.weight", q],
+        f"{pre}bo": [p + "out_proj.bias", d],
+    }
+
+
+def _stack_layers(per: list[dict]) -> dict:
+    out = {}
+    for k in per[0]:
+        vals = [layer[k] for layer in per]
+        if isinstance(vals[0], QTensor):
+            from bigdl_tpu.quant.qtensor import map_arrays_multi
+
+            out[k] = map_arrays_multi(vals, jnp.stack)
+        else:
+            out[k] = jnp.stack(vals)
+    return out
+
+
+def encoder_params_from_state_dict(
+    config: WhisperConfig, get, prefix: str = "model.encoder.",
+    q=None, d=None,
+) -> Params:
+    """Translate a transformers WhisperEncoder state dict (accessor
+    `get(name) -> np.ndarray`, names relative to `prefix`) into the
+    encoder subset of this module's param tree, runnable by `encode`.
+    Used by `params_from_hf` and by MiniCPM-o's apm tower
+    (models/minicpmo.py), whose checkpoint stores a bare WhisperEncoder
+    under `apm.`. `q`/`d` transform linear / non-linear weights
+    (default: dense float32)."""
+    q = q or (lambda arr: jnp.asarray(arr, jnp.float32))
+    d = d or (lambda arr: jnp.asarray(arr, jnp.float32))
+    per = []
+    for i in range(config.encoder_layers):
+        p = f"{prefix}layers.{i}."
+        m = {
+            "ln1_w": [p + "self_attn_layer_norm.weight", d],
+            "ln1_b": [p + "self_attn_layer_norm.bias", d],
+            **_attn_names(p + "self_attn.", "", q, d),
+            "ln2_w": [p + "final_layer_norm.weight", d],
+            "ln2_b": [p + "final_layer_norm.bias", d],
+            "fc1": [p + "fc1.weight", q], "b1": [p + "fc1.bias", d],
+            "fc2": [p + "fc2.weight", q], "b2": [p + "fc2.bias", d],
+        }
+        per.append({k: fn(get(name)) for k, (name, fn) in m.items()})
+    return {
+        "conv1_w": d(get(prefix + "conv1.weight")),
+        "conv1_b": d(get(prefix + "conv1.bias")),
+        "conv2_w": d(get(prefix + "conv2.weight")),
+        "conv2_b": d(get(prefix + "conv2.bias")),
+        "enc_pos": d(get(prefix + "embed_positions.weight")),
+        "enc": _stack_layers(per),
+        "enc_ln_w": d(get(prefix + "layer_norm.weight")),
+        "enc_ln_b": d(get(prefix + "layer_norm.bias")),
+    }
+
+
 def params_from_hf(config: WhisperConfig, get, qtype: str = "bf16",
                    dtype=jnp.float32) -> Params:
     """Translate a transformers WhisperForConditionalGeneration state dict
@@ -213,60 +276,28 @@ def params_from_hf(config: WhisperConfig, get, qtype: str = "bf16",
     def d(arr):
         return jnp.asarray(arr, dtype)
 
-    def attn(p, pre):
-        return {
-            f"{pre}wq": [p + "q_proj.weight", q],
-            f"{pre}bq": [p + "q_proj.bias", d],
-            f"{pre}wk": [p + "k_proj.weight", q],  # k_proj: no bias in HF
-            f"{pre}wv": [p + "v_proj.weight", q],
-            f"{pre}bv": [p + "v_proj.bias", d],
-            f"{pre}wo": [p + "out_proj.weight", q],
-            f"{pre}bo": [p + "out_proj.bias", d],
+    dec_per = []
+    for i in range(config.decoder_layers):
+        p = f"model.decoder.layers.{i}."
+        m = {
+            "ln1_w": [p + "self_attn_layer_norm.weight", d],
+            "ln1_b": [p + "self_attn_layer_norm.bias", d],
+            **_attn_names(p + "self_attn.", "", q, d),
+            "ln2_w": [p + "final_layer_norm.weight", d],
+            "ln2_b": [p + "final_layer_norm.bias", d],
+            "fc1": [p + "fc1.weight", q], "b1": [p + "fc1.bias", d],
+            "fc2": [p + "fc2.weight", q], "b2": [p + "fc2.bias", d],
+            "lnx_w": [p + "encoder_attn_layer_norm.weight", d],
+            "lnx_b": [p + "encoder_attn_layer_norm.bias", d],
+            **_attn_names(p + "encoder_attn.", "x", q, d),
         }
-
-    def stack(side: str, n: int) -> dict:
-        per = []
-        for i in range(n):
-            p = f"model.{side}.layers.{i}."
-            m = {
-                "ln1_w": [p + "self_attn_layer_norm.weight", d],
-                "ln1_b": [p + "self_attn_layer_norm.bias", d],
-                **attn(p + "self_attn.", ""),
-                "ln2_w": [p + "final_layer_norm.weight", d],
-                "ln2_b": [p + "final_layer_norm.bias", d],
-                "fc1": [p + "fc1.weight", q], "b1": [p + "fc1.bias", d],
-                "fc2": [p + "fc2.weight", q], "b2": [p + "fc2.bias", d],
-            }
-            if side == "decoder":
-                m.update({
-                    "lnx_w": [p + "encoder_attn_layer_norm.weight", d],
-                    "lnx_b": [p + "encoder_attn_layer_norm.bias", d],
-                    **attn(p + "encoder_attn.", "x"),
-                })
-            per.append({k: fn(get(name)) for k, (name, fn) in m.items()})
-        out = {}
-        for k in per[0]:
-            vals = [layer[k] for layer in per]
-            if isinstance(vals[0], QTensor):
-                from bigdl_tpu.quant.qtensor import map_arrays_multi
-
-                out[k] = map_arrays_multi(vals, jnp.stack)
-            else:
-                out[k] = jnp.stack(vals)
-        return out
+        dec_per.append({k: fn(get(name)) for k, (name, fn) in m.items()})
 
     return {
-        "conv1_w": d(get("model.encoder.conv1.weight")),
-        "conv1_b": d(get("model.encoder.conv1.bias")),
-        "conv2_w": d(get("model.encoder.conv2.weight")),
-        "conv2_b": d(get("model.encoder.conv2.bias")),
-        "enc_pos": d(get("model.encoder.embed_positions.weight")),
-        "enc": stack("encoder", config.encoder_layers),
-        "enc_ln_w": d(get("model.encoder.layer_norm.weight")),
-        "enc_ln_b": d(get("model.encoder.layer_norm.bias")),
+        **encoder_params_from_state_dict(config, get, "model.encoder.", q, d),
         "embed": d(get("model.decoder.embed_tokens.weight")),
         "dec_pos": d(get("model.decoder.embed_positions.weight")),
-        "dec": stack("decoder", config.decoder_layers),
+        "dec": _stack_layers(dec_per),
         "dec_ln_w": d(get("model.decoder.layer_norm.weight")),
         "dec_ln_b": d(get("model.decoder.layer_norm.bias")),
     }

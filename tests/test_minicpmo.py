@@ -1,0 +1,221 @@
+"""MiniCPM-o tests.
+
+The audio tower (apm) is checked against transformers' WhisperEncoder
+(fp32 CPU eager — the reference patches exactly its attention class,
+convert.py:1970-1976) composed with a torch oracle of the published
+MultiModalProjector + AvgPool1d semantics; the prefill path checks that
+image and audio features land on their own placeholder tokens.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.models import get_family, llama, minicpmo
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.models.whisper import WhisperConfig
+
+
+def _tiny_apm():
+    from transformers import WhisperConfig as HFWhisperConfig
+    from transformers.models.whisper.modeling_whisper import WhisperEncoder
+
+    hf_cfg = HFWhisperConfig(
+        vocab_size=64, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, num_mel_bins=8,
+        max_source_positions=16, max_target_positions=8,
+    )
+    hf_cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    enc = WhisperEncoder(hf_cfg).eval().to(torch.float32)
+    wcfg = WhisperConfig.from_hf_config(hf_cfg.to_dict())
+    return hf_cfg, enc, wcfg
+
+
+def test_audio_tower_matches_hf_whisper_encoder():
+    hf_cfg, enc, wcfg = _tiny_apm()
+    rng = np.random.default_rng(0)
+    # mel length = 2 * max_source_positions (conv2 stride 2)
+    mel = rng.standard_normal((1, 8, 32)).astype(np.float32)
+    with torch.no_grad():
+        hf_out = enc(torch.from_numpy(mel)).last_hidden_state.numpy()
+
+    sd = {k: v.numpy() for k, v in enc.state_dict().items()}
+    aparams = minicpmo.apm_params_from_state_dict(wcfg, sd.__getitem__, prefix="")
+
+    from bigdl_tpu.models import whisper
+
+    ours = np.asarray(whisper.encode(wcfg, aparams, jnp.asarray(mel)))
+    np.testing.assert_allclose(ours, hf_out, rtol=2e-3, atol=2e-3)
+
+
+def test_audio_embed_matches_projector_pool_oracle():
+    hf_cfg, enc, wcfg = _tiny_apm()
+    E_llm = 48
+    torch.manual_seed(1)
+    linear1 = torch.nn.Linear(32, E_llm)
+    linear2 = torch.nn.Linear(E_llm, E_llm)
+    pool = torch.nn.AvgPool1d(2, stride=2)
+
+    rng = np.random.default_rng(1)
+    mel = rng.standard_normal((2, 8, 32)).astype(np.float32)
+    with torch.no_grad():
+        states = enc(torch.from_numpy(mel)).last_hidden_state
+        proj = linear2(torch.relu(linear1(states)))
+        expect = pool(proj.transpose(1, 2)).transpose(1, 2).numpy()
+
+    sd = {k: v.numpy() for k, v in enc.state_dict().items()}
+    aparams = minicpmo.apm_params_from_state_dict(wcfg, sd.__getitem__, prefix="")
+    pparams = minicpmo.audio_proj_params_from_state_dict(
+        {
+            "p.linear1.weight": linear1.weight.detach().numpy(),
+            "p.linear1.bias": linear1.bias.detach().numpy(),
+            "p.linear2.weight": linear2.weight.detach().numpy(),
+            "p.linear2.bias": linear2.bias.detach().numpy(),
+        }.__getitem__,
+        prefix="p.",
+    )
+    ours = np.asarray(
+        minicpmo.audio_embed(wcfg, aparams, pparams, jnp.asarray(mel), pool_step=2)
+    )
+    assert ours.shape == expect.shape == (2, 16 // 2, E_llm)
+    np.testing.assert_allclose(ours, expect, rtol=2e-3, atol=2e-3)
+
+
+def test_family_registered():
+    fam = get_family("minicpmo")
+    assert fam is minicpmo
+    cfg = ModelConfig.from_hf_config(
+        {
+            "model_type": "minicpmo",
+            "hidden_size": 48,
+            "intermediate_size": 96,
+            "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "vocab_size": 128,
+            "image_token_id": 101,
+            "audio_token_id": 102,
+        }
+    )
+    assert cfg.audio_token_id == 102 and cfg.attention_bias
+
+
+def test_multimodal_prefill_scatters_audio():
+    hf_cfg, enc, wcfg = _tiny_apm()
+    cfg = ModelConfig.from_hf_config(
+        {
+            "model_type": "minicpmo",
+            "hidden_size": 48,
+            "intermediate_size": 96,
+            "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "vocab_size": 128,
+            "image_token_id": 101,
+            "audio_token_id": 102,
+        }
+    )
+    import jax
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    torch.manual_seed(2)
+    linear1 = torch.nn.Linear(32, 48)
+    linear2 = torch.nn.Linear(48, 48)
+    sd = {k: v.numpy() for k, v in enc.state_dict().items()}
+    aparams = minicpmo.apm_params_from_state_dict(wcfg, sd.__getitem__, prefix="")
+    pparams = {
+        "w1": jnp.asarray(linear1.weight.detach().numpy()),
+        "b1": jnp.asarray(linear1.bias.detach().numpy()),
+        "w2": jnp.asarray(linear2.weight.detach().numpy()),
+        "b2": jnp.asarray(linear2.bias.detach().numpy()),
+    }
+    rng = np.random.default_rng(2)
+    mel = rng.standard_normal((1, 8, 32)).astype(np.float32)
+    audio = minicpmo.audio_embed(wcfg, aparams, pparams, jnp.asarray(mel))
+    Qa = audio.shape[1]  # 8 pooled frames
+
+    T = Qa + 4
+    ids = np.full((1, T), 5, np.int64)
+    ids[0, 2 : 2 + Qa] = 102  # audio placeholder run
+
+    cache = kvcache.init_cache(
+        cfg.num_hidden_layers, 1, T + 4, cfg.num_key_value_heads,
+        cfg.head_dim_, dtype=jnp.float32,
+    )
+    logits, cache = minicpmo.multimodal_prefill(
+        cfg, params, ids, cache,
+        wcfg=wcfg, aparams=aparams, pparams=pparams,
+        mel=jnp.asarray(mel), last_logits_only=True,
+    )
+    assert logits.shape == (1, 1, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+    # the scattered hidden actually differs from a text-only embed at
+    # exactly the placeholder span
+    from bigdl_tpu.models._multimodal import scatter_image_features
+
+    h = scatter_image_features(cfg, params, ids, None, jnp.float32, audio=audio)
+    h_text = llama.embed_tokens(cfg, params, jnp.asarray(ids), jnp.float32)
+    diff = np.abs(np.asarray(h) - np.asarray(h_text)).max(axis=-1)[0]
+    assert (diff[2 : 2 + Qa] > 0).all()
+    assert (diff[:2] == 0).all() and (diff[2 + Qa :] == 0).all()
+
+
+def test_placeholder_id_collision_raises():
+    cfg = ModelConfig.from_hf_config(
+        {
+            "model_type": "minicpmo",
+            "hidden_size": 48,
+            "intermediate_size": 96,
+            "num_hidden_layers": 1,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "vocab_size": 128,
+            # no explicit ids: image defaults to 0, audio stays None —
+            # and forcing both to one id must raise, not silently overwrite
+            "audio_token_id": 0,
+        }
+    )
+    assert cfg.audio_pool_step is None  # default lives in minicpmo.py
+    import jax
+
+    from bigdl_tpu.models._multimodal import scatter_image_features
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ids = np.zeros((1, 4), np.int64)
+    feats = jnp.zeros((1, 4, 48), jnp.float32)
+    with pytest.raises(ValueError, match="image_token_id == audio_token_id"):
+        scatter_image_features(
+            cfg, params, ids, feats, jnp.float32, audio=feats,
+        )
+
+
+def test_audio_placeholder_count_mismatch_raises():
+    cfg = ModelConfig.from_hf_config(
+        {
+            "model_type": "minicpmo",
+            "hidden_size": 48,
+            "intermediate_size": 96,
+            "num_hidden_layers": 1,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "vocab_size": 128,
+            "audio_token_id": 102,
+        }
+    )
+    import jax
+
+    from bigdl_tpu.models._multimodal import scatter_image_features
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ids = np.full((1, 6), 5, np.int64)
+    ids[0, 1:3] = 102  # two placeholders, three features
+    audio = jnp.zeros((1, 3, 48), jnp.float32)
+    with pytest.raises(ValueError, match="audio placeholder"):
+        scatter_image_features(cfg, params, ids, None, jnp.float32, audio=audio)
