@@ -602,6 +602,17 @@ def _minicpmv_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
     return _llama_top(config, _prefixed(get, "llm."))
 
 
+def _qwen2_audio_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
+    """Qwen2-Audio stores its qwen2 decoder under `language_model.`
+    (transformers Qwen2AudioForConditionalGeneration); audio tower and
+    projector load separately via models/qwen2_audio.py."""
+    return _llama_layer(config, i, _prefixed(get, "language_model."))
+
+
+def _qwen2_audio_top(config: ModelConfig, get: Get) -> dict[str, np.ndarray]:
+    return _llama_top(config, _prefixed(get, "language_model."))
+
+
 def _yuan_layer(config: ModelConfig, i: int, get: Get) -> dict[str, np.ndarray]:
     """Yuan-2 (yuan_hf_model.py layout): llama names + the LFA filter's
     two Conv2d(k=(2,1)) stages, each split into its two time taps
@@ -1013,6 +1024,7 @@ _FAMILY_LAYER = {
     "yuan": _yuan_layer,
     "minicpmv": _minicpmv_layer,
     "minicpmo": _minicpmv_layer,  # same llm. prefix, qwen2 layout
+    "qwen2_audio": _qwen2_audio_layer,
     "internvl": _internvl_layer,
     "janus": _janus_layer,
     "qwen": _qwen_layer,
@@ -1040,6 +1052,7 @@ _FAMILY_TOP = {
     "gemma3_text": _gemma3_top,
     "minicpmv": _minicpmv_top,
     "minicpmo": _minicpmv_top,  # same llm. prefix
+    "qwen2_audio": _qwen2_audio_top,
     "internvl": _internvl_top,
     "janus": _janus_top,
     "qwen": _qwen_top,

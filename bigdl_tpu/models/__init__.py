@@ -76,6 +76,12 @@ from bigdl_tpu.models import minicpmo  # noqa: E402  (adds whisper-apm audio)
 # projected into the qwen2-shaped LLM (models/minicpmo.py)
 _FAMILIES["minicpmo"] = minicpmo
 
+from bigdl_tpu.models import qwen2_audio  # noqa: E402  (whisper-pool tower)
+
+# Qwen2-Audio: whisper-style encoder with an in-encoder AvgPool1d(2) +
+# single-linear projector over the qwen2 decoder (models/qwen2_audio.py)
+_FAMILIES["qwen2_audio"] = qwen2_audio
+
 from bigdl_tpu.models import mllama  # noqa: E402  (cross-attn decoder)
 
 _FAMILIES["mllama"] = mllama

@@ -728,6 +728,15 @@ def _hf_minicpmo(hf, kw):
         kw.setdefault("audio_pool_step", hf["audio_pool_step"])
 
 
+def _hf_qwen2_audio(hf, kw):
+    """Qwen2-Audio (reference convert.py:969-971, 1655-1656): the text
+    half is qwen2 (nested text_config, merged by from_hf_config); the
+    <|AUDIO|> placeholder id is the top-level audio_token_index."""
+    kw.setdefault("attention_bias", True)  # qwen2 qkv bias
+    if hf.get("audio_token_index") is not None:
+        kw.setdefault("audio_token_id", hf["audio_token_index"])
+
+
 def _hf_yuan(hf, kw):
     """Yuan-2 (reference models/yuan.py; original schema in
     gguf/models/model_implement/yuan2/configuration_yuan.py): llama
@@ -833,6 +842,7 @@ _HF_BUILDERS = {
     "yuan": _hf_yuan,
     "minicpmv": _hf_minicpmv,
     "minicpmo": _hf_minicpmo,
+    "qwen2_audio": _hf_qwen2_audio,
     "mllama": _hf_mllama,
     "mllama_text_model": _hf_mllama,
     "deepseek_v2": _hf_deepseek_v2,

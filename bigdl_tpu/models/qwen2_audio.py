@@ -1,0 +1,100 @@
+"""Qwen2-Audio: a Whisper-style audio encoder with an in-encoder
+AvgPool1d(2), a single-linear projector, and a qwen2 LLM.
+
+Reference support: convert.py:969-971 (_optimize_pre merges the
+language_model's qkv) and :1655-1656 (_optimize_post optimizes the
+language_model as plain qwen2); the towers run through transformers'
+Qwen2AudioEncoder. Architecture per transformers modeling_qwen2_audio:
+
+    audio_tower (whisper encoder layers; AvgPool1d(2, stride=2) between
+      the layer stack and the final layer_norm)
+      -> multi_modal_projector (one biased linear, d_model -> hidden)
+      -> scattered over the prompt's <|AUDIO|> placeholder tokens
+         (config.audio_token_index)
+
+The checkpoint stores the decoder under `language_model.` (qwen2
+layout), the encoder under `audio_tower.` (whisper encoder names — the
+shared translator whisper.encoder_params_from_state_dict reads it
+directly), and the projector under `multi_modal_projector.linear.`.
+Only the LLM quantizes; the tower stays dense, as the reference does
+for multimodal families.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import llama, whisper
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.models.whisper import WhisperConfig
+
+# the text side delegates wholesale to the llama family (qwen2-shaped)
+init_params = llama.init_params
+quantize_params = llama.quantize_params
+forward = llama.forward
+merge_fused_params = llama.merge_fused_params
+unmerge_fused_params = llama.unmerge_fused_params
+
+POOL_STEP = 2  # fixed in transformers Qwen2AudioEncoder (avg_pooler)
+
+
+def tower_params_from_state_dict(
+    wcfg: WhisperConfig, get, prefix: str = "audio_tower.",
+) -> dict:
+    """Qwen2AudioEncoder uses whisper's encoder key names verbatim."""
+    return whisper.encoder_params_from_state_dict(wcfg, get, prefix)
+
+
+def proj_params_from_state_dict(
+    get, prefix: str = "multi_modal_projector.",
+) -> dict:
+    def g(name):
+        return jnp.asarray(np.asarray(get(prefix + name), np.float32))
+
+    return {"w": g("linear.weight"), "b": g("linear.bias")}
+
+
+def audio_embed(
+    wcfg: WhisperConfig,
+    aparams: dict,
+    pparams: dict,
+    mel: jax.Array,  # [B, n_mels, 2 * max_source_positions]
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """mel -> [B, max_source_positions // 2, E_llm]: encoder (with its
+    internal pool-2) then the single-linear projector."""
+    enc = whisper.encode(wcfg, aparams, mel, pool_before_ln=POOL_STEP)
+    x = jnp.einsum("bsh,eh->bse", enc.astype(jnp.float32), pparams["w"])
+    return (x + pparams["b"]).astype(out_dtype)
+
+
+def multimodal_prefill(
+    config: ModelConfig,
+    params: dict,
+    input_ids: np.ndarray,  # [B, T] with audio_token_id placeholders
+    cache,
+    wcfg: Optional[WhisperConfig] = None,
+    aparams: Optional[dict] = None,
+    pparams: Optional[dict] = None,
+    mel: Optional[jax.Array] = None,
+    compute_dtype=jnp.bfloat16,
+    last_logits_only: bool = True,
+):
+    """Audio tower -> projector -> scatter over placeholders -> standard
+    qwen2 prefill."""
+    from bigdl_tpu.models._multimodal import scatter_image_features
+
+    audio = None
+    if mel is not None:
+        audio = audio_embed(wcfg, aparams, pparams, mel)
+    h = scatter_image_features(
+        config, params, input_ids, None, compute_dtype, audio=audio,
+    )
+    return llama.forward(
+        config, params, h, cache, mode="prefill", input_is_hidden=True,
+        compute_dtype=compute_dtype, last_logits_only=last_logits_only,
+    )

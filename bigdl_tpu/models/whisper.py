@@ -84,11 +84,13 @@ class WhisperConfig:
                     f"{dec}={hf[dec]}"
                 )
         return cls(
-            vocab_size=hf["vocab_size"],
+            # vocab/decoder fields are absent from encoder-only configs
+            # (Qwen2AudioEncoderConfig) — default them
+            vocab_size=hf.get("vocab_size", 51865),
             num_mel_bins=hf.get("num_mel_bins", 80),
             hidden_size=hf["d_model"],
             encoder_layers=hf["encoder_layers"],
-            decoder_layers=hf["decoder_layers"],
+            decoder_layers=hf.get("decoder_layers", 0),
             num_heads=hf["encoder_attention_heads"],
             ffn_dim=hf.get("encoder_ffn_dim", 4 * hf["d_model"]),
             max_source_positions=hf.get("max_source_positions", 1500),
@@ -331,11 +333,14 @@ def _mha(config, x_q, k, v, mask, compute_dtype):
 
 
 def encode(config: WhisperConfig, params: Params, mel: jax.Array,
-           compute_dtype=jnp.float32) -> jax.Array:
+           compute_dtype=jnp.float32, pool_before_ln: int = 1) -> jax.Array:
     """mel [B, n_mels, T_audio] → encoder states [B, T_audio//2, H].
 
     T_audio must be 2 * max_source_positions (whisper's fixed 30 s
-    window; shorter audio is zero-padded upstream, as in HF)."""
+    window; shorter audio is zero-padded upstream, as in HF).
+    pool_before_ln > 1 applies Qwen2Audio's in-encoder AvgPool1d
+    (kernel == stride == pool_before_ln) between the layer stack and the
+    final layer_norm (transformers Qwen2AudioEncoder.forward)."""
     H = config.hidden_size
     Hd, D = config.num_heads, config.head_dim
     eps = config.layer_norm_eps
@@ -370,6 +375,11 @@ def encode(config: WhisperConfig, params: Params, mel: jax.Array,
         return hidden, None
 
     h, _ = jax.lax.scan(body, h, params["enc"])
+    if pool_before_ln > 1:
+        S_out = h.shape[1] // pool_before_ln
+        h = h[:, : S_out * pool_before_ln].reshape(
+            B, S_out, pool_before_ln, H
+        ).mean(axis=2)
     return layer_norm(h, params["enc_ln_w"], params["enc_ln_b"], eps)
 
 

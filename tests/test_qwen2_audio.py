@@ -1,0 +1,111 @@
+"""Qwen2-Audio tests against transformers' Qwen2AudioEncoder /
+Qwen2AudioForConditionalGeneration (fp32 CPU eager — the reference
+optimizes exactly these modules, convert.py:969-971, 1655-1656): tower
++ projector features, and end-to-end audio-conditioned logits through
+the registered convert path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu import kvcache
+from bigdl_tpu.convert import params_from_state_dict
+from bigdl_tpu.models import get_family, qwen2_audio
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.models.whisper import WhisperConfig
+
+
+def _tiny_model():
+    from transformers import (
+        Qwen2AudioConfig,
+        Qwen2AudioEncoderConfig,
+        Qwen2AudioForConditionalGeneration,
+    )
+    from transformers.models.qwen2 import Qwen2Config
+
+    audio = Qwen2AudioEncoderConfig(
+        d_model=32, encoder_layers=2, encoder_attention_heads=4,
+        encoder_ffn_dim=64, num_mel_bins=8, max_source_positions=16,
+    )
+    text = Qwen2Config(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    cfg = Qwen2AudioConfig(
+        audio_config=audio.to_dict(), text_config=text.to_dict(),
+        audio_token_index=7,
+    )
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    model = Qwen2AudioForConditionalGeneration(cfg).eval().to(torch.float32)
+    return cfg, model
+
+
+def _mel(batch=1, seed=0):
+    # Qwen2Audio requires mel length == 2 * max_source_positions
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((batch, 8, 32)).astype(np.float32)
+
+
+def test_tower_and_projector_match_hf():
+    cfg, model = _tiny_model()
+    mel = _mel()
+    with torch.no_grad():
+        states = model.audio_tower(torch.from_numpy(mel)).last_hidden_state
+        expect = model.multi_modal_projector(states).numpy()
+
+    wcfg = WhisperConfig.from_hf_config(cfg.audio_config.to_dict())
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    aparams = qwen2_audio.tower_params_from_state_dict(wcfg, sd.__getitem__)
+    pparams = qwen2_audio.proj_params_from_state_dict(sd.__getitem__)
+    ours = np.asarray(
+        qwen2_audio.audio_embed(wcfg, aparams, pparams, jnp.asarray(mel))
+    )
+    # pool-2 inside the encoder: 32 mel -> 16 conv frames -> 8 pooled
+    assert ours.shape == expect.shape == (1, 8, 48)
+    np.testing.assert_allclose(ours, expect, rtol=2e-3, atol=2e-3)
+
+
+def test_end_to_end_logits_match_hf():
+    cfg, model = _tiny_model()
+    mel = _mel(seed=1)
+    Qa = 8
+    ids = np.full((1, Qa + 4), 5, np.int64)
+    ids[0, 2 : 2 + Qa] = 7  # <|AUDIO|> placeholders
+
+    with torch.no_grad():
+        hf_logits = model(
+            input_ids=torch.from_numpy(ids),
+            input_features=torch.from_numpy(mel),
+            feature_attention_mask=torch.ones(1, 32, dtype=torch.long),
+        ).logits.numpy()
+
+    config = ModelConfig.from_hf_config(cfg.to_dict())
+    assert config.model_type == "qwen2_audio"
+    assert config.audio_token_id == 7
+    assert get_family("qwen2_audio") is qwen2_audio
+
+    sd = model.state_dict()
+    get = lambda name: sd[name].detach().to(torch.float32).numpy()
+    params = params_from_state_dict(config, get, qtype="bf16", dtype=jnp.float32)
+    wcfg = WhisperConfig.from_hf_config(cfg.audio_config.to_dict())
+    aparams = qwen2_audio.tower_params_from_state_dict(
+        wcfg, lambda n: sd[n].numpy()
+    )
+    pparams = qwen2_audio.proj_params_from_state_dict(lambda n: sd[n].numpy())
+
+    cache = kvcache.init_cache(
+        config.num_hidden_layers, 1, ids.shape[1] + 8,
+        config.num_key_value_heads, config.head_dim_, dtype=jnp.float32,
+    )
+    logits, _ = qwen2_audio.multimodal_prefill(
+        config, params, ids, cache,
+        wcfg=wcfg, aparams=aparams, pparams=pparams, mel=jnp.asarray(mel),
+        compute_dtype=jnp.float32, last_logits_only=False,
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=3e-3, atol=3e-3)
