@@ -112,20 +112,22 @@ def multimodal_prefill(
     aparams: Optional[dict] = None,
     pparams: Optional[dict] = None,
     mel: Optional[jax.Array] = None,  # [B, n_mels, T_audio]
+    audio: Optional[jax.Array] = None,  # precomputed audio_embed output
     pool_step: Optional[int] = None,  # default: config.audio_pool_step
     compute_dtype=jnp.bfloat16,
     last_logits_only: bool = True,
 ):
     """Vision and/or audio towers -> scatter over placeholders ->
-    standard 1-D-rope prefill (the minicpm-o LLM uses plain rope)."""
+    standard 1-D-rope prefill (the minicpm-o LLM uses plain rope).
+    Pass either `mel` (tower runs here) or precomputed `audio` features
+    to skip a second tower pass."""
     from bigdl_tpu.models._multimodal import scatter_image_features
 
     img = None
     if patches is not None:
         feats = siglip_forward(vcfg, vparams, patches)
         img = resampler_forward(rcfg, rparams, feats, tgt_size)
-    audio = None
-    if mel is not None:
+    if audio is None and mel is not None:
         if pool_step is None:
             pool_step = (
                 config.audio_pool_step

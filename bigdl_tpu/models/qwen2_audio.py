@@ -81,15 +81,17 @@ def multimodal_prefill(
     aparams: Optional[dict] = None,
     pparams: Optional[dict] = None,
     mel: Optional[jax.Array] = None,
+    audio: Optional[jax.Array] = None,  # precomputed audio_embed output
     compute_dtype=jnp.bfloat16,
     last_logits_only: bool = True,
 ):
     """Audio tower -> projector -> scatter over placeholders -> standard
-    qwen2 prefill."""
+    qwen2 prefill. Pass either `mel` (tower runs here) or precomputed
+    `audio` features (callers that already ran audio_embed — e.g. to
+    size the placeholder run — skip a second tower pass)."""
     from bigdl_tpu.models._multimodal import scatter_image_features
 
-    audio = None
-    if mel is not None:
+    if audio is None and mel is not None:
         audio = audio_embed(wcfg, aparams, pparams, mel)
     h = scatter_image_features(
         config, params, input_ids, None, compute_dtype, audio=audio,

@@ -66,8 +66,7 @@ def main():
                    "b": jnp.zeros(48)}
         audio = qwen2_audio.audio_embed(wcfg, aparams, pparams, mel)
         prefill = lambda ids, cache: qwen2_audio.multimodal_prefill(
-            cfg, params, ids, cache, wcfg=wcfg, aparams=aparams,
-            pparams=pparams, mel=mel, compute_dtype=jnp.float32,
+            cfg, params, ids, cache, audio=audio, compute_dtype=jnp.float32,
         )
     else:
         pparams = {"w1": jax.random.normal(k(2), (48, 32)) * 0.1,
@@ -76,8 +75,7 @@ def main():
                    "b2": jnp.zeros(48)}
         audio = minicpmo.audio_embed(wcfg, aparams, pparams, mel)
         prefill = lambda ids, cache: minicpmo.multimodal_prefill(
-            cfg, params, ids, cache, wcfg=wcfg, aparams=aparams,
-            pparams=pparams, mel=mel, compute_dtype=jnp.float32,
+            cfg, params, ids, cache, audio=audio, compute_dtype=jnp.float32,
         )
 
     # prompt: text tokens around a run of audio placeholders (one per
