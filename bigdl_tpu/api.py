@@ -237,13 +237,20 @@ class TpuModel:
         quantize_kv: bool = False,
         compress_kv: Optional[int] = None,  # SnapKV budget (slots kept)
         compress_window: int = 32,
+        streaming_window: Optional[int] = None,  # attention-sink ring size
+        streaming_sink: int = 4,
     ) -> np.ndarray:
         """prompts: ragged list of token-id lists (or [B, T] array).
         Returns [B, max_new_tokens] generated ids.
 
         quantize_kv is the reference's IPEX_LLM_QUANTIZE_KV_CACHE (FP8 KV);
         compress_kv the reference's IPEX_LLM_COMPRESS_KV_CACHE (SnapKV) —
-        applied only when the prompt is longer than the budget."""
+        applied only when the prompt is longer than the budget.
+        streaming_window enables StreamingLLM-style attention sinks
+        (reference example/GPU/Applications/streaming-llm): the cache is
+        a fixed `streaming_window` slots — the first `streaming_sink`
+        tokens plus a rolling recent region — so max_new_tokens may
+        exceed the cache and generation runs in constant memory."""
         from bigdl_tpu.utils import flags
 
         if isinstance(prompts, np.ndarray):
@@ -252,6 +259,8 @@ class TpuModel:
             raise ValueError("prompts is empty — nothing to generate")
         # env-flag defaults (reference IPEX_LLM_QUANTIZE_KV_CACHE /
         # IPEX_LLM_COMPRESS_KV_CACHE / IPEX_LLM_PERFORMANCE_MODE)
+        explicit_quantize_kv = quantize_kv
+        explicit_compress_kv = compress_kv
         if not quantize_kv:
             quantize_kv = flags.quantize_kv_default()
         if compress_kv is None:
@@ -277,6 +286,7 @@ class TpuModel:
             compress_kv = None
         if (
             flags.performance_mode()
+            and streaming_window is None  # lookup has no eviction support
             and cache_init is None  # lookup verify needs a rewindable KV cache
             and not do_sample
             and compress_kv is None  # lookup path has no SnapKV support
@@ -288,7 +298,52 @@ class TpuModel:
                 eos_token_id=eos_token_id, pad_token_id=pad_token_id,
                 seed=seed, quantize_kv=quantize_kv,
             )
-        tokens, start = pad_prompts(prompts, pad_token_id)
+        streaming = None
+        if streaming_window is not None:
+            from bigdl_tpu.streaming import validate_streaming
+
+            validate_streaming(self.config, streaming_window, streaming_sink)
+            if explicit_quantize_kv or explicit_compress_kv is not None:
+                raise ValueError(
+                    "streaming_window is incompatible with quantize_kv/"
+                    "compress_kv — the evicted keys are re-based in place"
+                )
+            if quantize_kv or compress_kv is not None:
+                # env-flag defaults (BIGDL_TPU_QUANTIZE_KV_CACHE /
+                # _COMPRESS_KV_CACHE), not a caller choice: disable for
+                # this call rather than make streaming unusable under them
+                warnings.warn(
+                    "streaming_window: ignoring env-default "
+                    "quantize_kv/compress_kv for this call"
+                )
+                quantize_kv, compress_kv = False, None
+            if cache_init is not None:
+                raise ValueError(
+                    "streaming_window supports the standard KV cache only; "
+                    f"the {self.config.model_type} family uses a custom "
+                    "cache layout (family init_cache hook)"
+                )
+            lens = {len(p) for p in prompts}
+            if len(lens) > 1:
+                raise ValueError(
+                    "streaming_window needs equal-length prompts (the sink "
+                    "slots must hold real tokens in every row) — batch "
+                    "equal lengths or generate per prompt"
+                )
+            if max(lens) >= streaming_window:
+                raise ValueError(
+                    f"prompt ({max(lens)} tokens) must be shorter than "
+                    f"streaming_window ({streaming_window}); raise the "
+                    "window or pre-truncate the prompt"
+                )
+            streaming = (streaming_sink, streaming_window)
+        # streaming: pad to the exact (equal) prompt length, not a
+        # power-of-two bucket — the sink slots must hold real tokens,
+        # and a bucket as large as the window would leave no decode room
+        tokens, start = pad_prompts(
+            prompts, pad_token_id,
+            bucket=(len(prompts[0]) if streaming is not None else None),
+        )
         gen = GenerationConfig(
             max_new_tokens=max_new_tokens,
             do_sample=do_sample,
@@ -301,7 +356,10 @@ class TpuModel:
         )
         from bigdl_tpu.utils import cache_len_for
 
-        cache_len = cache_len_for(tokens.shape[1], max_new_tokens)
+        cache_len = (
+            streaming_window if streaming is not None
+            else cache_len_for(tokens.shape[1], max_new_tokens)
+        )
         budget = 0
         if compress_kv is not None and tokens.shape[1] > compress_kv:
             budget = compress_kv
@@ -320,6 +378,7 @@ class TpuModel:
                 compress_window=min(compress_window, max(budget - 1, 1)),
                 last_logits=flags.last_lm_head_default(),
                 cache_init=cache_init,
+                streaming=streaming,
             )
         return np.asarray(out)
 

@@ -177,7 +177,7 @@ def pad_prompts(
     static_argnames=(
         "config", "gen", "model_forward", "cache_len", "quantize_kv",
         "compress_budget", "compress_window", "compress_kernel",
-        "last_logits", "cache_init",
+        "last_logits", "cache_init", "streaming",
     ),
     donate_argnames=(),
 )
@@ -201,6 +201,11 @@ def generate_tokens(
     # architectures whose state is not a KV cache (rwkv's RwkvState);
     # None = standard kvcache.init_cache
     cache_init=None,
+    # (sink, window) or (sink, window, chunk) attention-sink streaming:
+    # the cache is `window` slots and the oldest `chunk` non-sink slots
+    # are evicted together once full (bigdl_tpu/streaming.py) —
+    # generation length becomes unbounded
+    streaming=None,
 ) -> jax.Array:
     """One compiled program: prefill + full decode loop.
 
@@ -214,7 +219,17 @@ def generate_tokens(
     from bigdl_tpu.utils import cache_len_for
 
     B, T = tokens.shape
-    assert cache_len >= T + gen.max_new_tokens
+    shift = None
+    if streaming is not None:
+        from bigdl_tpu.streaming import default_chunk, make_sink_shift
+
+        sink, window = streaming[:2]
+        chunk = streaming[2] if len(streaming) > 2 else default_chunk(window, sink)
+        assert cache_len == window and cache_len > T
+        assert not quantize_kv and compress_budget == 0 and cache_init is None
+        shift = make_sink_shift(config, window, sink, chunk)
+    else:
+        assert cache_len >= T + gen.max_new_tokens
     if cache_init is not None:
         cache = cache_init(config, B, cache_len, quantize_kv)
         assert compress_budget == 0, "SnapKV needs a KV cache"
@@ -270,6 +285,8 @@ def generate_tokens(
 
     def step(state):
         i, cur, cache, done, out, key, seen = state
+        if shift is not None:
+            cache = shift(cache)  # evict the oldest non-sink slot if full
         logits, cache = model_forward(
             config, params, cur[:, None], cache, mode="decode"
         )

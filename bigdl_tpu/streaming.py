@@ -1,0 +1,129 @@
+"""StreamingLLM-style attention sinks: unbounded generation in a fixed
+cache (reference: example/GPU/Applications/streaming-llm — a wrapper
+over the external streaming_llm package with start_size/recent_size;
+here it is a first-class cache policy).
+
+The window keeps the first `sink` tokens (attention sinks — the
+softmax's always-attended anchors) plus a rolling region of the most
+recent tokens. When the cache fills, the oldest `chunk` non-sink slots
+are evicted at once by shifting the recent region left.
+
+TPU-native design: everything stays static-shaped and in-jit. Keys are
+stored rotated (the hot path is untouched), so eviction re-bases the
+shifted keys' rope positions by applying the exact `-chunk`-step inverse
+rotation — rope is a per-lane complex rotation, so rotate(k, p-c) ==
+rotate(rotate(k, p), -c), and the yarn/longrope attention scale factors
+cancel (the shift tables use scale 1). Positions therefore never exceed
+`window`, which is what keeps quality inside the trained context (the
+point of the original StreamingLLM positional re-basing).
+
+Chunked eviction is both the perf and the precision lever: a shift
+rewrites the whole cache (L*B*W*Hkv*D * 2 dtypes of HBM traffic), so
+evicting `chunk` slots at once amortizes that to 1/chunk per token; and
+each shift rounds the re-rotated keys back to the cache dtype (bf16 on
+the generate path), so a key surviving the recent region is re-rounded
+ceil((W - sink)/chunk) times instead of once per token — with the
+default chunk of (window - sink + 7) // 8 that is <= 8 rounding events,
+a worst-case random-walk of a few bf16 ulps. The rotation itself is
+exact; the only approximation on eviction is that rounding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.ops import apply_rotary_emb
+from bigdl_tpu.ops.rope import make_inv_freq_scaled, rope_cos_sin
+
+
+def default_chunk(window: int, sink: int) -> int:
+    return max(1, (window - sink + 7) // 8)
+
+
+def validate_streaming(
+    config: ModelConfig, window: int, sink: int, chunk: int = 1
+) -> None:
+    if not 0 < sink < window:
+        raise ValueError(f"need 0 < sink ({sink}) < window ({window})")
+    if not 0 < chunk <= window - sink:
+        raise ValueError(
+            f"need 0 < chunk ({chunk}) <= window - sink ({window - sink})"
+        )
+    if config.learned_positions:
+        raise NotImplementedError(
+            "streaming sinks need relative positions; learned absolute "
+            "position embeddings (gpt2-style) cannot be re-based"
+        )
+    if config.sliding_window:
+        raise NotImplementedError(
+            "sliding-window attention already bounds the KV span; "
+            "combining it with sink eviction is not supported"
+        )
+    if config.mrope_section or config.rope_local_theta is not None:
+        raise NotImplementedError(
+            "streaming sinks support standard 1-D rope only"
+        )
+
+
+def make_sink_shift(config: ModelConfig, window: int, sink: int,
+                    chunk: int = 1):
+    """Returns a jit-safe fn(cache) -> cache that evicts the oldest
+    `chunk` non-sink slots when the cache is full (cache.pos >= window),
+    else returns the cache unchanged. Scalar-pos (generate path) caches
+    only."""
+    validate_streaming(config, window, sink, chunk)
+    use_rope = not config.alibi  # alibi shifts without re-rotation
+    if use_rope:
+        inv_freq, _ = make_inv_freq_scaled(
+            config.rotary_dim, config.rope_theta, config.rope_scaling_dict,
+            seq_len=window,
+        )
+        # chunk-step INVERSE rotation; attention scale deliberately 1 —
+        # the stored keys already carry it, and the re-basing must not
+        cos_mc, sin_mc = rope_cos_sin(
+            jnp.full((1,), -chunk, jnp.int32), inv_freq,
+            interleaved=config.rope_interleaved,
+        )
+        cos_mc, sin_mc = cos_mc[0], sin_mc[0]  # [R]
+
+    def shift(cache):
+        if cache.k_scale is not None:
+            raise NotImplementedError(
+                "streaming sinks over an fp8-quantized cache would need a "
+                "dequant-rotate-requant pass; use quantize_kv=False"
+            )
+        if cache.rope_base is not None:
+            raise NotImplementedError(
+                "streaming sinks after SnapKV compression are unsupported"
+            )
+        if cache.pos.ndim != 0:
+            raise NotImplementedError(
+                "streaming sinks run on the aligned generate path "
+                "(scalar cache.pos), not the serving engine's per-row pool"
+            )
+
+        def evict(c):
+            moved_k = c.k[:, :, sink + chunk:]
+            if use_rope:
+                _, moved_k = apply_rotary_emb(
+                    moved_k, moved_k, cos_mc, sin_mc, config.rope_interleaved
+                )
+            pad_k = jnp.zeros_like(c.k[:, :, :chunk])
+            new_k = jnp.concatenate([c.k[:, :, :sink], moved_k, pad_k], axis=2)
+            new_v = jnp.concatenate(
+                [c.v[:, :, :sink], c.v[:, :, sink + chunk:],
+                 jnp.zeros_like(c.v[:, :, :chunk])], axis=2,
+            )
+            return dataclasses.replace(
+                c, k=new_k, v=new_v, pos=c.pos - chunk
+            )
+
+        return jax.lax.cond(
+            cache.pos >= window, evict, lambda c: c, cache
+        )
+
+    return shift

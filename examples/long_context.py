@@ -31,6 +31,16 @@ def main():
     out_snap = model.generate([long_prompt], max_new_tokens=16, compress_kv=48)
     print("snapkv (48)    :", out_snap[0].tolist())
 
+    # StreamingLLM attention sinks (reference
+    # example/GPU/Applications/streaming-llm): fixed 128-slot cache =
+    # 4 sink tokens + rolling recent window; generation length may exceed
+    # the cache — constant memory however long it runs
+    out_stream = model.generate(
+        [long_prompt], max_new_tokens=64,
+        streaming_window=128, streaming_sink=4,
+    )
+    print("sink-streaming :", out_stream[0][:16].tolist(), "...")
+
 
 if __name__ == "__main__":
     main()
