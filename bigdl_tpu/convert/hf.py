@@ -1001,8 +1001,11 @@ _FAMILY_LAYER = {
     "gemma3": _gemma3_layer,
     "gemma3_text": _gemma3_layer,
     "phi3": _phi3_layer,
+    "phi3_v": _phi3_layer,  # text half is phi3 (vision keys not loaded)
     "baichuan": _baichuan_layer,
     "internlm2": _internlm2_layer,
+    # xcomposer2: internlm2 names; Plora_A/B image-path keys are ignored
+    "internlmxcomposer2": _internlm2_layer,
     "starcoder2": _starcoder2_layer,
     "glm": _glm_layer,
     "chatglm": _chatglm_layer,
@@ -1024,6 +1027,7 @@ _FAMILY_LAYER = {
     "yuan": _yuan_layer,
     "minicpmv": _minicpmv_layer,
     "minicpmo": _minicpmv_layer,  # same llm. prefix, qwen2 layout
+    "megrezo": _minicpmv_layer,  # Megrez-3B-Omni: llama llm under llm.
     "qwen2_audio": _qwen2_audio_layer,
     "internvl": _internvl_layer,
     "janus": _janus_layer,
@@ -1037,6 +1041,7 @@ _FAMILY_LAYER = {
 _FAMILY_TOP = {
     "baichuan": _baichuan_top,
     "internlm2": _internlm2_top,
+    "internlmxcomposer2": _internlm2_top,
     "chatglm": _chatglm_top,
     "chatglm4v": _chatglm_top,
     "qwen2_vl": _qwen2_vl_top,
@@ -1052,6 +1057,7 @@ _FAMILY_TOP = {
     "gemma3_text": _gemma3_top,
     "minicpmv": _minicpmv_top,
     "minicpmo": _minicpmv_top,  # same llm. prefix
+    "megrezo": _minicpmv_top,
     "qwen2_audio": _qwen2_audio_top,
     "internvl": _internvl_top,
     "janus": _janus_top,

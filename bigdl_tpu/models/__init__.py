@@ -54,6 +54,17 @@ _FAMILIES = {
     "deci": llama,  # variable GQA replicated to uniform kv heads at ingest
     "gpt_bigcode": llama,  # starcoder v1: MQA + learned positions
     "phixtral": llama,  # phi decoder + MoE over non-gated fc1/fc2 experts
+    # phi-3-vision: optimized as phi3 on the text path (reference
+    # convert.py:947,1829 treats phi3/phi3_v identically)
+    "phi3_v": llama,
+    # internlm-xcomposer2: internlm2 decoder; Plora image-row deltas are
+    # a vision-path addition (reference convert.py:984,1523) — text path
+    # is exactly internlm2
+    "internlmxcomposer2": llama,
+    # Megrez-3B-Omni: the llm half is llama (reference convert.py:1044
+    # rewrites model.llm.config.model_type = "llama"); towers load
+    # separately like minicpmv (same `llm.` checkpoint prefix)
+    "megrezo": llama,
 }
 
 from bigdl_tpu.models import qwen2_vl  # noqa: E402  (delegates text to llama)

@@ -193,6 +193,22 @@ class ModelConfig:
             # dict; route to the chatglm4v family (EVA2-CLIP tower over
             # the same chatglm text schema)
             model_type = "chatglm4v"
+        if model_type == "Yi":
+            # legacy 01-ai remote-code id (reference convert.py:1738);
+            # the architecture is llama-shaped — served by the yi entry
+            model_type = "yi"
+        if model_type == "phi-msft":
+            # mlabonne phixtral ships phi-2's legacy remote-code id
+            # (reference convert.py:1685-1687 keys on num_local_experts
+            # exactly this way to exclude plain phi-2)
+            if hf.get("num_local_experts"):
+                model_type = "phixtral"
+            else:
+                raise NotImplementedError(
+                    "legacy phi-msft (phi-2 remote-code) checkpoints are "
+                    "not supported — use the native model_type='phi' "
+                    "release of phi-2"
+                )
         if isinstance(hf.get("text_config"), dict):
             # multimodal configs nest the decoder fields (HF >= 4.52
             # qwen2_vl etc.); original checkpoints keep them at top level
@@ -824,10 +840,19 @@ _HF_BUILDERS = {
     "gemma3": _hf_gemma3,
     "gemma3_text": _hf_gemma3,
     "phi3": _hf_phi3,
+    # phi-3-vision: the reference optimizes it as phi3 (convert.py:947,
+    # :1829 `in ["phi3", "phi3_v"]`); text fields are phi3's, the CLIP
+    # tower weights are simply not loaded on the text path
+    "phi3_v": _hf_phi3,
     "stablelm": _hf_stablelm,
     "starcoder2": _hf_starcoder2,
     "baichuan": _hf_baichuan,
     "internlm2": _hf_internlm2,
+    # internlm-xcomposer2: internlm2 decoder + per-linear Plora deltas
+    # that apply only to image-token rows (reference convert.py:984,
+    # :1523); the text path (im_mask=None) is exactly internlm2, and the
+    # Plora_A/B checkpoint keys are ignored by the internlm2 translation
+    "internlmxcomposer2": _hf_internlm2,
     "internlm": _hf_internlm,
     "minicpm": _hf_minicpm,
     "glm": _hf_glm,

@@ -745,3 +745,122 @@ def test_phixtral_ingest_and_generate():
 
     out = TpuModel(cfg, params, "bf16").generate([[3, 1, 4]], max_new_tokens=5)
     assert out.shape == (1, 5)
+
+
+def test_legacy_model_type_aliases():
+    """Checkpoints ship legacy remote-code ids: 01-ai "Yi" (llama-shaped,
+    reference convert.py:1738) and mlabonne phixtral's "phi-msft"
+    (convert.py:1685-1687, keyed on num_local_experts to exclude plain
+    phi-2). from_hf_config rewrites them to the serving families."""
+    yi = ModelConfig.from_hf_config({
+        "model_type": "Yi", "vocab_size": 64, "hidden_size": 32,
+        "intermediate_size": 64, "num_hidden_layers": 1,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+    })
+    assert yi.model_type == "yi"
+    assert get_family("yi") is not None
+
+    px = ModelConfig.from_hf_config({
+        "model_type": "phi-msft", "vocab_size": 64, "n_embd": 32,
+        "n_layer": 1, "n_head": 2, "n_inner": 48, "n_positions": 64,
+        "rotary_dim": 8, "num_local_experts": 4, "num_experts_per_tok": 2,
+    })
+    assert px.model_type == "phixtral" and px.num_experts == 4
+
+    with pytest.raises(NotImplementedError, match="phi-msft"):
+        ModelConfig.from_hf_config({"model_type": "phi-msft",
+                                    "n_embd": 32, "n_layer": 1})
+
+
+def test_phi3_v_text_path_matches_phi3_oracle():
+    """phi-3-vision is optimized as phi3 on the text path (reference
+    convert.py:947,1829 `in ["phi3", "phi3_v"]`); the relabeled config
+    must produce identical text logits through the phi3 translation."""
+    cfg, model = hf_tiny(
+        "Phi3ForCausalLM", "Phi3Config",
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, pad_token_id=0,
+    )
+    hf = cfg.to_dict()
+    hf["model_type"] = "phi3_v"
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(TOKENS).long()).logits.numpy()
+    config = ModelConfig.from_hf_config(hf)
+    assert config.model_type == "phi3_v"
+    ours = run_ours(config, model.state_dict(), TOKENS)
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-3, atol=2e-3)
+
+
+def test_xcomposer2_ingests_ignoring_plora():
+    """internlm-xcomposer2 = internlm2 names + Plora_A/B per-linear image
+    deltas (reference convert.py:984,1523). The text path ignores the
+    Plora keys (im_mask=None) and generates."""
+    rng = np.random.default_rng(4)
+    H, I, V, D, Hkv, g = 32, 48, 64, 8, 2, 2
+    sd = {
+        "model.tok_embeddings.weight": rng.standard_normal((V, H)).astype(np.float32),
+        "model.norm.weight": np.ones(H, np.float32),
+        "output.weight": rng.standard_normal((V, H)).astype(np.float32) * 0.1,
+    }
+    p = "model.layers.0."
+    sd[p + "attention.wqkv.weight"] = rng.standard_normal(
+        (Hkv * (g + 2) * D, H)).astype(np.float32) * 0.05
+    sd[p + "attention.wo.weight"] = rng.standard_normal((H, H)).astype(np.float32) * 0.05
+    sd[p + "attention_norm.weight"] = np.ones(H, np.float32)
+    sd[p + "ffn_norm.weight"] = np.ones(H, np.float32)
+    sd[p + "feed_forward.w1.weight"] = rng.standard_normal((I, H)).astype(np.float32) * 0.05
+    sd[p + "feed_forward.w3.weight"] = rng.standard_normal((I, H)).astype(np.float32) * 0.05
+    sd[p + "feed_forward.w2.weight"] = rng.standard_normal((H, I)).astype(np.float32) * 0.05
+    # Plora keys present in real checkpoints; must be ignored, not crash
+    sd[p + "attention.wqkv.Plora_A.weight"] = np.zeros((8, H), np.float32)
+    sd[p + "attention.wqkv.Plora_B.weight"] = np.zeros((Hkv * (g + 2) * D, 8), np.float32)
+
+    config = ModelConfig.from_hf_config({
+        "model_type": "internlmxcomposer2", "vocab_size": V, "hidden_size": H,
+        "intermediate_size": I, "num_hidden_layers": 1,
+        "num_attention_heads": 4, "num_key_value_heads": Hkv,
+    })
+    from bigdl_tpu.api import TpuModel
+    from bigdl_tpu.convert import params_from_state_dict
+
+    params = params_from_state_dict(config, sd.__getitem__, qtype="bf16",
+                                    dtype=jnp.float32)
+    out = TpuModel(config, params, "bf16").generate([[3, 1, 4]], max_new_tokens=4)
+    assert out.shape == (1, 4)
+
+
+def test_megrezo_text_path_ingests():
+    """Megrez-3B-Omni: llama llm under the `llm.` prefix (reference
+    convert.py:1042-1047 rewrites llm model_type to llama; towers load
+    separately)."""
+    rng = np.random.default_rng(5)
+    H, I, V = 32, 48, 64
+    sd = {
+        "llm.model.embed_tokens.weight": rng.standard_normal((V, H)).astype(np.float32),
+        "llm.model.norm.weight": np.ones(H, np.float32),
+        "llm.lm_head.weight": rng.standard_normal((V, H)).astype(np.float32) * 0.1,
+    }
+    p = "llm.model.layers.0."
+    for name, shape in (
+        ("self_attn.q_proj.weight", (H, H)), ("self_attn.k_proj.weight", (16, H)),
+        ("self_attn.v_proj.weight", (16, H)), ("self_attn.o_proj.weight", (H, H)),
+        ("mlp.gate_proj.weight", (I, H)), ("mlp.up_proj.weight", (I, H)),
+        ("mlp.down_proj.weight", (H, I)),
+    ):
+        sd[p + name] = rng.standard_normal(shape).astype(np.float32) * 0.05
+    sd[p + "input_layernorm.weight"] = np.ones(H, np.float32)
+    sd[p + "post_attention_layernorm.weight"] = np.ones(H, np.float32)
+
+    config = ModelConfig.from_hf_config({
+        "model_type": "megrezo", "vocab_size": V, "hidden_size": H,
+        "intermediate_size": I, "num_hidden_layers": 1,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+    })
+    from bigdl_tpu.api import TpuModel
+    from bigdl_tpu.convert import params_from_state_dict
+
+    params = params_from_state_dict(config, sd.__getitem__, qtype="bf16",
+                                    dtype=jnp.float32)
+    out = TpuModel(config, params, "bf16").generate([[3, 1, 4]], max_new_tokens=4)
+    assert out.shape == (1, 4)
