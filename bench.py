@@ -385,6 +385,27 @@ def child_kernels() -> dict:
 
     bank("flash_attention_window_softcap", flash_window_smoke)
 
+    # --- trainable flash (fwd-with-lse + dq + dkv), training path
+    def flash_train_smoke():
+        from bigdl_tpu.ops.pallas import flash_attention_trainable
+        import numpy as np
+
+        B, T, Hq, Hkv, D = 1, 512, 32, 8, 128
+        q = jnp.ones((B, T, Hq, D), jnp.bfloat16) * 0.01
+        k = jnp.ones((B, T, Hkv, D), jnp.bfloat16) * 0.01
+        v = jnp.ones((B, T, Hkv, D), jnp.bfloat16) * 0.01
+
+        def loss(q, k, v):
+            return jnp.sum(
+                flash_attention_trainable(q, k, v).astype(jnp.float32))
+
+        _, grads = jax.jit(lambda a, b, c: jax.value_and_grad(
+            loss, argnums=(0, 1, 2))(a, b, c))(q, k, v)
+        for g in jax.device_get(grads):
+            assert np.isfinite(np.asarray(g)).all()
+
+    bank("flash_train_fwd_bwd", flash_train_smoke)
+
     # --- paged decode attention, bf16 and fp8 pages
     def paged_smoke(quantized: bool):
         def run():

@@ -633,6 +633,16 @@ def forward(
         and uniform_window and not config.alibi
         and cache.pos.ndim == 0  # kernel takes a scalar q_offset
     )
+    # training (cache=None): the differentiable flash kernel
+    # (ops/pallas/flash_backward.py) — the backward recomputes attention
+    # blockwise instead of saving the [T, T] probabilities, which is
+    # what lets long-context single-chip finetuning fit in HBM
+    use_flash_train = (
+        cache is None and T > 1 and use_pallas()
+        and uniform_window and not config.alibi
+        and attention_override is None
+        and config.attn_logit_softcap is None
+    )
 
     # Attention masks (shared by all layers, computed once outside the scan).
     # With sliding-window alternation (gemma2) both the global and the
@@ -670,7 +680,7 @@ def forward(
         and attention_override is None
     )
 
-    if use_flash or use_paged_kernel:
+    if use_flash or use_paged_kernel or use_flash_train:
         mask_global = mask_sliding = None
         alibi_bias = None
     else:
@@ -768,6 +778,13 @@ def forward(
             )[:, None]
         elif attention_override is not None and c is None:
             attn = attention_override(q, k_att, v_att, row_start)
+        elif use_flash_train:
+            from bigdl_tpu.ops.pallas import flash_attention_trainable
+
+            attn = flash_attention_trainable(
+                q, k_att, v_att, row_start,
+                window=config.sliding_window, scale=config.attn_scale,
+            )
         elif use_flash:
             from bigdl_tpu.ops.pallas import flash_attention
 

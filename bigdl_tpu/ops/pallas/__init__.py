@@ -39,6 +39,9 @@ def interpret_mode() -> bool:
 
 
 from bigdl_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
+from bigdl_tpu.ops.pallas.flash_backward import (  # noqa: E402
+    flash_attention_trainable,
+)
 from bigdl_tpu.ops.pallas.paged_attention import (  # noqa: E402
     paged_decode_attention,
 )
@@ -48,6 +51,7 @@ from bigdl_tpu.ops.pallas.qmatmul import (  # noqa: E402
 )
 
 __all__ = ["use_pallas", "interpret_mode", "flash_attention",
+           "flash_attention_trainable",
            "paged_decode_attention", "qmatmul_int4", "qmatmul_codebook",
            "qmatmul_int8", "qmatmul_asym_int4", "qmatmul_q4k",
            "qmatmul_q6k"]

@@ -142,6 +142,33 @@ def smoke_attn():
         results["flash"] = dict(ok=False, error=repr(e)[:300])
         log(f"flash: FAIL {repr(e)[:200]}")
 
+    # trainable flash: forward-with-lse + dq + dkv kernels (training path)
+    try:
+        from bigdl_tpu.ops.pallas import flash_attention_trainable
+
+        B, T, Hq, Hkv, D = 1, 512, 32, 8, 128
+        q = jnp.ones((B, T, Hq, D), jnp.bfloat16) * 0.01
+        k = jnp.ones((B, T, Hkv, D), jnp.bfloat16) * 0.01
+        v = jnp.ones((B, T, Hkv, D), jnp.bfloat16) * 0.01
+        t0 = time.time()
+
+        def loss(q, k, v):
+            return jnp.sum(
+                flash_attention_trainable(q, k, v).astype(jnp.float32)
+            )
+
+        val, grads = jax.jit(
+            lambda q, k, v: jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        )(q, k, v)
+        grads = jax.device_get(grads)
+        dt = time.time() - t0
+        assert all(np.isfinite(np.asarray(g)).all() for g in grads)
+        results["flash_train"] = dict(ok=True, compile_s=round(dt, 1))
+        log(f"flash_train: OK compile={dt:.1f}s (fwd+dq+dkv)")
+    except Exception as e:
+        results["flash_train"] = dict(ok=False, error=repr(e)[:300])
+        log(f"flash_train: FAIL {repr(e)[:200]}")
+
     # paged decode kernel, fp8 + bf16 pages
     for fp8 in (False, True):
         name = f"paged_fp8={fp8}"
