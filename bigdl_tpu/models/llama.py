@@ -521,6 +521,9 @@ def forward(
     # only — prefill skips the [B,T,V] logits (reference
     # reshape_lm_head_input / IPEX_LLM_LAST_LM_HEAD,
     # low_bit_linear.py:262-270)
+    remat: bool = False,  # static: jax.checkpoint each scan layer —
+    # backward recomputes the layer instead of saving its activations
+    # (long-context training memory lever; make_train_step(remat=True))
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache with pos advanced).
 
@@ -847,8 +850,14 @@ def forward(
         return (hidden, c, idx + 1), ys
 
     xs = (params["layers"], lora["layers"]) if lora is not None else params["layers"]
+    scan_body = body
+    if remat:
+        # recompute the layer in the backward instead of saving its
+        # activations; prevent_cse is the documented setting for remat
+        # inside scan (jax.checkpoint docs)
+        scan_body = jax.checkpoint(body, prevent_cse=False)
     (h, cache, _), obs = jax.lax.scan(
-        body, (h, cache, jnp.zeros((), jnp.int32)), xs
+        scan_body, (h, cache, jnp.zeros((), jnp.int32)), xs
     )
 
     if return_hidden:

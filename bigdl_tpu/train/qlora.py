@@ -170,6 +170,7 @@ def make_train_step(
     ring_mesh=None,
     ring_axis: str = "sp",
     batch_axis: str = "dp",
+    remat: bool = False,
 ):
     """Returns jittable step(params, lora, opt_state, tokens, loss_mask) ->
     (lora, opt_state, loss). Only lora['layers'] is trained (the alpha/rank
@@ -180,6 +181,11 @@ def make_train_step(
     input token grid — sequence-parallel training: embedding/norm/MLP run
     on sequence shards; without ring_mesh XLA all-gathers KV around
     attention.
+
+    remat=True checkpoints each decoder layer (jax.checkpoint around the
+    scan body): the backward recomputes the layer instead of saving its
+    activations — with the flash-train kernel this makes per-layer saved
+    state O(B*T*H) instead of O(B*T*(3H+2I)), the long-context lever.
 
     ring_mesh: pass the Mesh to replace those all-gathers with ring
     attention (parallel/ring.py) — each device keeps 1/sp of the KV and
@@ -225,13 +231,14 @@ def make_train_step(
         )
 
     inner_forward = forward_fn
-    if seq_spec is not None or attention_override is not None:
+    if seq_spec is not None or attention_override is not None or remat:
         def inner_forward(cfg, params, toks, cache, lora=None):
             if seq_spec is not None:
                 toks = jax.lax.with_sharding_constraint(toks, seq_spec)
+            kw = {"remat": True} if remat else {}
             return forward_fn(
                 cfg, params, toks, cache, lora=lora,
-                attention_override=attention_override,
+                attention_override=attention_override, **kw,
             )
 
     def step(params, lora, opt_state, tokens, loss_mask):

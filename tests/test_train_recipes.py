@@ -337,3 +337,28 @@ def test_watchdog_hard_exits_blocked_process():
     )
     assert proc.returncode == StepWatchdog.EXIT_CODE, proc.stderr[-300:]
     assert b"watchdog" in proc.stderr
+
+
+def test_remat_matches_plain_step(rng, qbase):
+    """remat=True (jax.checkpoint per scan layer) must change memory, not
+    math: loss and updated adapters match the plain step bit-for-bit up
+    to fp tolerance."""
+    toks = _tokens(rng)
+    mask = jnp.ones_like(toks, jnp.float32)
+    optimizer = optax.sgd(1e-2)
+
+    outs = []
+    for remat in (False, True):
+        lora = init_lora(CFG, jax.random.PRNGKey(1), rank=4)
+        state = optimizer.init(lora["layers"])
+        step = jax.jit(make_train_step(CFG, llama.forward, optimizer,
+                                       remat=remat))
+        lora, state, loss = step(qbase, lora, state, toks, mask)
+        outs.append((float(loss), lora["layers"]))
+
+    assert np.isclose(outs[0][0], outs[1][0], rtol=1e-5, atol=1e-6)
+    flat0 = jax.tree.leaves(outs[0][1])
+    flat1 = jax.tree.leaves(outs[1][1])
+    for a, b in zip(flat0, flat1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
