@@ -184,7 +184,7 @@ def cmd_serve(args):
         model, tokenizer=tok, host=args.host,
         port=args.port, n_slots=args.slots, max_len=args.max_len, gen=gen,
         paged=args.paged, speculative=args.speculative,
-        draft_k=args.draft_k,
+        draft_k=args.draft_k, adaptive_draft=args.adaptive_draft,
     )
     server.start()
     print(f"bigdl-tpu serving {args.model} on {args.host}:{server.port}")
@@ -315,6 +315,9 @@ def main(argv=None):
                    help="in-engine speculative decoding (sym_int4 "
                         "self-draft; needs an unquantized model load)")
     s.add_argument("--draft-k", type=int, default=4)
+    s.add_argument("--adaptive-draft", action="store_true",
+                   help="steer draft length from recent acceptance "
+                        "(ladder of compiled K programs)")
     s.add_argument("--paged", action="store_true",
                    help="paged KV pool + prefix caching")
     s.set_defaults(fn=cmd_serve)

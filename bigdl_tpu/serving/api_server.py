@@ -117,6 +117,7 @@ class ApiServer:
         speculative: bool = False,  # in-engine draft-K-then-verify
         draft_params=None,  # None = sym_int4 self-draft of the model
         draft_k: int = 4,
+        adaptive_draft: bool = False,  # acceptance-steered draft length
         journal: Optional[str] = None,  # crash-recovery request journal
     ):
         from bigdl_tpu.serving.metrics import Metrics
@@ -125,7 +126,7 @@ class ApiServer:
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, page_size=page_size, n_pages=n_pages,
             speculative=speculative, draft_params=draft_params,
-            draft_k=draft_k, journal=journal,
+            draft_k=draft_k, adaptive_draft=adaptive_draft, journal=journal,
         )
         self.tokenizer = tokenizer
         self.whisper = whisper

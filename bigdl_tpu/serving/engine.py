@@ -89,6 +89,7 @@ class InferenceEngine:
         speculative: bool = False,
         draft_params=None,
         draft_k: int = 4,
+        adaptive_draft: bool = False,
         quantize_kv: bool = False,
         journal: Optional[str] = None,
     ):
@@ -276,12 +277,55 @@ class InferenceEngine:
             # dense [slots, max_len] draft pool keeps the verify-round
             # rollback a per-row pos subtraction in both pools
             self.dcache = self._make_pool(force_dense=True)
-            self._spec_decode = self._with_mesh(jax.jit(
+            spec_jit = jax.jit(
                 functools.partial(self._spec_decode_impl, fwd),
+                static_argnums=(0,),  # k_draft: ladder of compiled programs
                 donate_argnames=("cache", "dcache", "seen"),
-            ))
+            )
+            self._spec_decode = self._with_mesh(spec_jit)
             self.spec_rounds = 0  # verify rounds run
             self.spec_emitted = 0  # tokens emitted by those rounds
+            # adaptive draft length (reference speculative.py's adaptive
+            # th_stop_draft tunes drafting from recent acceptance; a
+            # static-K XLA program cannot stop mid-draft, so this
+            # switches between a few compiled K programs instead)
+            ks = {draft_k}
+            if adaptive_draft:
+                k_ = draft_k
+                while k_ > 2:
+                    k_ = max(2, k_ // 2)
+                    ks.add(k_)
+            self._k_ladder = sorted(ks)
+            self._cur_k = draft_k
+            self._accept_ema: Optional[float] = None
+            self._spec_exec = None
+            if adaptive_draft:
+                # AOT-compile every ladder program NOW: the first ladder
+                # switch must not stall in-flight streams on a
+                # mid-serving XLA compile. lower() only reads avals (no
+                # donation of the live pools); the compiled executables
+                # stay valid across _reset_state (same shapes).
+                import contextlib
+
+                ctx = (jax.set_mesh(self._mesh) if self._mesh is not None
+                       else contextlib.nullcontext())
+                args = (self.model.params, self._draft_params, self.cur,
+                        self.cache, self.dcache, jax.random.PRNGKey(0),
+                        jnp.asarray(self._temp), jnp.asarray(self._topk),
+                        jnp.asarray(self._topp),
+                        jnp.asarray(self._dosample), self.seen,
+                        jnp.asarray(self._penalty))
+                with ctx:
+                    self._spec_exec = {
+                        k_: spec_jit.lower(k_, *args).compile()
+                        for k_ in self._k_ladder
+                    }
+        elif adaptive_draft:
+            raise ValueError(
+                "adaptive_draft steers the speculative draft length — "
+                "pass speculative=True (CLI: --speculative) to enable it"
+            )
+        self.adaptive_draft = adaptive_draft
         self._waiting: Optional[Request] = None  # paged OOM retry slot
         # rids whose client went away (stop-string hit, disconnect):
         # handler threads add, the engine thread frees the slot at the
@@ -455,8 +499,9 @@ class InferenceEngine:
         seen = seen.at[jnp.arange(seen.shape[0]), nxt].set(True)
         return nxt, cache, seen
 
-    def _spec_decode_impl(self, forward, params, dparams, cur, cache, dcache,
-                          key, temp, topk, topp, dosample, seen, penalty):
+    def _spec_decode_impl(self, forward, k_draft, params, dparams, cur, cache,
+                          dcache, key, temp, topk, topp, dosample, seen,
+                          penalty):
         """One speculative round for the whole slot pool. Returns
         (choice [B, K], n_acc [B], cur' [B], cache, dcache, seen):
         slot b emits choice[b, :n_acc[b]+1].
@@ -476,7 +521,7 @@ class InferenceEngine:
         from bigdl_tpu.generate import apply_repetition_penalty
 
         cfg = self.config
-        K = self.draft_k
+        K = k_draft  # static: one compiled program per ladder value
 
         def draft_step(carry, _):
             tok, dc = carry
@@ -1012,8 +1057,11 @@ class InferenceEngine:
         self._reap_cancelled()
         self._admit()
         if self.paged:
+            # reserve for the CURRENT ladder K (== draft_k when not
+            # adaptive): after a downshift the round writes at most
+            # _cur_k tokens before rollback, so tighter is still safe
             self._ensure_decode_pages(
-                self.draft_k if self.speculative else 1
+                self._cur_k if self.speculative else 1
             )
             if self._bt_dirty:
                 self.cache = dataclasses.replace(
@@ -1051,15 +1099,17 @@ class InferenceEngine:
     def _step_speculative(self, k) -> bool:
         """Draft-K-then-verify round: each live slot emits 1..draft_k
         tokens (its accepted prefix + the target's bonus token)."""
+        if self._spec_exec is not None:  # pre-compiled ladder program
+            fn = self._spec_exec[self._cur_k]
+        else:
+            fn = functools.partial(self._spec_decode, self._cur_k)
         try:
-            choice, n_acc, cur2, self.cache, self.dcache, self.seen = (
-                self._spec_decode(
-                    self.model.params, self._draft_params, self.cur,
-                    self.cache, self.dcache, k,
-                    jnp.asarray(self._temp), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), jnp.asarray(self._dosample),
-                    self.seen, jnp.asarray(self._penalty),
-                )
+            choice, n_acc, cur2, self.cache, self.dcache, self.seen = fn(
+                self.model.params, self._draft_params, self.cur,
+                self.cache, self.dcache, k,
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), jnp.asarray(self._dosample),
+                self.seen, jnp.asarray(self._penalty),
             )
         except Exception:
             self.fail_all("speculative decode step failed")
@@ -1069,6 +1119,8 @@ class InferenceEngine:
         choice_h = np.asarray(choice)
         n_acc_h = np.asarray(n_acc)
         self.spec_rounds += 1
+        if self.adaptive_draft:
+            self._adapt_draft_k(n_acc_h[self.active])
         for i in np.nonzero(self.active)[0]:
             i = int(i)
             s = self._slots[i]
@@ -1081,6 +1133,26 @@ class InferenceEngine:
                 if not self.active[i]:  # EOS or budget hit mid-round
                     break
         return True
+
+    def _adapt_draft_k(self, n_acc: np.ndarray) -> None:
+        """Steer the draft length along the compiled-K ladder from an
+        EMA of the per-round acceptance fraction. Output is unchanged by
+        construction (speculative decoding is exact at any K); only the
+        draft-compute : emitted-token ratio moves."""
+        if n_acc.size == 0:
+            return
+        frac = float(np.mean(n_acc)) / max(self._cur_k - 1, 1)
+        self._accept_ema = (
+            frac if self._accept_ema is None
+            else 0.7 * self._accept_ema + 0.3 * frac
+        )
+        idx = self._k_ladder.index(self._cur_k)
+        if self._accept_ema < 0.35 and idx > 0:
+            self._cur_k = self._k_ladder[idx - 1]
+            self._accept_ema = None  # re-measure at the new K
+        elif self._accept_ema > 0.75 and idx < len(self._k_ladder) - 1:
+            self._cur_k = self._k_ladder[idx + 1]
+            self._accept_ema = None
 
     def _fail_request(self, req: Request, msg: str) -> None:
         """Terminal failure for a request not (or no longer) in a slot."""

@@ -414,3 +414,29 @@ def test_speculative_paged_fp8_composes(model):
     out = _run(eng, prompts, maxnt=10)
     assert out == ref
     assert eng.spec_rounds > 0 and eng.spec_emitted / eng.spec_rounds > 1.0
+
+
+def test_adaptive_draft_over_paged_matches_plain(model):
+    """adaptive_draft composes with the paged pool: output byte-identical
+    to plain serving, page reservation follows the CURRENT ladder K, and
+    a forced downshift keeps serving correctly."""
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8], [11, 12, 13]]
+    ref = _run(InferenceEngine(model, n_slots=2, max_len=128), prompts,
+               maxnt=12)
+    eng = InferenceEngine(
+        model, n_slots=2, max_len=128, paged=True, page_size=16,
+        speculative=True, draft_params=model.params, draft_k=4,
+        adaptive_draft=True,
+    )
+    out = _run(eng, prompts, maxnt=12)
+    assert out == ref
+
+    # force a downshift and serve again — still byte-identical
+    eng2 = InferenceEngine(
+        model, n_slots=2, max_len=128, paged=True, page_size=16,
+        speculative=True, draft_params=model.params, draft_k=4,
+        adaptive_draft=True,
+    )
+    eng2._cur_k = 2
+    out2 = _run(eng2, prompts, maxnt=12)
+    assert out2 == ref

@@ -552,3 +552,42 @@ def test_journal_recovery_replays_unfinished(model, tmp_path):
     # engine finds nothing to replay
     eng3 = InferenceEngine(model, n_slots=2, max_len=128, journal=jpath)
     assert eng3.recovered_requests == []
+
+
+def test_engine_adaptive_draft_identical_and_ladder(model):
+    """adaptive_draft=True must not change output (speculative decoding
+    is exact at any K — the ladder only moves draft compute); here the
+    draft IS the target so acceptance is ~always full and K climbs or
+    stays at the top of the ladder."""
+    want = {
+        tuple(p): model.generate([p], max_new_tokens=12)[0].tolist()
+        for p in PROMPTS
+    }
+    eng = InferenceEngine(
+        model, n_slots=2, max_len=128, speculative=True,
+        draft_params=model.params, draft_k=4, adaptive_draft=True,
+    )
+    assert eng._k_ladder == [2, 4]
+    reqs = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle(max_steps=300)
+    for p, r in zip(PROMPTS, reqs):
+        assert r.done
+        assert r.out_tokens == want[tuple(p)], (p, r.out_tokens)
+    assert eng._cur_k == 4  # full acceptance never downshifts
+
+    # ladder steering unit check: sustained low acceptance downshifts,
+    # then sustained full acceptance climbs back
+    import numpy as np
+
+    eng._cur_k, eng._accept_ema = 4, None
+    for _ in range(8):
+        eng._adapt_draft_k(np.zeros(2, np.int32))
+    assert eng._cur_k == 2
+    for _ in range(8):
+        eng._adapt_draft_k(np.full(2, eng._cur_k - 1, np.int32))
+    assert eng._cur_k == 4
+
+
+def test_adaptive_draft_requires_speculative(model):
+    with pytest.raises(ValueError, match="adaptive_draft"):
+        InferenceEngine(model, n_slots=2, max_len=64, adaptive_draft=True)
