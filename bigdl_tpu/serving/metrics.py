@@ -130,5 +130,9 @@ class Metrics:
                     "verify rounds",
                     "# TYPE bigdl_tpu_spec_emitted_total counter",
                     f"bigdl_tpu_spec_emitted_total {self.engine.spec_emitted}",
+                    "# HELP bigdl_tpu_spec_draft_k current draft length "
+                    "(ladder-steered when adaptive_draft)",
+                    "# TYPE bigdl_tpu_spec_draft_k gauge",
+                    f"bigdl_tpu_spec_draft_k {self.engine._cur_k}",
                 ]
         return "\n".join(lines) + "\n"
