@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -180,11 +181,21 @@ def cmd_serve(args):
     gen = GenerationConfig(
         eos_token_id=(tok.eos_token_id if tok is not None else None)
     )
+    embedder = None
+    if args.embedder:
+        from bigdl_tpu.convert.hf import open_checkpoint
+        from bigdl_tpu.models import bert as B
+
+        with open(os.path.join(args.embedder, "config.json")) as f:
+            bcfg = B.BertConfig.from_hf_config(json.load(f))
+        get = open_checkpoint(args.embedder)
+        embedder = (bcfg, B.params_from_hf(bcfg, get), _tokenizer(args.embedder))
     server = ApiServer(
         model, tokenizer=tok, host=args.host,
         port=args.port, n_slots=args.slots, max_len=args.max_len, gen=gen,
         paged=args.paged, speculative=args.speculative,
         draft_k=args.draft_k, adaptive_draft=args.adaptive_draft,
+        embedder=embedder,
     )
     server.start()
     print(f"bigdl-tpu serving {args.model} on {args.host}:{server.port}")
@@ -318,6 +329,8 @@ def main(argv=None):
     s.add_argument("--adaptive-draft", action="store_true",
                    help="steer draft length from recent acceptance "
                         "(ladder of compiled K programs)")
+    s.add_argument("--embedder", default=None,
+                   help="bert checkpoint dir: enables POST /v1/embeddings")
     s.add_argument("--paged", action="store_true",
                    help="paged KV pool + prefix caching")
     s.set_defaults(fn=cmd_serve)

@@ -173,9 +173,12 @@ def embed_texts(
     texts: list[str],
     max_length: int = 256,
     normalize: bool = True,
-) -> np.ndarray:
+    return_usage: bool = False,
+):
     """[n, H] sentence embeddings (mean-pooled, optionally L2-normalized)
-    — the LangChain embeddings entry point."""
+    — the LangChain embeddings entry point. return_usage=True also
+    returns the POST-truncation token count (what was actually encoded —
+    the serving usage field must not re-tokenize or overreport)."""
     enc = [tokenizer.encode(t)[:max_length] for t in texts]
     T = max(len(e) for e in enc)
     ids = np.zeros((len(enc), T), np.int32)
@@ -189,4 +192,6 @@ def embed_texts(
         emb = emb / jnp.maximum(
             jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9
         )
+    if return_usage:
+        return np.asarray(emb), sum(len(e) for e in enc)
     return np.asarray(emb)

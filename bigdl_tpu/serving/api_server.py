@@ -111,6 +111,7 @@ class ApiServer:
         gen=None,
         whisper=None,  # (WhisperConfig, params) enables /v1/audio/*
         whisper_tokenizer=None,
+        embedder=None,  # (BertConfig, params, tokenizer): /v1/embeddings
         paged: bool = False,  # paged KV pool + prefix caching (kvpaged.py)
         page_size: int = 64,
         n_pages=None,
@@ -131,11 +132,13 @@ class ApiServer:
         self.tokenizer = tokenizer
         self.whisper = whisper
         self.whisper_tokenizer = whisper_tokenizer
+        self.embedder = embedder
         self.metrics = Metrics(self.engine)
         # serializes whisper device work: handler threads must not race
         # each other (or pile unbounded compute onto the chip) the way
         # the engine thread already serializes text decode
         self._whisper_lock = threading.Lock()
+        self._embed_lock = threading.Lock()
         self.worker = _EngineThread(self.engine)
         outer = self
 
@@ -202,6 +205,7 @@ class ApiServer:
             _KNOWN_POSTS = {
                 "/generate", "/generate_stream", "/v1/completions",
                 "/v1/chat/completions", "/v1/audio/transcriptions",
+                "/v1/embeddings",
             }
 
             def do_POST(self):
@@ -239,6 +243,8 @@ class ApiServer:
                 is_tgi = "parameters" in payload or (
                     "inputs" in payload and "prompt" not in payload
                 )
+                if self.path == "/v1/embeddings":
+                    return self._embeddings(payload)
                 if self.path == "/generate":
                     if is_tgi:
                         return self._tgi_generate(payload, stream=False)
@@ -353,6 +359,44 @@ class ApiServer:
                     elif pending is not None:
                         emit(*pending, "".join(pieces))
                 return None
+
+            def _embeddings(self, payload):
+                """OpenAI embeddings schema over the bert encoder
+                (models/bert.py embed_texts — the same entry point the
+                LangChain integration wraps)."""
+                if outer.embedder is None:
+                    return self._json(
+                        400, {"error": "no embedding model loaded (pass "
+                              "embedder=(config, params, tokenizer) to "
+                              "ApiServer)"}
+                    )
+                texts = payload.get("input")
+                if isinstance(texts, str):
+                    texts = [texts]
+                if (not isinstance(texts, list) or not texts
+                        or not all(isinstance(t, str) for t in texts)):
+                    return self._json(
+                        400,
+                        {"error": "input must be a string or list of strings"},
+                    )
+                from bigdl_tpu.models import bert as BERT
+
+                bcfg, bparams, btok = outer.embedder
+                with outer._embed_lock:
+                    emb, n_tok = BERT.embed_texts(
+                        bcfg, bparams, btok, texts, return_usage=True
+                    )
+                return self._json(200, {
+                    "object": "list",
+                    "data": [
+                        {"object": "embedding", "index": i,
+                         "embedding": e.tolist()}
+                        for i, e in enumerate(emb)
+                    ],
+                    "model": payload.get("model", "bigdl-tpu-embed"),
+                    "usage": {"prompt_tokens": n_tok,
+                              "total_tokens": n_tok},
+                })
 
             def _transcribe(self, raw: bytes):
                 if outer.whisper is None:

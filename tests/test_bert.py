@@ -110,3 +110,82 @@ def test_embed_texts(hf_pair):
     # deterministic
     embs2 = B.embed_texts(config, params, StubTok(), ["hello world", "hi"])
     np.testing.assert_allclose(embs, embs2)
+
+
+def test_embeddings_endpoint(hf_pair):
+    """OpenAI /v1/embeddings route over the bert encoder."""
+    import json
+    import urllib.request
+
+    import jax
+
+    from bigdl_tpu.api import TpuModel, optimize_model
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+    from bigdl_tpu.serving.api_server import ApiServer
+
+    hf_cfg, hf_model, _ = hf_pair
+    config = B.BertConfig.from_hf_config(hf_cfg.to_dict())
+    sd = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+    params = B.params_from_hf(config, sd.__getitem__)
+    cfg = PRESETS["tiny-llama"]
+    model = TpuModel(cfg, optimize_model(
+        llama.init_params(cfg, jax.random.PRNGKey(1)), cfg
+    ), "sym_int4")
+    server = ApiServer(model, port=0, n_slots=2, max_len=128,
+                       embedder=(config, params, StubTok()))
+    server.start()
+    try:
+        port = server.httpd.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/embeddings",
+            data=json.dumps({"input": ["hello world", "hi"]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        assert out["object"] == "list" and len(out["data"]) == 2
+        v = np.asarray(out["data"][0]["embedding"], np.float32)
+        assert v.ndim == 1 and np.isfinite(v).all()
+        assert abs(float(np.linalg.norm(v)) - 1.0) < 1e-3  # normalized
+        assert out["usage"]["prompt_tokens"] > 0
+
+        # string input + error path
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/embeddings",
+            data=json.dumps({"input": "solo"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        assert len(out["data"]) == 1
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/embeddings",
+            data=json.dumps({"input": []}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.shutdown()
+
+
+def test_embedder_checkpoint_dir_loads(hf_pair, tmp_path):
+    """The CLI --embedder loader path: HF-format safetensors dir ->
+    open_checkpoint -> params_from_hf -> embed."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    hf_cfg, hf_model, _ = hf_pair
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
+
+    from bigdl_tpu.convert.hf import open_checkpoint
+
+    config = B.BertConfig.from_hf_config(hf_cfg.to_dict())
+    params = B.params_from_hf(config, open_checkpoint(str(tmp_path)))
+    emb = B.embed_texts(config, params, StubTok(), ["hello"])
+    assert emb.shape == (1, 64) and np.isfinite(emb).all()
