@@ -798,11 +798,13 @@ def main() -> None:
     best = (decoded[-1] if decoded else banked[-1])[1] if banked else None
 
     if decoded and remaining() > 200:
-        # train MFU on the biggest preset that already decoded fine.
+        # train MFU on BASELINE's named recipe (Mistral-7B QLoRA,
+        # >= 50% MFU north star) — children transfer their own weights,
+        # so this costs nothing extra vs reusing the decoded preset.
         # Reserve a serve slot only when the window is generous: on an
         # r03-class slow-compile day train still gets everything it
         # would have before (remaining - 30); never capped below 360s.
-        preset = decoded[-1][0]
+        preset = "mistral-7b"
         budget = (remaining() - 210) if remaining() > 570 else (remaining() - 30)
         res = guarded("train", preset, budget)
         if isinstance(res, dict):
