@@ -1,0 +1,133 @@
+"""bench.py parent orchestration tests (no device, children mocked).
+
+The bench ladder is the round's one shot at silicon numbers — an
+orchestration bug (stage results dropped on merge, wrong emit on
+deadline) would waste a live window invisibly. These tests drive
+main() with canned child results and assert exactly what lands in the
+single emitted JSON line.
+"""
+
+import importlib.util
+import json
+import pathlib
+import signal
+import time
+
+import pytest
+
+pytestmark = pytest.mark.core
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    path = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+    spec = importlib.util.spec_from_file_location("bench_under_test",
+                                                  str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # fresh wall clock so remaining() is the full budget
+    monkeypatch.setattr(mod, "T0", time.time())
+    # capture handlers instead of arming a real SIGALRM in the test runner
+    handlers = {}
+    monkeypatch.setattr(signal, "signal",
+                        lambda sig, h: handlers.__setitem__(sig, h))
+    monkeypatch.setattr(signal, "alarm", lambda *a, **k: None)
+    monkeypatch.setattr(mod, "wait_for_tunnel", lambda *a, **k: True)
+    mod._test_handlers = handlers
+    return mod
+
+
+def run_main(bench, results, capsys):
+    """results: dict mode -> child result (dict | 'error' | None)."""
+    calls = []
+
+    def fake_run_child(mode, preset, budget, extra_env=None):
+        calls.append((mode, preset))
+        res = results.get(mode)
+        if callable(res):
+            res = res(preset)
+        return res, False
+
+    bench.run_child = fake_run_child
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    # emit() prints exactly one JSON line; log() lines go to stderr
+    payload = json.loads(out[-1])
+    return payload, calls, e.value.code
+
+
+def test_all_stages_merge_into_one_line(bench, capsys):
+    values = {"tiny-llama": 0.05, "llama2-7b": 18.0, "llama3-8b": 15.0}
+    decode = lambda preset: {
+        "metric": f"{preset}_sym_int4_decode_latency",
+        "value": values[preset],
+        "unit": "ms/token", "vs_baseline": 1.33, "tokens_per_s": 66.7,
+    }
+    results = {
+        "decode": decode,
+        "kernels": {"metric": "pallas_kernel_matrix", "value": 9,
+                    "unit": "kernels_ok_of_10", "vs_baseline": 0,
+                    "kernels": {"k": {"ok": True}}},
+        "train": {"metric": "train", "train_mfu": 0.52},
+        "serve": {"metric": "x_paged_serve_throughput", "value": 480.0,
+                  "serve_batch": 8, "serve_step_ms": 16.6},
+    }
+    payload, calls, code = run_main(bench, results, capsys)
+    assert code == 0
+    # headline is the LAST decoded preset, not the first banked
+    assert payload["metric"] == "llama3-8b_sym_int4_decode_latency"
+    assert payload["value"] == 15.0
+    # train fields merged in (metric key stripped)
+    assert payload["train_mfu"] == 0.52
+    # serve fields merged in
+    assert payload["serve_tokens_per_s"] == 480.0
+    assert payload["serve_batch"] == 8
+    # kernel matrix attached
+    assert payload["kernel_matrix"] == {"k": {"ok": True}}
+    # train runs the BASELINE mistral recipe
+    assert ("train", "mistral-7b") in calls
+
+
+def test_all_children_dead_emits_bench_failed(bench, capsys):
+    payload, _, code = run_main(bench, {}, capsys)
+    assert code == 1
+    assert payload["metric"] == "bench_failed"
+
+
+def test_kernel_matrix_alone_still_banks(bench, capsys):
+    results = {
+        "kernels": {"metric": "pallas_kernel_matrix", "value": 3,
+                    "unit": "kernels_ok_of_10", "vs_baseline": 0,
+                    "kernels": {"k": {"ok": True}}},
+    }
+    payload, _, code = run_main(bench, results, capsys)
+    assert code == 0
+    assert payload["metric"] == "pallas_kernel_matrix"
+
+
+def test_deadline_emits_decoded_headline_with_merged_fields(bench, capsys):
+    """A late-stage overrun fires on_deadline: the emitted line must be
+    the decoded headline INCLUDING fields already merged in place —
+    never a bare kernels entry (review finding, round 5)."""
+    def serve_hangs(preset):
+        # the serve stage "hangs" and the parent deadline fires:
+        # on_deadline must emit the decoded headline with the
+        # already-banked train field, then exit
+        bench._test_handlers[signal.SIGALRM](None, None)
+        raise AssertionError("unreachable: deadline exited")
+
+    results = {
+        "decode": lambda preset: {
+            "metric": f"{preset}_decode", "value": 15.0,
+            "unit": "ms/token", "vs_baseline": 1.33},
+        "kernels": {"metric": "pallas_kernel_matrix", "value": 1,
+                    "unit": "u", "vs_baseline": 0,
+                    "kernels": {"k": {"ok": True}}},
+        "train": {"metric": "train", "train_mfu": 0.5},
+        "serve": serve_hangs,
+    }
+    payload, _, code = run_main(bench, results, capsys)
+    assert code == 0
+    assert payload["metric"].endswith("_decode")
+    assert payload["train_mfu"] == 0.5  # merged in place before the hang
