@@ -18,6 +18,7 @@ TPU-relevant subset):
     speculative        — bf16 target + int4 self-draft
     lookup             — prompt-lookup decoding
     serving_engine     — continuous-batching engine throughput
+    speculative_serving — engine with speculative + paged + adaptive draft
     paged_serving      — engine with paged KV pool + prefix caching
     tensor_parallel    — sym_int4 sharded over a tp mesh (cfg key `tp`,
                           default all devices; reference Deepspeed-AutoTP
@@ -42,6 +43,7 @@ QTYPE_FOR_API = {
     "fp8_kv": "sym_int4",
     "compress_kv": "sym_int4",
     "speculative": "bf16",
+    "speculative_serving": "bf16",  # fp-target + int4 self-draft
     "lookup": "sym_int4",
     "serving_engine": "sym_int4",
     "paged_serving": "sym_int4",
@@ -100,17 +102,32 @@ def run_case(model, api: str, in_len: int, out_len: int, batch: int,
             "peak_memory_bytes": None,
         }
 
-    if api in ("serving_engine", "paged_serving"):
+    if api in ("serving_engine", "paged_serving", "speculative_serving"):
         from bigdl_tpu.serving.engine import InferenceEngine
 
+        spec = api == "speculative_serving"
         eng = InferenceEngine(model, n_slots=batch, max_len=in_len + out_len + 64,
-                              paged=(api == "paged_serving"))
+                              paged=(api != "serving_engine"),
+                              speculative=spec,  # engine auto-builds the
+                              adaptive_draft=spec)  # sym_int4 self-draft
         reqs = [eng.submit(p, max_new_tokens=out_len) for p in prompts]
-        eng.step()  # includes prefill admission
+        eng.step()  # warm-up: admission compile + first decode round
+        # the warm step EMITS tokens (a whole draft-and-verify round in
+        # speculative mode) — only post-warm tokens may count, or the
+        # untimed round inflates tokens_per_s by up to draft_k/out_len
+        warm = sum(len(r.out_tokens) for r in reqs)
         t0 = time.perf_counter()
         eng.run_until_idle()
         dt = time.perf_counter() - t0
-        done = sum(len(r.out_tokens) for r in reqs)
+        done = sum(len(r.out_tokens) for r in reqs) - warm
+        if done == 0:
+            # everything finished inside the warm-up: time a fresh,
+            # fully-warm batch end to end instead
+            reqs = [eng.submit(p, max_new_tokens=out_len) for p in prompts]
+            t0 = time.perf_counter()
+            eng.run_until_idle()
+            dt = time.perf_counter() - t0
+            done = sum(len(r.out_tokens) for r in reqs)
         return {
             "first_cost_ms": float("nan"),
             "rest_cost_mean_ms": round(dt / max(done, 1) * 1000, 3),

@@ -132,3 +132,12 @@ def test_benchmark_html_report(tmp_path):
     assert "regress" in doc and "+15.0%" in doc
     assert "improve" in doc and "-25.0%" in doc
     assert doc.count("<tr>") == 3  # header + one row per case
+
+
+def test_run_case_speculative_serving(model):
+    """speculative_serving mode: bf16 target + auto int4 self-draft over
+    the paged pool with adaptive draft length."""
+    from benchmark.run import run_case
+
+    r = run_case(model, "speculative_serving", in_len=8, out_len=4, batch=2)
+    assert r["tokens_per_s"] > 0
