@@ -1,0 +1,224 @@
+"""Incremental multi-turn chat sessions.
+
+The reference's `llm-chat` (cli/llm-cli dispatch) and our own one-shot
+`TpuModel.generate` re-prefill the WHOLE conversation every turn — turn
+N pays O(history) prefill again. A ChatSession keeps the KV cache alive
+across turns: each send() prefills only the new tokens (bucketed for
+compile reuse; stale padded slots are masked out by the causal mask and
+overwritten later), then decodes token by token.
+
+With `streaming=(sink, window)` the cache is a fixed attention-sink
+window (bigdl_tpu/streaming.py): before each prefill the session evicts
+enough chunks to make room, and during decode the standard full-cache
+shift applies — the conversation length becomes unbounded in constant
+memory, the original StreamingLLM use case.
+
+Math note: incremental prefill is exactly equivalent to re-prefilling
+the concatenated history (same cache contents, same rope positions), so
+within the window a session's replies are byte-identical to one-shot
+`generate` on the full transcript — tested in tests/test_chat.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import kvcache
+
+_MIN_BUCKET = 16
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+class ChatSession:
+    def __init__(
+        self,
+        model,
+        max_len: int = 2048,
+        streaming: Optional[tuple] = None,  # (sink, window[, chunk])
+        compute_dtype=jnp.bfloat16,
+    ):
+        from bigdl_tpu.models import get_family
+
+        self.model = model
+        self.config = model.config
+        fam = get_family(self.config.model_type)
+        if getattr(fam, "init_cache", None) is not None:
+            raise NotImplementedError(
+                f"ChatSession supports the standard KV cache; "
+                f"{self.config.model_type} uses a family cache adapter"
+            )
+        self._forward = model.forward_fn
+        self._dtype = compute_dtype
+        self._evict = None
+        self._shift = None
+        self._sink = self._chunk = 0
+        if streaming is not None:
+            from bigdl_tpu.streaming import default_chunk, make_sink_shift
+
+            sink, window = streaming[:2]
+            chunk = (streaming[2] if len(streaming) > 2
+                     else default_chunk(window, sink))
+            max_len = window
+            self._sink, self._chunk = sink, chunk
+            self._evicts: dict[int, object] = {}  # shift-amount -> jit
+            self._evict = self._evict_by  # marker: streaming enabled
+            self._shift = jax.jit(make_sink_shift(
+                self.config, window, sink, chunk))
+        self.max_len = max_len
+        self.cache = kvcache.init_cache(
+            self.config.num_hidden_layers, 1, max_len,
+            self.config.num_key_value_heads, self.config.head_dim_,
+        )
+        self._prefill_jits: dict[int, object] = {}
+        self._decode_jit = jax.jit(
+            lambda p, t, c: self._forward(
+                self.config, p, t, c, mode="decode",
+                compute_dtype=self._dtype,
+            )
+        )
+
+    @property
+    def pos(self) -> int:
+        return int(self.cache.pos)
+
+    def reset(self) -> None:
+        """Drop the conversation but keep every compiled program."""
+        self.cache = kvcache.init_cache(
+            self.config.num_hidden_layers, 1, self.max_len,
+            self.config.num_key_value_heads, self.config.head_dim_,
+        )
+
+    def _evict_by(self, m: int):
+        """Jitted m-slot evict, cached per distinct m (the common case is
+        the standard chunk; exact-tail amounts < chunk appear when a
+        whole-chunk evict would cut into the sinks)."""
+        if m not in self._evicts:
+            from bigdl_tpu.streaming import make_evict
+
+            self._evicts[m] = jax.jit(make_evict(
+                self.config, self.max_len, self._sink, m))
+        return self._evicts[m]
+
+    def _make_room(self, n: int) -> None:
+        if self.pos + n <= self.max_len:
+            return
+        if self._evict is None:
+            raise ValueError(
+                f"conversation ({self.pos} + {n} new tokens) exceeds "
+                f"max_len={self.max_len}; start the session with "
+                "streaming=(sink, window) for unbounded chats"
+            )
+        if self._sink + n > self.max_len:
+            raise ValueError(
+                f"a single turn of {n} tokens cannot fit the streaming "
+                f"window ({self.max_len}, sink {self._sink})"
+            )
+        while self.pos + n > self.max_len:
+            avail = self.pos - self._sink  # evictable non-sink tokens
+            need = self.pos + n - self.max_len
+            m = min(self._chunk if need >= self._chunk else need, avail)
+            self.cache = self._evict_by(m)(self.cache)
+
+    def _prefill(self, ids: Sequence[int]) -> jax.Array:
+        """Append `ids` to the cache; returns the last real token's
+        logits [V]. Bucketed right-padding: the padded queries' KV lands
+        in slots the causal mask hides and later writes overwrite."""
+        n = len(ids)
+        # make room for the whole BUCKET so only power-of-two prefill
+        # shapes ever compile; fall back to the exact length when the
+        # bucket itself cannot fit (window tail / oversized turn)
+        b = _bucket(n)
+        if self._evict is None:  # bounded session: no eviction possible
+            self._make_room(n)
+        else:
+            self._make_room(b if self._sink + b <= self.max_len else n)
+        if self.pos + b > self.max_len:
+            b = n
+        if b not in self._prefill_jits:
+            self._prefill_jits[b] = jax.jit(
+                lambda p, t, c: self._forward(
+                    self.config, p, t, c, mode="prefill",
+                    compute_dtype=self._dtype, last_logits_only=False,
+                )
+            )
+        padded = np.zeros((1, b), np.int32)
+        padded[0, :n] = np.asarray(ids, np.int32)
+        pos0 = self.pos
+        logits, cache = self._prefill_jits[b](
+            self.model.params, jnp.asarray(padded), self.cache
+        )
+        # roll pos back from the bucket end to the last REAL token + 1
+        self.cache = dataclasses.replace(
+            cache, pos=jnp.asarray(pos0 + n, jnp.int32)
+        )
+        return logits[0, n - 1]
+
+    def send_stream(
+        self,
+        ids: Sequence[int],
+        max_new_tokens: int = 128,
+        eos_token_id: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+    ) -> Iterator[int]:
+        """Prefill this turn's new tokens, then yield generated ids one
+        by one (greedy when temperature == 0, else sampled). The yielded
+        reply tokens enter the cache, so the next send() only needs the
+        next user message."""
+        from bigdl_tpu.generate import GenerationConfig, sample_token
+
+        if len(ids) == 0:
+            raise ValueError("empty turn")
+        gen = GenerationConfig(
+            do_sample=temperature > 0, temperature=max(temperature, 1e-5),
+            top_k=top_k, top_p=top_p,
+        )
+        key = jax.random.PRNGKey(seed + self.pos)  # per-turn stream
+
+        def pick(lg):
+            nonlocal key
+            key, k = jax.random.split(key)
+            return int(sample_token(lg[None].astype(jnp.float32), k, gen)[0])
+
+        logits = self._prefill(ids)
+        tok = pick(logits)
+        for _ in range(max_new_tokens):
+            if self._shift is not None:
+                self.cache = self._shift(self.cache)
+            elif self.pos >= self.max_len:
+                raise ValueError(
+                    f"conversation exceeds max_len={self.max_len}; use "
+                    "streaming=(sink, window) for unbounded chats"
+                )
+            yield tok
+            # the decode step below also COMMITS tok's KV to the cache —
+            # it must run even when stopping at EOS, or the next turn's
+            # context would silently miss the transcript's final token
+            lg, self.cache = self._decode_jit(
+                self.model.params, jnp.asarray([[tok]]), self.cache
+            )
+            if eos_token_id is not None and tok == eos_token_id:
+                return
+            tok = pick(lg[0, -1])
+
+    def send(
+        self,
+        ids: Sequence[int],
+        max_new_tokens: int = 128,
+        eos_token_id: Optional[int] = None,
+        **kw,
+    ) -> list[int]:
+        return list(self.send_stream(ids, max_new_tokens, eos_token_id, **kw))

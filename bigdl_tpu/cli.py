@@ -115,11 +115,31 @@ def cmd_generate(args):
 
 def cmd_chat(args):
     """Interactive chat REPL — the reference's `llm-chat` wrapper
-    (cli/llm-chat dispatches to main-<family> binaries; here the same
-    jitted decode drives a tokenizer chat template when available)."""
+    (cli/llm-cli dispatches to main-<family> binaries; here the same
+    jitted decode drives a tokenizer chat template when available).
+
+    Turns run through an incremental ChatSession (delta prefill — the
+    cache persists across turns, unlike the reference's full-history
+    re-prefill); --streaming-window makes the conversation unbounded
+    via attention sinks. Families with custom cache adapters fall back
+    to one-shot generation."""
     model = _load(args.model, args.qtype)
     tok = _tokenizer(args.model)
     history: list[dict] = []
+
+    def new_session():
+        from bigdl_tpu.chat import ChatSession
+
+        streaming = ((args.streaming_sink, args.streaming_window)
+                     if args.streaming_window else None)
+        return ChatSession(model, max_len=args.max_len, streaming=streaming)
+
+    session = None
+    consumed: list[int] = []
+    try:
+        session = new_session()
+    except NotImplementedError as e:
+        print(f"note: {e}; using one-shot generation", file=sys.stderr)
     templated = tok is not None and getattr(tok, "chat_template", None)
     if args.system:
         if not templated:
@@ -144,8 +164,40 @@ def cmd_chat(args):
             ids = list(tok(line)["input_ids"])
         else:  # no tokenizer: whitespace token ids (testing)
             ids = [int(t) for t in line.split()]
-        _, text = _gen_text(model, tok, ids, args.max_new_tokens,
-                            args.temperature)
+        if session is not None:
+            eos = tok.eos_token_id if tok else None
+            if ids[: len(consumed)] == consumed and len(ids) > len(consumed):
+                delta = ids[len(consumed):]
+            else:
+                # the template rewrote earlier tokens (or this is the
+                # first turn): reset the session (keeps compiled
+                # programs) and replay the full ids
+                session.reset()
+                consumed, delta = [], ids
+            try:
+                toks = session.send(
+                    delta, args.max_new_tokens, eos,
+                    temperature=args.temperature,
+                )
+            except ValueError as e:  # window/max_len overflow
+                print(f"note: {e}; restarting context", file=sys.stderr)
+                session.reset()
+                consumed = []
+                try:
+                    toks = session.send(ids, args.max_new_tokens, eos,
+                                        temperature=args.temperature)
+                except ValueError as e2:
+                    # even a fresh context cannot fit this turn — tell
+                    # the user and keep the REPL alive
+                    print(f"error: {e2}", file=sys.stderr)
+                    session.reset()
+                    continue
+            consumed = ids + toks
+            text = (tok.decode(toks, skip_special_tokens=True)
+                    if tok else str(toks))
+        else:
+            _, text = _gen_text(model, tok, ids, args.max_new_tokens,
+                                args.temperature)
         print(f"bot> {text}")
         if templated:
             history.append({"role": "assistant", "content": text})
@@ -381,6 +433,12 @@ def main(argv=None):
     ch.add_argument("-n", "--max-new-tokens", type=int, default=256)
     ch.add_argument("-t", "--temperature", type=float, default=0.7)
     ch.add_argument("--system", default=None, help="system prompt")
+    ch.add_argument("--max-len", type=int, default=2048,
+                   help="session KV cache length")
+    ch.add_argument("--streaming-window", type=int, default=None,
+                   help="attention-sink window: unbounded conversation "
+                        "in constant memory")
+    ch.add_argument("--streaming-sink", type=int, default=4)
     ch.set_defaults(fn=cmd_chat)
 
     b = sub.add_parser("bench", help="quick decode-latency check", parents=[qp])

@@ -69,12 +69,12 @@ def validate_streaming(
         )
 
 
-def make_sink_shift(config: ModelConfig, window: int, sink: int,
-                    chunk: int = 1):
-    """Returns a jit-safe fn(cache) -> cache that evicts the oldest
-    `chunk` non-sink slots when the cache is full (cache.pos >= window),
-    else returns the cache unchanged. Scalar-pos (generate path) caches
-    only."""
+def make_evict(config: ModelConfig, window: int, sink: int,
+               chunk: int = 1):
+    """Returns a jit-safe fn(cache) -> cache that UNCONDITIONALLY evicts
+    the oldest `chunk` non-sink slots (shift + rope re-basing). Used by
+    make_sink_shift (behind the pos >= window condition) and by
+    ChatSession's make-room loop before a turn's prefill."""
     validate_streaming(config, window, sink, chunk)
     use_rope = not config.alibi  # alibi shifts without re-rotation
     if use_rope:
@@ -90,7 +90,7 @@ def make_sink_shift(config: ModelConfig, window: int, sink: int,
         )
         cos_mc, sin_mc = cos_mc[0], sin_mc[0]  # [R]
 
-    def shift(cache):
+    def check(cache):
         if cache.k_scale is not None:
             raise NotImplementedError(
                 "streaming sinks over an fp8-quantized cache would need a "
@@ -106,24 +106,35 @@ def make_sink_shift(config: ModelConfig, window: int, sink: int,
                 "(scalar cache.pos), not the serving engine's per-row pool"
             )
 
-        def evict(c):
-            moved_k = c.k[:, :, sink + chunk:]
-            if use_rope:
-                _, moved_k = apply_rotary_emb(
-                    moved_k, moved_k, cos_mc, sin_mc, config.rope_interleaved
-                )
-            pad_k = jnp.zeros_like(c.k[:, :, :chunk])
-            new_k = jnp.concatenate([c.k[:, :, :sink], moved_k, pad_k], axis=2)
-            new_v = jnp.concatenate(
-                [c.v[:, :, :sink], c.v[:, :, sink + chunk:],
-                 jnp.zeros_like(c.v[:, :, :chunk])], axis=2,
+        moved_k = cache.k[:, :, sink + chunk:]
+        if use_rope:
+            _, moved_k = apply_rotary_emb(
+                moved_k, moved_k, cos_mc, sin_mc, config.rope_interleaved
             )
-            return dataclasses.replace(
-                c, k=new_k, v=new_v, pos=c.pos - chunk
-            )
-
-        return jax.lax.cond(
-            cache.pos >= window, evict, lambda c: c, cache
+        pad_k = jnp.zeros_like(cache.k[:, :, :chunk])
+        new_k = jnp.concatenate(
+            [cache.k[:, :, :sink], moved_k, pad_k], axis=2
         )
+        new_v = jnp.concatenate(
+            [cache.v[:, :, :sink], cache.v[:, :, sink + chunk:],
+             jnp.zeros_like(cache.v[:, :, :chunk])], axis=2,
+        )
+        return dataclasses.replace(
+            cache, k=new_k, v=new_v, pos=cache.pos - chunk
+        )
+
+    return check
+
+
+def make_sink_shift(config: ModelConfig, window: int, sink: int,
+                    chunk: int = 1):
+    """Returns a jit-safe fn(cache) -> cache that evicts the oldest
+    `chunk` non-sink slots when the cache is full (cache.pos >= window),
+    else returns the cache unchanged. Scalar-pos (generate path) caches
+    only."""
+    evict = make_evict(config, window, sink, chunk)
+
+    def shift(cache):
+        return jax.lax.cond(cache.pos >= window, evict, lambda c: c, cache)
 
     return shift
