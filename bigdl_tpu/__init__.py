@@ -31,6 +31,7 @@ __all__ = [
     "qtype_registry",
     "AutoModelForCausalLM",
     "optimize_model",
+    "ChatSession",
     "__version__",
 ]
 
@@ -46,4 +47,8 @@ def __getattr__(name):
         from bigdl_tpu.api import optimize_model
 
         return optimize_model
+    if name == "ChatSession":
+        from bigdl_tpu.chat import ChatSession
+
+        return ChatSession
     raise AttributeError(f"module 'bigdl_tpu' has no attribute {name!r}")
