@@ -52,8 +52,22 @@ def remaining() -> float:
 # child: one decode candidate
 # --------------------------------------------------------------------------
 
+# BENCH_REHEARSAL=1: full dress rehearsal of the ladder on CPU —
+# real subprocess children, stdout banking, stage merging — with
+# tiny-llama and interpret-mode Pallas. The one thing the mocked unit
+# tests (tests/test_bench_orchestration.py) cannot cover is the actual
+# child protocol; this covers it without a chip.
+REHEARSAL = os.environ.get("BENCH_REHEARSAL") == "1"
+
+
 def _child_env() -> dict:
     env = dict(os.environ)
+    if REHEARSAL:
+        env["BENCH_FORCE_CPU"] = "1"
+        env.setdefault("BIGDL_TPU_PALLAS", "interpret")
+        # NEVER the shared TPU cache dir: XLA:CPU AOT entries bake host
+        # machine features and poison cross-host caches (conftest story)
+        env["JAX_COMPILATION_CACHE_DIR"] = "/tmp/jax_cache_bench_cpu"
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_tpu")
     return env
 
@@ -770,6 +784,8 @@ def main() -> None:
         ("llama2_7b", "llama2-7b", 480, 150),
         ("llama3_8b", "llama3-8b", 300, 200),
     ]
+    if REHEARSAL:  # CPU dress rehearsal: tiny model, generous budget
+        candidates = [("tiny_llama", "tiny-llama", 420, 30)]
     for name, preset, budget, min_s in candidates:
         if remaining() < min_s:
             log(f"skip {name}: only {remaining():.0f}s left")
@@ -786,7 +802,9 @@ def main() -> None:
     # fields make each entry seconds; banked after the headline so a
     # slow-compile day costs the matrix, not the ms/token number.
     kernel_matrix = None
-    if remaining() > 180:
+    # rehearsal skips the matrix: interpret-mode Pallas at the real
+    # K=14336 shapes takes minutes per kernel on one CPU core
+    if remaining() > 180 and not REHEARSAL:
         res = guarded("kernels", "-", min(300, remaining() - 60))
         if isinstance(res, dict) and res.get("kernels"):
             kernel_matrix = res["kernels"]
@@ -804,7 +822,7 @@ def main() -> None:
         # Reserve a serve slot only when the window is generous: on an
         # r03-class slow-compile day train still gets everything it
         # would have before (remaining - 30); never capped below 360s.
-        preset = "mistral-7b"
+        preset = "tiny-llama" if REHEARSAL else "mistral-7b"
         budget = (remaining() - 210) if remaining() > 570 else (remaining() - 30)
         res = guarded("train", preset, budget)
         if isinstance(res, dict):
