@@ -247,7 +247,7 @@ def cmd_serve(args):
         port=args.port, n_slots=args.slots, max_len=args.max_len, gen=gen,
         paged=args.paged, speculative=args.speculative,
         draft_k=args.draft_k, adaptive_draft=args.adaptive_draft,
-        embedder=embedder,
+        embedder=embedder, truncate_prompts=args.truncate_prompts,
     )
     server.start()
     print(f"bigdl-tpu serving {args.model} on {args.host}:{server.port}")
@@ -383,6 +383,9 @@ def main(argv=None):
                         "(ladder of compiled K programs)")
     s.add_argument("--embedder", default=None,
                    help="bert checkpoint dir: enables POST /v1/embeddings")
+    s.add_argument("--truncate-prompts", action="store_true",
+                   help="keep the tail of over-long prompts instead of "
+                        "rejecting them with 400")
     s.add_argument("--paged", action="store_true",
                    help="paged KV pool + prefix caching")
     s.set_defaults(fn=cmd_serve)

@@ -119,6 +119,7 @@ class ApiServer:
         draft_params=None,  # None = sym_int4 self-draft of the model
         draft_k: int = 4,
         adaptive_draft: bool = False,  # acceptance-steered draft length
+        truncate_prompts: bool = False,  # opt-in: keep over-long tails
         journal: Optional[str] = None,  # crash-recovery request journal
     ):
         from bigdl_tpu.serving.metrics import Metrics
@@ -127,7 +128,8 @@ class ApiServer:
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, page_size=page_size, n_pages=n_pages,
             speculative=speculative, draft_params=draft_params,
-            draft_k=draft_k, adaptive_draft=adaptive_draft, journal=journal,
+            draft_k=draft_k, adaptive_draft=adaptive_draft,
+            truncate_prompts=truncate_prompts, journal=journal,
         )
         self.tokenizer = tokenizer
         self.whisper = whisper
@@ -302,7 +304,7 @@ class ApiServer:
                     req = outer.engine.submit(ids, maxnt, **kw)
                     outer._wait(req)
                     if req.error:
-                        return self._json(500, {"error": req.error})
+                        return self._req_error(req)
                     if not req.done:
                         return self._json(504, {"error": "generation timed out"})
                     text, stop_reason, n_gen = tokens_until_cut(req.out_tokens)
@@ -319,6 +321,8 @@ class ApiServer:
 
                 q: queue.SimpleQueue = queue.SimpleQueue()
                 req = outer.engine.submit(ids, maxnt, stream=q, **kw)
+                if self._rejected(req):  # 400 beats a dead SSE stream
+                    return self._req_error(req)
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.end_headers()
@@ -398,6 +402,17 @@ class ApiServer:
                               "total_tokens": n_tok},
                 })
 
+            @staticmethod
+            def _rejected(req):
+                return req.done and req.finish_reason == "invalid"
+
+            def _req_error(self, req):
+                """One mapping for every endpoint: submit-time rejection
+                ("invalid", a client mistake) is 400; anything else is a
+                server-side 500."""
+                code = 400 if req.finish_reason == "invalid" else 500
+                return self._json(code, {"error": req.error})
+
             def _transcribe(self, raw: bytes):
                 if outer.whisper is None:
                     return self._json(
@@ -455,6 +470,8 @@ class ApiServer:
                     q: queue.SimpleQueue = queue.SimpleQueue()
                     req = outer.engine.submit(ids, maxnt, stream=q,
                                               **_sampling_kwargs(payload))
+                    if self._rejected(req):
+                        return self._req_error(req)
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.end_headers()
@@ -472,7 +489,7 @@ class ApiServer:
                                           **_sampling_kwargs(payload))
                 outer._wait(req)
                 if req.error:
-                    return self._json(500, {"error": req.error})
+                    return self._req_error(req)
                 if not req.done:
                     return self._json(504, {"error": "generation timed out"})
                 return self._json(200, {
@@ -487,7 +504,7 @@ class ApiServer:
                                           **_sampling_kwargs(payload))
                 outer._wait(req)
                 if req.error:
-                    return self._json(500, {"error": req.error})
+                    return self._req_error(req)
                 if not req.done:
                     return self._json(504, {"error": "generation timed out"})
                 return self._json(200, {
@@ -515,6 +532,8 @@ class ApiServer:
                     q: queue.SimpleQueue = queue.SimpleQueue()
                     req = outer.engine.submit(ids, maxnt, stream=q,
                                               **_sampling_kwargs(payload))
+                    if self._rejected(req):
+                        return self._req_error(req)
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.end_headers()
@@ -540,7 +559,7 @@ class ApiServer:
                                           **_sampling_kwargs(payload))
                 outer._wait(req)
                 if req.error:
-                    return self._json(500, {"error": req.error})
+                    return self._req_error(req)
                 if not req.done:
                     return self._json(504, {"error": "generation timed out"})
                 return self._json(200, {

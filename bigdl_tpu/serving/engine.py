@@ -57,7 +57,8 @@ class Request:
     # filled by the engine
     out_tokens: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
-    finish_reason: str = ""  # "stop" (EOS) | "length" (budget) | "error"
+    finish_reason: str = ""  # "stop" (EOS) | "length" (budget) |
+    # "invalid" (rejected at submit — over-long prompt) | "error"
     error: Optional[str] = None
     stream: Optional[queue.SimpleQueue] = None  # receives (token|None=EOS)
 
@@ -90,6 +91,7 @@ class InferenceEngine:
         draft_params=None,
         draft_k: int = 4,
         adaptive_draft: bool = False,
+        truncate_prompts: bool = False,  # opt-in: keep over-long tails
         quantize_kv: bool = False,
         journal: Optional[str] = None,
     ):
@@ -326,6 +328,7 @@ class InferenceEngine:
                 "pass speculative=True (CLI: --speculative) to enable it"
             )
         self.adaptive_draft = adaptive_draft
+        self.truncate_prompts = truncate_prompts
         self._waiting: Optional[Request] = None  # paged OOM retry slot
         # rids whose client went away (stop-string hit, disconnect):
         # handler threads add, the engine thread frees the slot at the
@@ -631,6 +634,24 @@ class InferenceEngine:
             repetition_penalty=repetition_penalty,
             eos_token_id=eos_token_id,
         )
+        limit = self.max_len - max_new_tokens
+        if len(req.prompt) > limit and not self.truncate_prompts:
+            # FAIL FAST: admission used to tail-truncate silently, which
+            # generates from a different context than the caller sent —
+            # wrong output with no signal (round-5 stress finding).
+            # vLLM-style rejection is the default; truncation is opt-in.
+            req.error = (
+                f"prompt ({len(req.prompt)} tokens) exceeds the slot "
+                f"capacity ({limit} = max_len {self.max_len} - "
+                f"max_new_tokens {max_new_tokens}); shorten the prompt, "
+                "raise max_len, or construct the engine with "
+                "truncate_prompts=True to keep the prompt tail"
+            )
+            req.finish_reason = "invalid"
+            req.done = True
+            if stream is not None:
+                stream.put(None)
+            return req
         if self._journal is not None:
             self._journal.record_submit(req)
         self._queue.put(req)

@@ -55,12 +55,13 @@ class FastChatWorker:
         speculative: bool = False,
         draft_k: int = 4,
         heartbeat_s: float = HEARTBEAT_S,
+        truncate_prompts: bool = False,
         journal: Optional[str] = None,  # crash-recovery request journal
     ):
         self.engine = InferenceEngine(
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, speculative=speculative, draft_k=draft_k,
-            journal=journal,
+            truncate_prompts=truncate_prompts, journal=journal,
         )
         self.tokenizer = tokenizer
         self.controller_addr = controller_addr
@@ -276,7 +277,11 @@ class FastChatWorker:
                         final_text = final_text[:i]
                         break
             if req.error:
-                yield {"text": req.error, "error_code": 50002, "usage": {},
+                # 50007 = FastChat CONTEXT_OVERFLOW: a client mistake
+                # (over-long prompt rejected at submit), not a worker
+                # failure — gateways must not health-flap on it
+                code = 50007 if req.finish_reason == "invalid" else 50002
+                yield {"text": req.error, "error_code": code, "usage": {},
                        "finish_reason": "error"}
             else:
                 yield {
