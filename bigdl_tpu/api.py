@@ -257,6 +257,26 @@ class TpuModel:
             prompts = [list(row) for row in prompts]
         if not prompts:
             raise ValueError("prompts is empty — nothing to generate")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if top_k is not None:
+            # HF semantics: top_k <= 0 disables the filter (the serving
+            # kernel's "<=0 disables" convention); larger than vocab caps
+            top_k = (None if top_k <= 0
+                     else min(top_k, self.config.vocab_size))
+        if any(len(p) == 0 for p in prompts):
+            raise ValueError(
+                "empty prompt row — every prompt needs at least one token"
+            )
+        lo = min(min(p) for p in prompts)
+        hi = max(max(p) for p in prompts)
+        if lo < 0 or hi >= self.config.vocab_size:
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.config.vocab_size}); "
+                f"got range [{lo}, {hi}] — wrong tokenizer for this model?"
+            )
         # env-flag defaults (reference IPEX_LLM_QUANTIZE_KV_CACHE /
         # IPEX_LLM_COMPRESS_KV_CACHE / IPEX_LLM_PERFORMANCE_MODE)
         explicit_quantize_kv = quantize_kv

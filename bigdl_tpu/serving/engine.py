@@ -622,6 +622,10 @@ class InferenceEngine:
             raise ValueError(
                 f"repetition_penalty must be > 0, got {repetition_penalty}"
             )
+        if top_k is not None:
+            # <=0 disables (the stack-wide convention); > vocab caps
+            top_k = (None if top_k <= 0
+                     else min(top_k, self.config.vocab_size))
         # the decode window must fit the cache alongside a minimal prompt
         # bucket; clamp instead of letting _admit derive a zero/negative
         # bucket (which would crash the engine thread)
@@ -634,6 +638,28 @@ class InferenceEngine:
             repetition_penalty=repetition_penalty,
             eos_token_id=eos_token_id,
         )
+        if not req.prompt:
+            req.error = "empty prompt — nothing to generate"
+            req.finish_reason = "invalid"
+            req.done = True
+            if stream is not None:
+                stream.put(None)
+            return req
+        bad = [t for t in req.prompt
+               if not 0 <= t < self.config.vocab_size]
+        if bad:
+            # wrong-tokenizer ids would silently index-clip into garbage
+            # generation; fail the request like the over-long case
+            req.error = (
+                f"prompt token id {bad[0]} outside [0, "
+                f"{self.config.vocab_size}) — wrong tokenizer for this "
+                "model?"
+            )
+            req.finish_reason = "invalid"
+            req.done = True
+            if stream is not None:
+                stream.put(None)
+            return req
         limit = self.max_len - max_new_tokens
         if len(req.prompt) > limit and not self.truncate_prompts:
             # FAIL FAST: admission used to tail-truncate silently, which

@@ -122,10 +122,10 @@ def test_overlong_prompt_rejected_not_truncated(server):
     from bigdl_tpu.serving.engine import InferenceEngine
 
     port = server.httpd.server_address[1]
+    long_prompt = [(i % 250) + 2 for i in range(298)]  # in-vocab, 298 toks
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(port, "/generate",
-              {"prompt": list(range(2, 300)), "max_new_tokens": 4},
-              timeout=120)
+              {"prompt": long_prompt, "max_new_tokens": 4}, timeout=120)
     assert e.value.code == 400
     assert b"truncate_prompts" in e.value.read()
 
@@ -146,3 +146,40 @@ def test_overlong_prompt_rejected_not_truncated(server):
     kept = long_p[-(64 - 4):]
     want = model.generate([kept], max_new_tokens=4)[0].tolist()
     assert r.out_tokens == want
+
+
+def test_generate_input_validation(server):
+    """Bad inputs fail with actionable ValueErrors, not jax internals
+    (round-5 fuzz findings: max_new_tokens<1 crashed with IndexError,
+    top_k=0 with a broadcast TypeError, out-of-vocab ids silently
+    generated garbage)."""
+    model = server.engine.model
+    V = model.config.vocab_size
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        model.generate([[3, 1]], max_new_tokens=0)
+    # top_k <= 0 disables the filter (stack-wide convention), not error
+    out = model.generate([[3, 1]], max_new_tokens=2, do_sample=True, top_k=0)
+    assert out.shape == (1, 2)
+    with pytest.raises(ValueError, match="empty prompt"):
+        model.generate([[]], max_new_tokens=2)
+    with pytest.raises(ValueError, match="token ids"):
+        model.generate([[V + 7]], max_new_tokens=2)
+    with pytest.raises(ValueError, match="token ids"):
+        model.generate([[-1]], max_new_tokens=2)
+    # top_k larger than vocab clamps (HF semantics) instead of raising
+    out = model.generate([[3, 1]], max_new_tokens=2, do_sample=True,
+                         top_k=10 * V)
+    assert out.shape == (1, 2)
+
+    # engine submit: out-of-vocab / empty prompts fail as "invalid"
+    from bigdl_tpu.serving.engine import InferenceEngine
+
+    eng = InferenceEngine(model, n_slots=1, max_len=64)
+    req = eng.submit([V + 7], max_new_tokens=2)
+    assert req.done and req.finish_reason == "invalid"
+    req = eng.submit([], max_new_tokens=2)
+    assert req.done and req.finish_reason == "invalid"
+    # top_k=0 is explicit-disable through the engine too
+    req = eng.submit([3, 1], max_new_tokens=2, do_sample=True, top_k=0)
+    eng.run_until_idle()
+    assert req.done and not req.error
