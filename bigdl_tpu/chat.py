@@ -182,6 +182,13 @@ class ChatSession:
 
         if len(ids) == 0:
             raise ValueError("empty turn")
+        bad = next((t for t in ids
+                    if not 0 <= t < self.config.vocab_size), None)
+        if bad is not None:
+            raise ValueError(
+                f"token id {bad} outside [0, {self.config.vocab_size}) — "
+                "wrong tokenizer for this model?"
+            )
         gen = GenerationConfig(
             do_sample=temperature > 0, temperature=max(temperature, 1e-5),
             top_k=top_k, top_p=top_p,

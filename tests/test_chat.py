@@ -103,3 +103,11 @@ def test_streaming_turn_fits_with_partial_tail_evict(model):
     # genuinely too-big turn still raises with the clear message
     with pytest.raises(ValueError, match="cannot fit the streaming"):
         sess.send(list(range(2, 2 + W)), max_new_tokens=2)
+
+
+def test_send_validates_token_ids(model):
+    sess = ChatSession(model, max_len=64)
+    with pytest.raises(ValueError, match="wrong tokenizer"):
+        sess.send([999999], max_new_tokens=2)
+    with pytest.raises(ValueError, match="empty turn"):
+        sess.send([], max_new_tokens=2)
