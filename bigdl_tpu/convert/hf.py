@@ -1318,7 +1318,11 @@ def open_checkpoint(model_path: str):
             # some checkpoints tie without the flag; fall back to embeddings
             name = "model.embed_tokens.weight"
         if name not in weight_map:
-            raise KeyError(name)
+            raise KeyError(
+                f"checkpoint at {model_path} has no tensor {name!r} "
+                f"({len(weight_map)} tensors present) — incomplete "
+                "download, or a layout this translation doesn't cover?"
+            )
         shard = weight_map[name]
         if shard not in handles:
             # torch framework: robust bf16/fp16 handling without ml_dtypes
