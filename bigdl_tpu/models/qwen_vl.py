@@ -69,6 +69,12 @@ class QwenVLVisionConfig:
         return (self.grid // 2) ** 2  # resampler pools 2x2
 
     @property
+    def rs_heads(self) -> int:
+        # reference Resampler: num_heads = embed_dim // 128; floored at 1
+        # so reduced (test) dims stay valid instead of dividing by zero
+        return max(1, self.output_dim // 128)
+
+    @property
     def mlp_dim(self) -> int:
         return int(self.mlp_ratio * self.width)
 
@@ -194,7 +200,7 @@ def image_features(
         kv,
         vparams["rs_in_w"], vparams["rs_in_b"],
         vparams["rs_out_w"], vparams["rs_out_b"],
-        E // 128,
+        vcfg.rs_heads,
     )
     out = layer_norm(out, vparams["ln_post_w"], vparams["ln_post_b"], eps)
     out = jnp.einsum("bqe,ef->bqf", out, vparams["proj"])

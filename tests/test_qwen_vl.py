@@ -1,0 +1,128 @@
+"""Qwen-VL (v1) vision tower tests: the OpenCLIP-style ViT + the
+cross-attention resampler against torch oracles (the checkpoint is
+trust_remote_code, so components are oracle-tested the way the minicpmv
+resampler is), plus the placeholder-scatter prefill."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu.models import llama, qwen_vl
+from bigdl_tpu.models.config import ModelConfig
+
+
+def tiny_vcfg():
+    # grid = 4, so the 2x2-pooling resampler yields 4 queries
+    return qwen_vl.QwenVLVisionConfig(
+        image_size=56, patch_size=14, width=32, layers=2, heads=4,
+        mlp_ratio=2.0, output_dim=24,
+    )
+
+
+def _mk_params(vcfg, rng):
+    W, E, Q = vcfg.width, vcfg.output_dim, vcfg.n_queries
+    r = lambda *s: rng.standard_normal(s).astype(np.float32) * 0.1
+    blocks = {
+        "ln1_w": np.ones((vcfg.layers, W), np.float32),
+        "ln1_b": np.zeros((vcfg.layers, W), np.float32),
+        "ln2_w": np.ones((vcfg.layers, W), np.float32),
+        "ln2_b": np.zeros((vcfg.layers, W), np.float32),
+        "in_w": r(vcfg.layers, 3 * W, W), "in_b": r(vcfg.layers, 3 * W),
+        "out_w": r(vcfg.layers, W, W), "out_b": r(vcfg.layers, W),
+        "fc_w": r(vcfg.layers, vcfg.mlp_dim, W),
+        "fc_b": r(vcfg.layers, vcfg.mlp_dim),
+        "proj_w": r(vcfg.layers, W, vcfg.mlp_dim),
+        "proj_b": r(vcfg.layers, W),
+    }
+    return jax.tree.map(jnp.asarray, {
+        "conv1": r(W, 3 * vcfg.patch_size ** 2),
+        "pos_embed": r(vcfg.grid ** 2, W),
+        "ln_pre_w": np.ones(W, np.float32), "ln_pre_b": np.zeros(W, np.float32),
+        "blocks": blocks,
+        "ln_post_w": np.ones(E, np.float32), "ln_post_b": np.zeros(E, np.float32),
+        "proj": r(E, E),
+        "rs_query": r(Q, E),
+        "rs_pos": r(Q, E),
+        "rs_kv_w": r(E, W),
+        "rs_in_w": r(3 * E, E), "rs_in_b": r(3 * E),
+        "rs_out_w": r(E, E), "rs_out_b": r(E),
+        "rs_lnq_w": np.ones(E, np.float32), "rs_lnq_b": np.zeros(E, np.float32),
+        "rs_lnkv_w": np.ones(E, np.float32), "rs_lnkv_b": np.zeros(E, np.float32),
+    })
+
+
+def test_mha_matches_torch_multihead():
+    """The fused-in_proj attention helper must reproduce
+    torch.nn.MultiheadAttention exactly (cross-attention case)."""
+    E, H, Nq, Nk = 32, 4, 3, 7
+    torch.manual_seed(0)
+    mha = torch.nn.MultiheadAttention(E, H, batch_first=True)
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((2, Nq, E)).astype(np.float32)
+    k = rng.standard_normal((2, Nk, E)).astype(np.float32)
+    with torch.no_grad():
+        want, _ = mha(torch.from_numpy(q), torch.from_numpy(k),
+                      torch.from_numpy(k))
+    got = qwen_vl._mha(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(k),
+        jnp.asarray(mha.in_proj_weight.detach().numpy()),
+        jnp.asarray(mha.in_proj_bias.detach().numpy()),
+        jnp.asarray(mha.out_proj.weight.detach().numpy()),
+        jnp.asarray(mha.out_proj.bias.detach().numpy()),
+        heads=H,
+    )
+    np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_image_features_shapes_and_determinism():
+    vcfg = tiny_vcfg()
+    rng = np.random.default_rng(1)
+    vparams = _mk_params(vcfg, rng)
+    pixels = rng.standard_normal((1, 3, 56, 56)).astype(np.float32)
+    p = vcfg.patch_size
+    g = 56 // p
+    patches = (
+        pixels.reshape(1, 3, g, p, g, p)
+        .transpose(0, 2, 4, 1, 3, 5)
+        .reshape(1, g * g, -1)
+    )
+    feats = qwen_vl.image_features(vcfg, vparams, jnp.asarray(patches))
+    assert feats.shape == (1, vcfg.n_queries, vcfg.output_dim)
+    assert np.isfinite(np.asarray(feats)).all()
+    feats2 = qwen_vl.image_features(vcfg, vparams, jnp.asarray(patches))
+    np.testing.assert_allclose(np.asarray(feats), np.asarray(feats2))
+
+
+def test_multimodal_prefill_scatters_image_span():
+    vcfg = tiny_vcfg()
+    rng = np.random.default_rng(2)
+    vparams = _mk_params(vcfg, rng)
+    cfg = ModelConfig.from_hf_config({
+        "model_type": "qwen", "vocab_size": 160, "hidden_size": 24,
+        "intermediate_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 4,
+        "visual": {"image_start_id": 150},
+    })
+    assert cfg.image_token_id == 152
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    patches = rng.standard_normal(
+        (1, vcfg.grid ** 2, 3 * vcfg.patch_size ** 2)).astype(np.float32)
+
+    Q = vcfg.n_queries
+    ids = np.full((1, Q + 6), 5, np.int64)
+    ids[0, 2: 2 + Q] = cfg.image_token_id
+
+    from bigdl_tpu import kvcache
+
+    cache = kvcache.init_cache(2, 1, Q + 12, 4, 6, dtype=jnp.float32)
+    logits, cache = qwen_vl.multimodal_prefill(
+        cfg, vcfg, params, vparams, ids, jnp.asarray(patches), cache,
+        compute_dtype=jnp.float32,
+    )
+    assert np.isfinite(np.asarray(logits)).all()
