@@ -440,3 +440,21 @@ def test_adaptive_draft_over_paged_matches_plain(model):
     eng2._cur_k = 2
     out2 = _run(eng2, prompts, maxnt=12)
     assert out2 == ref
+
+
+def test_no_page_leak_under_cancel_rounds(model):
+    """Client cancels mid-decode across several rounds must return every
+    non-cached page to the free list with no negative refcounts."""
+    eng = InferenceEngine(model, n_slots=2, max_len=64, paged=True,
+                          page_size=8, n_pages=12)
+    free0 = len(eng._free_pages)
+    for round_i in range(3):
+        rs = [eng.submit([round_i * 17 + j, 5, 6, 7, 8], max_new_tokens=40)
+              for j in range(2)]
+        for _ in range(3):
+            eng.step()
+        for r in rs:
+            eng.cancel(r)
+        eng.run_until_idle()
+        assert len(eng._free_pages) + len(eng._page_key) == free0
+        assert not [r for r in eng._page_ref[1:] if r < 0]
