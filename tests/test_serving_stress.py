@@ -183,3 +183,33 @@ def test_generate_input_validation(server):
     req = eng.submit([3, 1], max_new_tokens=2, do_sample=True, top_k=0)
     eng.run_until_idle()
     assert req.done and not req.error
+
+
+def test_full_feature_composition_torture(server, tmp_path):
+    """Every serving feature at once — paged + fp8 pages + speculative +
+    adaptive draft + journal + mixed sampling + a mid-flight cancel —
+    must complete all requests, leak no pages, and tombstone the journal
+    so a successor engine replays nothing."""
+    from bigdl_tpu.serving.engine import InferenceEngine
+
+    model = server.engine.model
+    jpath = str(tmp_path / "journal.jsonl")
+    eng = InferenceEngine(
+        model, n_slots=2, max_len=96, paged=True, page_size=8,
+        speculative=True, draft_params=model.params, draft_k=4,
+        adaptive_draft=True, quantize_kv=True, journal=jpath,
+    )
+    free0 = len(eng._free_pages)
+    reqs = [eng.submit([2 + i, 7, 9, 11], max_new_tokens=12,
+                       do_sample=(i % 2 == 0), temperature=0.8)
+            for i in range(5)]
+    for _ in range(2):
+        eng.step()
+    eng.cancel(reqs[0])
+    eng.run_until_idle()
+    assert all(r.done for r in reqs)
+    assert not [r.error for r in reqs if r.error]
+    assert len(eng._free_pages) + len(eng._page_key) == free0
+    eng2 = InferenceEngine(model, n_slots=2, max_len=96, paged=True,
+                           page_size=8, journal=jpath)
+    assert len(eng2.recovered_requests) == 0  # all tombstoned
