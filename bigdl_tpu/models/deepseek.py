@@ -220,7 +220,7 @@ MLA_DENSE_FACTORS = ("w_uk", "w_uv")
 
 
 def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = None) -> Params:
-    from bigdl_tpu.quant import QTensor, quantize
+    from bigdl_tpu.quant import QTensor, quantize, quantize_or_dense
     from bigdl_tpu.quant.qtypes import resolve_qtype, split_mixed_qtype
 
     qtype, head_default = split_mixed_qtype(qtype)
@@ -239,12 +239,13 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
                 continue
             if name in MLA_DENSE_FACTORS:
                 continue  # 4-D per-head factors stay dense (tiny, f32 math)
-            g[name] = quantize(wv, spec.name)
+            g[name] = quantize_or_dense(wv, spec.name, name)
         out[group] = g
     if "lm_head" in params and not isinstance(params["lm_head"], QTensor):
         lm_spec = resolve_qtype(lm_head_qtype) if lm_head_qtype else spec
         if not lm_spec.is_dense:
-            out["lm_head"] = quantize(params["lm_head"], lm_spec.name)
+            out["lm_head"] = quantize_or_dense(
+                params["lm_head"], lm_spec.name, "lm_head")
     return out
 
 

@@ -159,17 +159,20 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
     spec = resolve_qtype(qtype)
     if spec.is_dense:
         return params
+    from bigdl_tpu.quant import quantize_or_dense
+
     out = dict(params)
     out["layers"] = dict(params["layers"])
     for name in _QUANT_TARGETS:
         w = params["layers"].get(name)
         if w is None or isinstance(w, QTensor):  # absent or already low-bit
             continue
-        out["layers"][name] = quantize(w, spec.name)
+        out["layers"][name] = quantize_or_dense(w, spec.name, name)
     if "lm_head" in params and not isinstance(params["lm_head"], QTensor):
         lm_spec = resolve_qtype(lm_head_qtype) if lm_head_qtype else spec
         if not lm_spec.is_dense:
-            out["lm_head"] = quantize(params["lm_head"], lm_spec.name)
+            out["lm_head"] = quantize_or_dense(
+                params["lm_head"], lm_spec.name, "lm_head")
     return out
 
 

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from bigdl_tpu.models.config import ModelConfig
 from bigdl_tpu.ops import linear
 from bigdl_tpu.ops.norms import layer_norm
-from bigdl_tpu.quant import QTensor, quantize
+from bigdl_tpu.quant import QTensor, quantize, quantize_or_dense
 from bigdl_tpu.quant.qtypes import resolve_qtype
 
 Params = dict[str, Any]
@@ -191,11 +191,12 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
         w = params["layers"].get(name)
         if w is None or isinstance(w, QTensor):
             continue
-        out["layers"][name] = quantize(w, spec.name)
+        out["layers"][name] = quantize_or_dense(w, spec.name, name)
     if "lm_head" in params and not isinstance(params["lm_head"], QTensor):
         lm_spec = resolve_qtype(lm_head_qtype) if lm_head_qtype else spec
         if not lm_spec.is_dense:
-            out["lm_head"] = quantize(params["lm_head"], lm_spec.name)
+            out["lm_head"] = quantize_or_dense(
+                params["lm_head"], lm_spec.name, "lm_head")
     return out
 
 

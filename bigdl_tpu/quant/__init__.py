@@ -12,12 +12,14 @@ from bigdl_tpu.quant.numerics import (
     quantize_blockwise,
     unpack_nibbles,
 )
-from bigdl_tpu.quant.qtensor import QTensor, dequantize, quantize
+from bigdl_tpu.quant.qtensor import (QTensor, dequantize, quantize,
+                                     quantize_or_dense)
 
 __all__ = [
     "QTensor",
     "QTypeSpec",
     "quantize",
+    "quantize_or_dense",
     "dequantize",
     "quantize_blockwise",
     "dequantize_blockwise",

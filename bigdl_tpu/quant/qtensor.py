@@ -104,6 +104,16 @@ _KQUANT_FALLBACK = {
 }
 
 
+def _effective_spec(last_dim: int, qtype: str):
+    """The spec quantize() will actually use for a given last dim —
+    including the k-quant superblock fallback."""
+    spec = resolve_qtype(qtype)
+    if (spec.superblock and last_dim % spec.superblock
+            and spec.name in _KQUANT_FALLBACK):
+        spec = resolve_qtype(_KQUANT_FALLBACK[spec.name])
+    return spec
+
+
 def quantize(x: jax.Array, qtype: str) -> QTensor:
     """Quantize `x` along its last axis into a QTensor.
 
@@ -113,11 +123,28 @@ def quantize(x: jax.Array, qtype: str) -> QTensor:
     spec = resolve_qtype(qtype)
     if spec.is_dense:
         raise ValueError(f"qtype {qtype} is dense; keep the array as-is")
-    if (spec.superblock and x.shape[-1] % spec.superblock
-            and spec.name in _KQUANT_FALLBACK):
-        spec = resolve_qtype(_KQUANT_FALLBACK[spec.name])
+    spec = _effective_spec(x.shape[-1], qtype)
     fields = quantize_blockwise(x, spec)
     return QTensor(qtype=spec.name, **fields)
+
+
+def quantize_or_dense(x: jax.Array, qtype: str, what: str = "weight"):
+    """quantize(), but weights whose last dim cannot take the format
+    (not divisible by the effective block size, after the k-quant
+    fallback) stay dense with a warning instead of failing the whole
+    model — the reference's per-module gating behavior (convert.py's
+    is_linear_module checks). Shared by every family's quantize_params."""
+    spec = _effective_spec(x.shape[-1], qtype)
+    if x.shape[-1] % spec.block_size:
+        import warnings
+
+        warnings.warn(
+            f"{what}: last dim {x.shape[-1]} not divisible by "
+            f"{spec.name}'s block size {spec.block_size}; keeping this "
+            "weight dense"
+        )
+        return x
+    return quantize(x, qtype)
 
 
 def dequantize(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
