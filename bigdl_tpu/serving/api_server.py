@@ -507,16 +507,25 @@ class ApiServer:
                     return self._req_error(req)
                 if not req.done:
                     return self._json(504, {"error": "generation timed out"})
+                choice = {
+                    "index": 0,
+                    "text": outer._decode_tok(req.out_tokens),
+                    "finish_reason": req.finish_reason or "length",
+                }
+                if payload.get("logprobs") is not None:
+                    # OpenAI completions logprobs subset: the chosen
+                    # token's log-softmax under the model (pre-filtering)
+                    choice["logprobs"] = {
+                        "tokens": [outer._decode_tok([t])
+                                   for t in req.out_tokens],
+                        "token_logprobs": req.out_logprobs,
+                    }
                 return self._json(200, {
                     "id": f"cmpl-{uuid.uuid4().hex[:12]}",
                     "object": "text_completion",
                     "created": int(time.time()),
                     "model": payload.get("model", "bigdl-tpu"),
-                    "choices": [{
-                        "index": 0,
-                        "text": outer._decode_tok(req.out_tokens),
-                        "finish_reason": req.finish_reason or "length",
-                    }],
+                    "choices": [choice],
                     "usage": {
                         "prompt_tokens": len(ids),
                         "completion_tokens": len(req.out_tokens),

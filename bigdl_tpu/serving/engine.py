@@ -56,6 +56,9 @@ class Request:
     eos_token_id: Optional[int] = None
     # filled by the engine
     out_tokens: list[int] = dataclasses.field(default_factory=list)
+    # chosen-token logprob per emitted token (log softmax of the model's
+    # pre-filtering distribution — OpenAI "logprobs" semantics)
+    out_logprobs: list[float] = dataclasses.field(default_factory=list)
     done: bool = False
     finish_reason: str = ""  # "stop" (EOS) | "length" (budget) |
     # "invalid" (rejected at submit — over-long prompt) | "error"
@@ -499,15 +502,21 @@ class InferenceEngine:
             lambda: last,
         )
         nxt = sample_token_per_row(step, key, temp, topk, topp, dosample)
+        # chosen-token logprob without materializing [B, V] log-softmax:
+        # gather the logit, subtract the row's logsumexp
+        step32 = step.astype(jnp.float32)
+        lp = (jnp.take_along_axis(step32, nxt[:, None], axis=-1)[:, 0]
+              - jax.scipy.special.logsumexp(step32, axis=-1))
         seen = seen.at[jnp.arange(seen.shape[0]), nxt].set(True)
-        return nxt, cache, seen
+        return nxt, lp, cache, seen
 
     def _spec_decode_impl(self, forward, k_draft, params, dparams, cur, cache,
                           dcache, key, temp, topk, topp, dosample, seen,
                           penalty):
         """One speculative round for the whole slot pool. Returns
-        (choice [B, K], n_acc [B], cur' [B], cache, dcache, seen):
-        slot b emits choice[b, :n_acc[b]+1].
+        (choice [B, K], lp_all [B, K], n_acc [B], cur' [B], cache,
+        dcache, seen): slot b emits choice[b, :n_acc[b]+1], with
+        lp_all carrying each token's target logprob.
 
         Cache discipline (decode/speculative.py's crop, per-row): the
         draft scan advances dcache.pos by K and the verify forward
@@ -596,13 +605,35 @@ class InferenceEngine:
             jnp.where(pos == n_acc[:, None], extra[:, None], greedy),
         )
         cur2 = extra
+        # [B, K] target logprob of each emitted token (gather - logsumexp,
+        # no [B, K, V] log-softmax materialization)
+        lp_all = (
+            jnp.take_along_axis(tlogits, choice[..., None], axis=-1)[..., 0]
+            - jax.scipy.special.logsumexp(tlogits, axis=-1)
+        )
+
+        def lp0_penalized():
+            # penalty rows sampled position 0 from the penalty-adjusted
+            # distribution — report the logprob they were drawn from,
+            # matching the plain path (review finding, round 5)
+            step0 = apply_repetition_penalty(tlogits[:, 0], seen, penalty)
+            return (jnp.take_along_axis(
+                step0, choice[:, 0][:, None], axis=-1)[:, 0]
+                - jax.scipy.special.logsumexp(step0, axis=-1))
+
+        lp0 = jax.lax.cond(
+            jnp.any(penalty != 1.0), lp0_penalized, lambda: lp_all[:, 0]
+        )
+        lp_all = lp_all.at[:, 0].set(
+            jnp.where(penalty != 1.0, lp0, lp_all[:, 0])
+        )
 
         cache = dataclasses.replace(cache, pos=cache.pos - K + n_acc + 1)
         dcache = dataclasses.replace(dcache, pos=dcache.pos - K + n_acc + 1)
         rows = jnp.arange(seen.shape[0])
         # penalty rows emit exactly cur2; spec rows don't read `seen`
         seen = seen.at[rows, cur2].set(True)
-        return choice, n_acc, cur2, cache, dcache, seen
+        return choice, lp_all, n_acc, cur2, cache, dcache, seen
 
     # ---- host API ---------------------------------------------------------
 
@@ -994,7 +1025,10 @@ class InferenceEngine:
         self._penalty[slot] = penalty
         self.seen = self.seen.at[slot].set(row).at[slot, first].set(True)
         self.active[slot] = True
-        self._emit(slot, first)
+        first_lp = float(jax.nn.log_softmax(
+            jnp.asarray(logits_last, jnp.float32).reshape(-1)
+        )[first])
+        self._emit(slot, first, first_lp)
 
     def _admit_dense(self, req: Request, slot: int) -> None:
         # decode writes land at [bucket, bucket + max_new_tokens): keep
@@ -1032,7 +1066,8 @@ class InferenceEngine:
             else:
                 self._admit_dense(req, slot)
 
-    def _emit(self, slot: int, token: int) -> None:
+    def _emit(self, slot: int, token: int,
+              logprob: Optional[float] = None) -> None:
         s = self._slots[slot]
         eos = s.eos
         if eos is not None and token == eos:
@@ -1040,6 +1075,8 @@ class InferenceEngine:
             self._finish(slot, "stop")
             return
         s.req.out_tokens.append(token)
+        if logprob is not None:
+            s.req.out_logprobs.append(logprob)
         if s.req.stream is not None:
             s.req.stream.put(token)
         if s.remaining <= 0:
@@ -1121,7 +1158,7 @@ class InferenceEngine:
         if self.speculative:
             return self._step_speculative(k)
         try:
-            nxt, self.cache, self.seen = self._decode(
+            nxt, lps, self.cache, self.seen = self._decode(
                 self.model.params, self.cur, self.cache, k,
                 jnp.asarray(self._temp), jnp.asarray(self._topk),
                 jnp.asarray(self._topp), jnp.asarray(self._dosample),
@@ -1135,12 +1172,13 @@ class InferenceEngine:
             raise
         self.cur = nxt
         toks = np.asarray(nxt)
+        lps_h = np.asarray(lps)
         for i in np.nonzero(self.active)[0]:
             s = self._slots[int(i)]
             s.remaining -= 1
             if self.paged:
                 self._slot_pos[int(i)] += 1
-            self._emit(int(i), int(toks[i]))
+            self._emit(int(i), int(toks[i]), float(lps_h[i]))
         return True
 
     def _step_speculative(self, k) -> bool:
@@ -1151,7 +1189,8 @@ class InferenceEngine:
         else:
             fn = functools.partial(self._spec_decode, self._cur_k)
         try:
-            choice, n_acc, cur2, self.cache, self.dcache, self.seen = fn(
+            (choice, lp_all, n_acc, cur2, self.cache, self.dcache,
+             self.seen) = fn(
                 self.model.params, self._draft_params, self.cur,
                 self.cache, self.dcache, k,
                 jnp.asarray(self._temp), jnp.asarray(self._topk),
@@ -1164,6 +1203,7 @@ class InferenceEngine:
             raise
         self.cur = cur2
         choice_h = np.asarray(choice)
+        lp_h = np.asarray(lp_all)
         n_acc_h = np.asarray(n_acc)
         self.spec_rounds += 1
         if self.adaptive_draft:
@@ -1176,7 +1216,7 @@ class InferenceEngine:
             for t in range(int(n_acc_h[i]) + 1):
                 s.remaining -= 1
                 self.spec_emitted += 1
-                self._emit(i, int(choice_h[i, t]))
+                self._emit(i, int(choice_h[i, t]), float(lp_h[i, t]))
                 if not self.active[i]:  # EOS or budget hit mid-round
                     break
         return True

@@ -591,3 +591,65 @@ def test_engine_adaptive_draft_identical_and_ladder(model):
 def test_adaptive_draft_requires_speculative(model):
     with pytest.raises(ValueError, match="adaptive_draft"):
         InferenceEngine(model, n_slots=2, max_len=64, adaptive_draft=True)
+
+
+def test_logprobs_plain_and_speculative_agree(model):
+    """Every emitted token carries its model logprob; the speculative
+    engine reports the SAME logprobs as plain serving (the verify pass
+    scores with the target model — exactness extends to logprobs)."""
+    prompt = [3, 1, 4, 1, 5, 9]
+    eng = InferenceEngine(model, n_slots=2, max_len=128)
+    r = eng.submit(prompt, max_new_tokens=10)
+    eng.run_until_idle()
+    assert len(r.out_logprobs) == len(r.out_tokens) == 10
+    assert all(lp <= 0.0 for lp in r.out_logprobs)
+
+    spec = InferenceEngine(model, n_slots=2, max_len=128, speculative=True,
+                           draft_params=model.params, draft_k=4)
+    rs = spec.submit(prompt, max_new_tokens=10)
+    spec.run_until_idle()
+    assert rs.out_tokens == r.out_tokens
+    np.testing.assert_allclose(rs.out_logprobs, r.out_logprobs,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_completions_endpoint_logprobs(model):
+    import json
+    import urllib.request
+
+    from bigdl_tpu.serving.api_server import ApiServer
+
+    srv = ApiServer(model, port=0, n_slots=2, max_len=128)
+    srv.start()
+    try:
+        port = srv.httpd.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": [3, 1, 4], "max_tokens": 5,
+                             "logprobs": 1}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        lp = out["choices"][0]["logprobs"]
+        assert len(lp["token_logprobs"]) == 5
+        assert all(x <= 0 for x in lp["token_logprobs"])
+        assert len(lp["tokens"]) == 5
+    finally:
+        srv.shutdown()
+
+
+def test_logprobs_penalty_rows_match_across_modes(model):
+    """With repetition_penalty != 1, the emitted token is drawn from the
+    penalty-adjusted distribution — both engine modes must report THAT
+    logprob (review finding, round 5)."""
+    prompt = [3, 1, 4, 1, 5, 9]
+    plain = InferenceEngine(model, n_slots=2, max_len=128)
+    rp = plain.submit(prompt, max_new_tokens=8, repetition_penalty=1.3)
+    plain.run_until_idle()
+    spec = InferenceEngine(model, n_slots=2, max_len=128, speculative=True,
+                           draft_params=model.params, draft_k=4)
+    rs = spec.submit(prompt, max_new_tokens=8, repetition_penalty=1.3)
+    spec.run_until_idle()
+    assert rs.out_tokens == rp.out_tokens
+    np.testing.assert_allclose(rs.out_logprobs, rp.out_logprobs,
+                               rtol=1e-3, atol=1e-3)
