@@ -248,6 +248,7 @@ def cmd_serve(args):
         paged=args.paged, speculative=args.speculative,
         draft_k=args.draft_k, adaptive_draft=args.adaptive_draft,
         embedder=embedder, truncate_prompts=args.truncate_prompts,
+        logprobs_top_k=args.logprobs_top_k,
     )
     server.start()
     print(f"bigdl-tpu serving {args.model} on {args.host}:{server.port}")
@@ -386,6 +387,9 @@ def main(argv=None):
     s.add_argument("--truncate-prompts", action="store_true",
                    help="keep the tail of over-long prompts instead of "
                         "rejecting them with 400")
+    s.add_argument("--logprobs-top-k", type=int, default=0,
+                   help="serve OpenAI top_logprobs with up to N "
+                        "alternatives per token")
     s.add_argument("--paged", action="store_true",
                    help="paged KV pool + prefix caching")
     s.set_defaults(fn=cmd_serve)

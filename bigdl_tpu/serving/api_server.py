@@ -120,6 +120,7 @@ class ApiServer:
         draft_k: int = 4,
         adaptive_draft: bool = False,  # acceptance-steered draft length
         truncate_prompts: bool = False,  # opt-in: keep over-long tails
+        logprobs_top_k: int = 0,  # OpenAI top_logprobs alternatives
         journal: Optional[str] = None,  # crash-recovery request journal
     ):
         from bigdl_tpu.serving.metrics import Metrics
@@ -129,7 +130,8 @@ class ApiServer:
             paged=paged, page_size=page_size, n_pages=n_pages,
             speculative=speculative, draft_params=draft_params,
             draft_k=draft_k, adaptive_draft=adaptive_draft,
-            truncate_prompts=truncate_prompts, journal=journal,
+            truncate_prompts=truncate_prompts,
+            logprobs_top_k=logprobs_top_k, journal=journal,
         )
         self.tokenizer = tokenizer
         self.whisper = whisper
@@ -520,6 +522,24 @@ class ApiServer:
                                    for t in req.out_tokens],
                         "token_logprobs": req.out_logprobs,
                     }
+                    n_req = 0
+                    try:
+                        n_req = int(payload.get("logprobs") or 0)
+                    except (TypeError, ValueError):
+                        pass
+                    if req.out_top_logprobs and n_req > 0:
+                        # honor the requested count (engine serves up to
+                        # its static logprobs_top_k); on decoded-string
+                        # collisions keep the HIGHER logprob
+                        tops = []
+                        for alt in req.out_top_logprobs:
+                            d = {}
+                            for t, lp in list(alt.items())[:n_req]:
+                                s_tok = outer._decode_tok([t])
+                                if s_tok not in d or lp > d[s_tok]:
+                                    d[s_tok] = lp
+                            tops.append(d)
+                        choice["logprobs"]["top_logprobs"] = tops
                 return self._json(200, {
                     "id": f"cmpl-{uuid.uuid4().hex[:12]}",
                     "object": "text_completion",

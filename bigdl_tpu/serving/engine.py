@@ -59,6 +59,9 @@ class Request:
     # chosen-token logprob per emitted token (log softmax of the model's
     # pre-filtering distribution — OpenAI "logprobs" semantics)
     out_logprobs: list[float] = dataclasses.field(default_factory=list)
+    # when the engine runs with logprobs_top_k=N: per emitted token, the
+    # N most likely {token_id: logprob} alternatives
+    out_top_logprobs: list[dict] = dataclasses.field(default_factory=list)
     done: bool = False
     finish_reason: str = ""  # "stop" (EOS) | "length" (budget) |
     # "invalid" (rejected at submit — over-long prompt) | "error"
@@ -95,6 +98,9 @@ class InferenceEngine:
         draft_k: int = 4,
         adaptive_draft: bool = False,
         truncate_prompts: bool = False,  # opt-in: keep over-long tails
+        logprobs_top_k: int = 0,  # also return the N most likely
+        # alternatives per emitted token (OpenAI top_logprobs); static
+        # so the top-k pass compiles only into engines that opt in
         quantize_kv: bool = False,
         journal: Optional[str] = None,
     ):
@@ -112,6 +118,13 @@ class InferenceEngine:
         # identical prompt prefixes share storage AND prefill compute
         # (the reference's paged attention + prefix caching live in its
         # vLLM fork, vllm/xpu/)
+        if logprobs_top_k and speculative:
+            # checked BEFORE any pool allocation / AOT compile below —
+            # failing after seconds of compile and GBs of HBM is hostile
+            raise NotImplementedError(
+                "logprobs_top_k is not wired through the speculative "
+                "verify round yet; use speculative=False"
+            )
         self.paged = paged
         # fp8 KV storage for the shared pool (dense or paged): halves KV
         # HBM capacity + traffic, the reference's fp8 kv-cache lever
@@ -332,6 +345,7 @@ class InferenceEngine:
             )
         self.adaptive_draft = adaptive_draft
         self.truncate_prompts = truncate_prompts
+        self.logprobs_top_k = logprobs_top_k
         self._waiting: Optional[Request] = None  # paged OOM retry slot
         # rids whose client went away (stop-string hit, disconnect):
         # handler threads add, the engine thread frees the slot at the
@@ -505,10 +519,15 @@ class InferenceEngine:
         # chosen-token logprob without materializing [B, V] log-softmax:
         # gather the logit, subtract the row's logsumexp
         step32 = step.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(step32, axis=-1)
         lp = (jnp.take_along_axis(step32, nxt[:, None], axis=-1)[:, 0]
-              - jax.scipy.special.logsumexp(step32, axis=-1))
+              - lse)
+        top = None
+        if self.logprobs_top_k:  # static: compiles only when opted in
+            tv, ti = jax.lax.top_k(step32, self.logprobs_top_k)
+            top = (ti, tv - lse[:, None])
         seen = seen.at[jnp.arange(seen.shape[0]), nxt].set(True)
-        return nxt, lp, cache, seen
+        return nxt, lp, top, cache, seen
 
     def _spec_decode_impl(self, forward, k_draft, params, dparams, cur, cache,
                           dcache, key, temp, topk, topp, dosample, seen,
@@ -1025,10 +1044,16 @@ class InferenceEngine:
         self._penalty[slot] = penalty
         self.seen = self.seen.at[slot].set(row).at[slot, first].set(True)
         self.active[slot] = True
-        first_lp = float(jax.nn.log_softmax(
+        row_lp = jax.nn.log_softmax(
             jnp.asarray(logits_last, jnp.float32).reshape(-1)
-        )[first])
-        self._emit(slot, first, first_lp)
+        )
+        first_lp = float(row_lp[first])
+        first_top = None
+        if self.logprobs_top_k:
+            tv, ti = jax.lax.top_k(row_lp, self.logprobs_top_k)
+            first_top = {int(t): float(l)
+                         for t, l in zip(np.asarray(ti), np.asarray(tv))}
+        self._emit(slot, first, first_lp, first_top)
 
     def _admit_dense(self, req: Request, slot: int) -> None:
         # decode writes land at [bucket, bucket + max_new_tokens): keep
@@ -1067,7 +1092,8 @@ class InferenceEngine:
                 self._admit_dense(req, slot)
 
     def _emit(self, slot: int, token: int,
-              logprob: Optional[float] = None) -> None:
+              logprob: Optional[float] = None,
+              top_logprobs: Optional[dict] = None) -> None:
         s = self._slots[slot]
         eos = s.eos
         if eos is not None and token == eos:
@@ -1077,6 +1103,8 @@ class InferenceEngine:
         s.req.out_tokens.append(token)
         if logprob is not None:
             s.req.out_logprobs.append(logprob)
+        if top_logprobs is not None:
+            s.req.out_top_logprobs.append(top_logprobs)
         if s.req.stream is not None:
             s.req.stream.put(token)
         if s.remaining <= 0:
@@ -1158,7 +1186,7 @@ class InferenceEngine:
         if self.speculative:
             return self._step_speculative(k)
         try:
-            nxt, lps, self.cache, self.seen = self._decode(
+            nxt, lps, top, self.cache, self.seen = self._decode(
                 self.model.params, self.cur, self.cache, k,
                 jnp.asarray(self._temp), jnp.asarray(self._topk),
                 jnp.asarray(self._topp), jnp.asarray(self._dosample),
@@ -1173,12 +1201,20 @@ class InferenceEngine:
         self.cur = nxt
         toks = np.asarray(nxt)
         lps_h = np.asarray(lps)
+        tops_h = None
+        if top is not None:
+            tops_h = (np.asarray(top[0]), np.asarray(top[1]))
         for i in np.nonzero(self.active)[0]:
-            s = self._slots[int(i)]
+            i = int(i)
+            s = self._slots[i]
             s.remaining -= 1
             if self.paged:
-                self._slot_pos[int(i)] += 1
-            self._emit(int(i), int(toks[i]), float(lps_h[i]))
+                self._slot_pos[i] += 1
+            alt = None
+            if tops_h is not None:
+                alt = {int(t): float(l)
+                       for t, l in zip(tops_h[0][i], tops_h[1][i])}
+            self._emit(i, int(toks[i]), float(lps_h[i]), alt)
         return True
 
     def _step_speculative(self, k) -> bool:

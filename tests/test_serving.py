@@ -653,3 +653,60 @@ def test_logprobs_penalty_rows_match_across_modes(model):
     assert rs.out_tokens == rp.out_tokens
     np.testing.assert_allclose(rs.out_logprobs, rp.out_logprobs,
                                rtol=1e-3, atol=1e-3)
+
+
+def test_top_logprobs_opt_in(model):
+    """logprobs_top_k=N returns the N most likely alternatives per token,
+    consistent with the chosen-token logprob; engines without the option
+    pay nothing and return none."""
+    eng = InferenceEngine(model, n_slots=2, max_len=64, logprobs_top_k=3)
+    r = eng.submit([3, 1, 4], max_new_tokens=5)
+    eng.run_until_idle()
+    assert len(r.out_top_logprobs) == 5
+    for tok, lp, alt in zip(r.out_tokens, r.out_logprobs, r.out_top_logprobs):
+        assert len(alt) == 3
+        assert all(v <= 0 for v in alt.values())
+        # greedy: the chosen token IS the argmax, so it leads the top-k
+        best = max(alt, key=alt.get)
+        assert best == tok
+        assert abs(alt[tok] - lp) < 1e-3
+
+    plain = InferenceEngine(model, n_slots=2, max_len=64)
+    rp = plain.submit([3, 1, 4], max_new_tokens=5)
+    plain.run_until_idle()
+    assert rp.out_top_logprobs == []
+    assert rp.out_tokens == r.out_tokens  # option does not change output
+
+    with pytest.raises(NotImplementedError, match="logprobs_top_k"):
+        InferenceEngine(model, n_slots=2, max_len=64, logprobs_top_k=3,
+                        speculative=True, draft_params=model.params)
+
+
+def test_completions_top_logprobs_honors_requested_count(model):
+    import json
+    import urllib.request
+
+    from bigdl_tpu.serving.api_server import ApiServer
+
+    srv = ApiServer(model, port=0, n_slots=2, max_len=64, logprobs_top_k=4)
+    srv.start()
+    try:
+        port = srv.httpd.server_address[1]
+
+        def post(lp):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/completions",
+                data=json.dumps({"prompt": [3, 1, 4], "max_tokens": 3,
+                                 "logprobs": lp}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return json.loads(urllib.request.urlopen(req, timeout=300).read())
+
+        out = post(2)  # clamp to the requested 2 of the engine's 4
+        tops = out["choices"][0]["logprobs"]["top_logprobs"]
+        assert len(tops) == 3 and all(len(d) <= 2 for d in tops)
+        out0 = post(0)  # chosen-token only: no top_logprobs key
+        assert "top_logprobs" not in out0["choices"][0]["logprobs"]
+        assert len(out0["choices"][0]["logprobs"]["token_logprobs"]) == 3
+    finally:
+        srv.shutdown()
