@@ -365,8 +365,12 @@ def child_kernels() -> dict:
             assert v.shape == (1, O) and np.isfinite(v).all()
         return run
 
+    # the FULL fused-GEMV format set (ops/linear.py _QGEMV_QTYPES):
+    # one live TPU contact proves every in-kernel decode — nibble,
+    # byte/fp8, multi-plane and two-level k-quant — at the hardest shape
     for qtype in ("sym_int4", "asym_int4", "sym_int8", "nf4", "fp4",
-                  "q4_k", "q6_k"):
+                  "q4_k", "q6_k", "fp8_e4m3", "fp8_e5m2", "asym_int5",
+                  "sym_int5", "fp6", "nf3", "q2_k", "q3_k", "q5_k"):
         bank(f"gemv_{qtype}_k14336", gemv_smoke(qtype, 4096, 14336))
     bank("gemv_sym_int4_k4096", gemv_smoke("sym_int4", 4096, 4096))
     bank("gemv_sym_int4_k11008", gemv_smoke("sym_int4", 11008, 4096))

@@ -11,11 +11,12 @@ into our QTensor formats without a dequant/requant round trip:
   order differs (ggml: element j & j+16 share byte j; ours: 2i/2i+1).
 - Q4_1 → asym_int4 (d·q + m, identical numerics, nibble reorder).
 - Q8_0 → sym_int8 (bytes carried over unchanged).
-- Q5_0/Q5_1 → sym_int5/asym_int5 (high bit unpacked from qh).
-- K-quants (Q4_K/Q6_K) are repacked natively, keeping the ggml super-block
-  byte layout as a `ggml_block` QTensor decoded in-graph
-  (quant/kquants.py); remaining float tensors are dequantized to fp32 and
-  re-quantized to the requested qtype.
+- Q5_0/Q5_1 → sym_int5/asym_int5 (high bit unpacked from qh; sym_int5
+  re-packs into the 4+1 bit-plane layout the fused GEMV reads).
+- K-quants (Q2_K..Q6_K) repack bit-exactly into the TPU planar layout
+  (quant/kq_planar.py) consumed by the fused Pallas GEMV kernels;
+  remaining float tensors are dequantized to fp32 and re-quantized to
+  the requested qtype.
 
 The llama.cpp converter permutes Wq/Wk rows (interleaved→half rope
 conversion); import un-permutes them (same fix the reference applies in
@@ -406,15 +407,18 @@ def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
             data=data.reshape(*data.shape[:-2], -1), scales=d
         ), "sym_int8"
     if ggml_type == GGML_Q5_0:
+        from bigdl_tpu.quant import kq_planar
+
         d = _f16(blocks, 0).astype(np.float16)
         h = _q5_high_bits(blocks, 2)
         qs = blocks[..., 6:22]
         codes = np.concatenate(
             [(qs & 0xF) | (h[..., :16] << 4), (qs >> 4) | (h[..., 16:] << 4)],
             axis=-1,
-        ).astype(np.int8)
+        ).astype(np.uint8)
+        codes = codes.reshape(*codes.shape[:-2], -1)
         return dict(
-            data=codes.reshape(*codes.shape[:-2], -1), scales=d
+            data=kq_planar.pack_planes_np(codes, (4, 1)), scales=d
         ), "sym_int5"
     if ggml_type == GGML_Q5_1:
         d = _f16(blocks, 0).astype(np.float16)
@@ -428,22 +432,14 @@ def repack_to_qtensor(blocks: np.ndarray, ggml_type: int):
         return dict(
             data=codes.reshape(*codes.shape[:-2], -1), scales=d, mins=m
         ), "asym_int5"
-    if ggml_type == GGML_Q4_K:
+    if ggml_type in _KQUANT_TYPES:
         # planar repack (quant/kq_planar.py): codes + factored two-level
         # scales — the byte-exact TPU layout the fused GEMV kernel reads
         from bigdl_tpu.quant import kq_planar
 
-        return kq_planar.from_q4k_blocks(blocks), "q4_k"
-    if ggml_type == GGML_Q6_K:
-        from bigdl_tpu.quant import kq_planar
-
-        return kq_planar.from_q6k_blocks(blocks), "q6_k"
-    if ggml_type in _KQUANT_TYPES:
-        # q2/q3/q5_k: super-block bytes carried verbatim, decoded
-        # in-graph (quant/kquants.py); d offsets live in KQUANT_LAYOUT
         name = _KQUANT_TYPES[ggml_type]
-        d = _f16(blocks, KQUANT_LAYOUT[name][1]).astype(np.float16)
-        return dict(data=blocks, scales=d), name
+        repack = getattr(kq_planar, f"from_{name.replace('_', '')}_blocks")
+        return repack(blocks), name
     raise KeyError(ggml_type)
 
 

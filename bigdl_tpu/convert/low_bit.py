@@ -32,7 +32,18 @@ from bigdl_tpu.quant import QTensor
 # dequantize scrambled, so the version gate must reject them.
 # v3: q4_k/q6_k storage moved from ggml super-block bytes to the planar
 # layout (quant/kq_planar.py) with sub_scales/sub_mins fields.
-FORMAT_VERSION = 3
+# v4: the remaining low-bit formats moved to fused-GEMV layouts —
+# q2_k/q3_k/q5_k from ggml super-block bytes to planar, and
+# sym_int5/fp6/nf3 from int8 codes to packed bit planes
+# (quant/numerics.pack_planes).
+FORMAT_VERSION = 4
+
+# qtypes whose storage layout changed at each version bump: older
+# checkpoints load only if they contain none of the later-moved types
+_MOVED_AT = {
+    3: ("q4_k", "q6_k"),
+    4: ("q2_k", "q3_k", "q5_k", "sym_int5", "fp6", "nf3"),
+}
 
 _VIEW_DTYPES = {
     "bfloat16": np.uint16,
@@ -98,13 +109,14 @@ def load_low_bit(path: str) -> tuple[ModelConfig, dict, str]:
         meta = json.load(f)
     ver = meta["format_version"]
     if ver != FORMAT_VERSION:
-        # v2 checkpoints are still bit-compatible unless they contain
-        # q4_k/q6_k tensors (whose storage moved to the planar layout)
-        v2_ok = ver == 2 and not any(
-            info.get("qtype") in ("q4_k", "q6_k")
+        # older versions are still bit-compatible unless the checkpoint
+        # contains a qtype whose storage moved at a later version
+        moved = [q for v, qs in _MOVED_AT.items() if v > ver for q in qs]
+        ok = ver in (2, 3) and not any(
+            info.get("qtype") in moved
             for info in meta["manifest"].values()
         )
-        if not v2_ok:
+        if not ok:
             raise ValueError(f"unsupported format_version {ver}")
     config = ModelConfig(**meta["model_config"])
     manifest = meta["manifest"]

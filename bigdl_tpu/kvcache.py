@@ -218,6 +218,24 @@ def read_layer(
     return k.astype(dtype), v.astype(dtype)
 
 
+def read_layer_raw(
+    cache: KVCache, layer: jax.Array
+) -> tuple[jax.Array, jax.Array, Optional[jax.Array], Optional[jax.Array]]:
+    """One layer's k/v WITHOUT dequantization: ([B,S,Hkv,D] codes,
+    [B,S,Hkv] f16 scales or None). The flash kernel dequantizes fp8
+    blocks in-kernel (the paged path's fp8 story) — going through
+    read_layer instead would materialize the full dense bf16 cache in
+    HBM each step, forfeiting exactly the bytes fp8 KV saves (the dense
+    `sdp_fp8` caveat, VERDICT §2.1)."""
+    k = jax.lax.dynamic_index_in_dim(cache.k, layer, axis=0, keepdims=False)
+    v = jax.lax.dynamic_index_in_dim(cache.v, layer, axis=0, keepdims=False)
+    if not cache.quantized:
+        return k, v, None, None
+    ks = jax.lax.dynamic_index_in_dim(cache.k_scale, layer, 0, keepdims=False)
+    vs = jax.lax.dynamic_index_in_dim(cache.v_scale, layer, 0, keepdims=False)
+    return k, v, ks, vs
+
+
 def advance(cache: KVCache, n: int) -> KVCache:
     rope_base = cache.rope_base
     if rope_base is not None:

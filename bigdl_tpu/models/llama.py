@@ -178,8 +178,8 @@ def quantize_params(params: Params, qtype: str, lm_head_qtype: Optional[str] = N
 
 def _concat_weights(ws, axis=-2):
     """Concatenate dense arrays or QTensors along the output axis.
-    Returns None when the formats can't merge losslessly (mixed qtypes,
-    ggml super-block storage whose O axis isn't -2)."""
+    Returns None when the formats can't merge losslessly (mixed qtypes
+    or dense leaves mixed with QTensors)."""
     if all(isinstance(w, jax.Array) for w in ws):
         return jnp.concatenate(ws, axis=axis)
     if not all(isinstance(w, QTensor) for w in ws):
@@ -188,8 +188,9 @@ def _concat_weights(ws, axis=-2):
     if any(w.qtype != q0.qtype for w in ws):
         return None
     spec = q0.spec
-    if spec.storage not in ("packed_u8", "int8", "fp8_e4m3", "fp8_e5m2"):
-        return None  # raw ggml super-blocks keep an extra trailing axis
+    if spec.storage not in ("packed_u8", "packed_planes", "int8",
+                            "fp8_e4m3", "fp8_e5m2"):
+        return None  # every field must be row-leading [O, *]
     from bigdl_tpu.quant.qtensor import map_arrays_multi
 
     return map_arrays_multi(
@@ -758,9 +759,16 @@ def forward(
         if logn_col is not None:
             q = q * logn_col
 
+        k_scale_att = v_scale_att = None
         if c is not None:
             c = kvcache.update_layer(c, idx, k, v)
-            if not use_paged_kernel:
+            if use_flash and c.quantized:
+                # fp8 codes + scales go straight to the flash kernel,
+                # which dequantizes per block in-kernel — never a dense
+                # bf16 copy of the cache in HBM (kvcache.read_layer_raw)
+                k_att, v_att, k_scale_att, v_scale_att = \
+                    kvcache.read_layer_raw(c, idx)
+            elif not use_paged_kernel:
                 k_att, v_att = kvcache.read_layer(c, idx, compute_dtype)
         else:
             k_att = k.astype(compute_dtype)
@@ -798,6 +806,7 @@ def forward(
                 q, k_att, v_att, start=row_start, q_offset=pos0,
                 window=config.sliding_window, softcap=config.attn_logit_softcap,
                 scale=config.attn_scale,
+                k_scale=k_scale_att, v_scale=v_scale_att,
             )
         else:
             is_sliding = sliding_flags[layer_offset + idx]

@@ -11,7 +11,7 @@ shapes use an in-graph dequant that XLA fuses into the matmul.
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -31,20 +31,134 @@ def _rows(shape) -> int:
     return n
 
 
-# formats the fused GEMV kernel decodes in-kernel: sym/asym_int4
-# arithmetically, nf4/fp4 via their static codebooks, q4_k/q6_k via
-# factored two-level scales (planar layout, quant/kq_planar.py)
-_QGEMV_QTYPES = ("sym_int4", "asym_int4", "nf4", "fp4", "sym_int8",
-                 "q4_k", "q6_k")
+def _run_sym_int4(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_int4
+
+    return qmatmul_int4(x, w.data, w.scales, out_dtype=x.dtype, block_o=bo)
+
+
+def _run_asym_int4(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_asym_int4
+
+    return qmatmul_asym_int4(x, w.data, w.scales, w.mins, out_dtype=x.dtype,
+                             block_o=bo)
+
+
+def _run_codebook(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_codebook
+
+    return qmatmul_codebook(x, w.data, w.scales, codebook=w.spec.codebook,
+                            block=w.spec.block_size, out_dtype=x.dtype,
+                            block_o=bo)
+
+
+def _run_int8(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_int8
+
+    return qmatmul_int8(x, w.data, w.scales, out_dtype=x.dtype, block_o=bo)
+
+
+def _run_asym_int5(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_bytes
+
+    return qmatmul_bytes(x, w.data, w.scales, w.mins, decode="i8",
+                         block=w.spec.block_size, out_dtype=x.dtype,
+                         block_o=bo)
+
+
+def _run_fp8(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_fp8
+
+    return qmatmul_fp8(x, w.data, w.scales, block=w.spec.block_size,
+                       out_dtype=x.dtype, block_o=bo)
+
+
+def _run_planes(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_planes
+
+    spec = w.spec
+    if spec.name == "fp6":  # exact arithmetic e2m3 decode
+        decode = ("e2m3",)
+    elif spec.codebook is not None:  # nf3: 8-entry select tree
+        decode = ("lut", tuple(float(c) for c in spec.codebook))
+    else:  # sym_int5: v - 16
+        decode = ("offset", 16)
+    return qmatmul_planes(x, w.data, w.scales, spec.planes, decode,
+                          spec.block_size, out_dtype=x.dtype, block_o=bo)
+
+
+def _run_q4k(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_q4k
+
+    return qmatmul_q4k(x, w.data, w.scales, w.mins, w.sub_scales,
+                       w.sub_mins, out_dtype=x.dtype, block_o=bo)
+
+
+def _run_q5k(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_q5k
+
+    return qmatmul_q5k(x, w.data, w.scales, w.mins, w.sub_scales,
+                       w.sub_mins, out_dtype=x.dtype, block_o=bo)
+
+
+def _run_q2k(x, w, bo):
+    from bigdl_tpu.ops.pallas import qmatmul_q2k
+
+    return qmatmul_q2k(x, w.data, w.scales, w.mins, w.sub_scales,
+                       w.sub_mins, out_dtype=x.dtype, block_o=bo)
+
+
+def _run_q6k(x, w, bo):
+    # planar q3_k is structurally identical to q6_k (int8 centered
+    # codes, int8 sub-scales per 16, f16 d per 256) and shares its kernel
+    from bigdl_tpu.ops.pallas import qmatmul_q6k
+
+    return qmatmul_q6k(x, w.data, w.scales, w.sub_scales, out_dtype=x.dtype,
+                       block_o=bo)
+
+
+class _GemvEntry(NamedTuple):
+    """Eligibility + kernel for one qtype, registered in one place.
+
+    k_multiple folds every per-format shape rule into one divisibility
+    check on the LOGICAL contraction dim: whole quant blocks per packed
+    plane (sym/asym_int4 64, nf4/fp4 128), whole super-blocks (k-quants
+    256), and 128-lane alignment of the finest plane split for the
+    multi-plane kernels (fp6/q2_k 512; sym_int5/nf3/q5_k 1024 — the
+    eighth-split 1-bit plane slices at K/8-byte offsets)."""
+    k_multiple: int
+    run: Callable  # (x [M, K] compute dtype, w, block_o) -> y [M, O]
+
+
+# every qtype with a decode path dispatches to a fused Pallas kernel —
+# the in-kernel decode mirrors QTensor.dequantize exactly
+_QGEMV_QTYPES = {
+    "sym_int4": _GemvEntry(64, _run_sym_int4),
+    "asym_int4": _GemvEntry(64, _run_asym_int4),
+    "nf4": _GemvEntry(128, _run_codebook),
+    "fp4": _GemvEntry(128, _run_codebook),
+    "sym_int8": _GemvEntry(32, _run_int8),
+    "asym_int5": _GemvEntry(32, _run_asym_int5),
+    "fp8_e4m3": _GemvEntry(128, _run_fp8),
+    "fp8_e5m2": _GemvEntry(128, _run_fp8),
+    "sym_int5": _GemvEntry(1024, _run_planes),
+    "fp6": _GemvEntry(512, _run_planes),
+    "nf3": _GemvEntry(1024, _run_planes),
+    "q2_k": _GemvEntry(512, _run_q2k),
+    "q3_k": _GemvEntry(256, _run_q6k),
+    "q4_k": _GemvEntry(256, _run_q4k),
+    "q5_k": _GemvEntry(1024, _run_q5k),
+    "q6_k": _GemvEntry(256, _run_q6k),
+}
 
 
 def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
     from bigdl_tpu.ops.pallas import use_pallas
 
-    if w.qtype not in _QGEMV_QTYPES or w.data.ndim != 2:
+    entry = _QGEMV_QTYPES.get(w.qtype)
+    if entry is None or w.data.ndim != 2:
         return False
     out, kw_ = w.data.shape
-    block = w.spec.block_size
     if out % 128 != 0:
         return False
     # the kernels tile O at >= 128 rows (Mosaic lane rule forbids
@@ -54,20 +168,7 @@ def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
     row_bytes = kw_ * w.data.dtype.itemsize
     if 128 * row_bytes > 5 * 1024 * 1024:
         return False
-    if w.qtype == "sym_int8":  # unpacked: K = data's last dim directly
-        if kw_ % block != 0:
-            return False
-    elif w.qtype == "q6_k":  # unpacked; K tiles align to super-blocks
-        if kw_ % 256 != 0:
-            return False
-    elif w.qtype == "q4_k":
-        if (kw_ * 2) % 256 != 0:  # whole super-blocks per row
-            return False
-    # each half-split nibble plane must cover whole quant blocks; asym
-    # additionally needs an even per-plane block count for the scale views
-    elif (kw_ * 2) % (2 * block) != 0 or (
-        w.qtype == "asym_int4" and (kw_ * 2 // block) % 2 != 0
-    ):
+    if w.shape[-1] % entry.k_multiple != 0:
         return False
     return _rows(x.shape) <= _GEMV_MAX_ROWS and use_pallas()
 
@@ -86,49 +187,10 @@ def linear(
     """
     if isinstance(w, QTensor):
         if _use_qgemv(x, w):
-            from bigdl_tpu.ops.pallas import qmatmul_codebook, qmatmul_int4
-
             block_o = 256 if w.data.shape[0] % 256 == 0 else 128
-            if w.qtype == "sym_int4":
-                y = qmatmul_int4(
-                    x.astype(compute_dtype), w.data, w.scales,
-                    out_dtype=compute_dtype, block_o=block_o,
-                )
-            elif w.qtype == "asym_int4":
-                from bigdl_tpu.ops.pallas import qmatmul_asym_int4
-
-                y = qmatmul_asym_int4(
-                    x.astype(compute_dtype), w.data, w.scales, w.mins,
-                    out_dtype=compute_dtype, block_o=block_o,
-                )
-            elif w.qtype == "q4_k":
-                from bigdl_tpu.ops.pallas import qmatmul_q4k
-
-                y = qmatmul_q4k(
-                    x.astype(compute_dtype), w.data, w.scales, w.mins,
-                    w.sub_scales, w.sub_mins,
-                    out_dtype=compute_dtype, block_o=block_o,
-                )
-            elif w.qtype == "q6_k":
-                from bigdl_tpu.ops.pallas import qmatmul_q6k
-
-                y = qmatmul_q6k(
-                    x.astype(compute_dtype), w.data, w.scales, w.sub_scales,
-                    out_dtype=compute_dtype, block_o=block_o,
-                )
-            elif w.qtype == "sym_int8":
-                from bigdl_tpu.ops.pallas import qmatmul_int8
-
-                y = qmatmul_int8(
-                    x.astype(compute_dtype), w.data, w.scales,
-                    out_dtype=compute_dtype, block_o=block_o,
-                )
-            else:  # nf4 / fp4: static-codebook decode in-kernel
-                y = qmatmul_codebook(
-                    x.astype(compute_dtype), w.data, w.scales,
-                    codebook=w.spec.codebook, block=w.spec.block_size,
-                    out_dtype=compute_dtype, block_o=block_o,
-                )
+            y = _QGEMV_QTYPES[w.qtype].run(
+                x.astype(compute_dtype), w, block_o
+            )
             if bias is not None:
                 y = y + bias.astype(compute_dtype)
             return y

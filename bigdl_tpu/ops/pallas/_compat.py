@@ -1,0 +1,16 @@
+"""Pallas API-spelling compat for the pinned jax.
+
+jax 0.4.37 spells it TPUCompilerParams; newer jax renamed it to
+CompilerParams. One alias here so every kernel module agrees
+(paged_attention / flash_backward still use the bare newer spelling
+deliberately — flipping them adds interpret-mode CPU cost against the
+tier-1 time budget; import from here when migrating them).
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)

@@ -37,6 +37,8 @@ from bigdl_tpu.utils import round_up
 _NEG_INF = -1e30
 _LANES = 128
 
+from bigdl_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
+
 
 def _kernel(
     start_ref,  # SMEM [B] int32: per-row pad offsets (indexed by program_id)
@@ -44,18 +46,19 @@ def _kernel(
     q_ref,  # VMEM [1, 1, BQ, D]
     k_ref,  # VMEM [1, 1, BK, D]
     v_ref,  # VMEM [1, 1, BK, D]
-    o_ref,  # VMEM [1, 1, BQ, D]
-    m_scr,  # VMEM [BQ, LANES] f32
-    l_scr,  # VMEM [BQ, LANES] f32
-    acc_scr,  # VMEM [BQ, D] f32
-    *,
+    *refs,  # (+ ks/vs VMEM [1, 1, BK, 1] f32 when quantized) o, scratch
     scale: float,
     block_q: int,
     block_k: int,
     causal: bool,
     window: Optional[int],
     softcap: Optional[float],
+    quantized: bool,
 ):
+    if quantized:  # fp8 KV: per-(slot, head) f32 scales ride alongside
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
     b = pl.program_id(0)
     i, j = pl.program_id(2), pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -81,6 +84,8 @@ def _kernel(
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
         k = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
+        if quantized:
+            k = k * ks_ref[0, 0]  # [BK, 1] broadcasts over D
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK]
@@ -110,6 +115,8 @@ def _kernel(
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
         v = v_ref[0, 0].astype(jnp.float32)  # [BK, D]
+        if quantized:
+            v = v * vs_ref[0, 0]
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -131,7 +138,7 @@ def _kernel(
     ),
 )
 def _flash(
-    q, k, v, start, q_offset,
+    q, k, v, start, q_offset, k_scale, v_scale,
     causal: bool, window: Optional[int], softcap: Optional[float],
     scale: float, block_q: int, block_k: int, interpret: bool,
 ):
@@ -139,32 +146,41 @@ def _flash(
     _, Hkv, S, _ = k.shape
     group = Hq // Hkv
     n_q, n_k = T // block_q, S // block_k
+    quantized = k_scale is not None
 
     grid = (B, Hq, n_q, n_k)
     kernel = functools.partial(
         _kernel,
         scale=scale, block_q=block_q, block_k=block_k,
-        causal=causal, window=window, softcap=softcap,
+        causal=causal, window=window, softcap=softcap, quantized=quantized,
     )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, i, j: (b, h // group, j, 0),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [
+        pl.BlockSpec((B,), lambda b, h, i, j: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1,), lambda b, h, i, j: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        kv_spec, kv_spec,
+    ]
+    args = [start, q_offset, q, k, v]
+    if quantized:
+        # [B, Hkv, S, 1] f32: a trailing singleton keeps the block rank-2
+        # in (sublane, lane) with a full-dim lane (always legal)
+        sc_spec = pl.BlockSpec(
+            (1, 1, block_k, 1), lambda b, h, i, j: (b, h // group, j, 0),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scale, v_scale]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((B,), lambda b, h, i, j: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1,), lambda b, h, i, j: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec(
-                (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, D), lambda b, h, i, j: (b, h // group, j, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, D), lambda b, h, i, j: (b, h // group, j, 0),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0),
             memory_space=pltpu.VMEM,
@@ -175,16 +191,16 @@ def _flash(
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(start, q_offset, q, k, v)
+    )(*args)
 
 
 def flash_attention(
     q: jax.Array,  # [B, T, Hq, D]
-    k: jax.Array,  # [B, S, Hkv, D]
+    k: jax.Array,  # [B, S, Hkv, D] (fp8 codes when k_scale is given)
     v: jax.Array,  # [B, S, Hkv, D]
     start: Optional[jax.Array] = None,  # [B] int32 left-pad offsets
     q_offset: Optional[jax.Array] = None,  # scalar int32 global slot of q[0]
@@ -192,13 +208,21 @@ def flash_attention(
     window: Optional[int] = None,
     softcap: Optional[float] = None,
     scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,  # [B, S, Hkv] fp8 dequant scales
+    v_scale: Optional[jax.Array] = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Returns [B, T, Hq, D] in q.dtype. Pads T/S/D to tile multiples
     internally; padding key slots are excluded by the causal mask (they
-    lie beyond every query's global slot)."""
+    lie beyond every query's global slot).
+
+    With k_scale/v_scale, k/v are fp8 codes from a quantized KV cache
+    and dequantize per block IN-KERNEL (the paged kernel's fp8 story):
+    the cache never materializes as a dense bf16 copy in HBM, which is
+    the entire point of fp8 KV. Scales cross as f32 — Mosaic has no f16
+    vectors — at 1/D the footprint of the codes."""
     from bigdl_tpu.ops.pallas import interpret_mode
 
     B, T, Hq, D = q.shape
@@ -224,10 +248,17 @@ def flash_attention(
     kt = jnp.pad(kt, ((0, 0), (0, 0), (0, Sp - S), (0, Dp - D)))
     vt = jnp.pad(vt, ((0, 0), (0, 0), (0, Sp - S), (0, Dp - D)))
 
+    def prep_scale(s):
+        if s is None:
+            return None
+        st = jnp.transpose(s.astype(jnp.float32), (0, 2, 1))  # [B, Hkv, S]
+        return jnp.pad(st, ((0, 0), (0, 0), (0, Sp - S)))[..., None]
+
     out = _flash(
         qt, kt, vt,
         start.astype(jnp.int32),
         q_offset.astype(jnp.int32).reshape(1),
+        prep_scale(k_scale), prep_scale(v_scale),
         causal, window, softcap, scale, block_q, block_k, interpret,
     )
     return jnp.transpose(out[:, :, :T, :D], (0, 2, 1, 3))

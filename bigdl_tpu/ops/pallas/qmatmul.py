@@ -6,9 +6,13 @@ decode; dispatch in low_bit_linear.py:606-716 of /root/reference).
 
 The decode step is HBM-bandwidth-bound: y = x @ W^T with x [M, K],
 M <= ~32. The win over the XLA fallback (dequantize to bf16, then
-matmul) is that W crosses HBM as packed nibbles — 0.5 byte/weight + one
-f16 scale per 32 — i.e. ~4x less weight traffic than bf16, which is the
-entire cost of a GEMV.
+matmul) is that W crosses HBM packed — e.g. 0.5 byte/weight + one f16
+scale per 32 for nibble formats — i.e. up to ~6x less weight traffic
+than bf16, which is the entire cost of a GEMV. Four kernel families
+cover EVERY decodable qtype (coverage matrix: docs/kernels.md):
+nibble (sym/asym_int4, nf4/fp4), byte-code (sym_int8, asym_int5, fp8),
+packed multi-plane (sym_int5, fp6, nf3, q2_k, q5_k), and two-level
+planar k-quant (q4_k, q6_k — q3_k shares q6_k's kernel).
 
 Layout contract (quant/numerics.py pack_nibbles): byte j of a row packs
 element j in its low nibble and element j + K/2 in its high nibble. The
@@ -52,6 +56,12 @@ from bigdl_tpu.utils import round_up
 
 BLOCK = 32  # quant block (elements per scale) for sym_int4; nf4/fp4 use 64
 _VMEM_BUDGET = 10 * 1024 * 1024  # leave scoped-VMEM headroom under 16 MiB
+
+from bigdl_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
+
+
+def _params_parallel():
+    return _CompilerParams(dimension_semantics=("parallel",))
 
 
 def _f16_bits_to_f32(bits):
@@ -252,9 +262,7 @@ def _qmm(x2, w, s_bits, out_dtype, block_o: int, ck: int, interpret: bool,
             (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
-        ),
+        compiler_params=_params_parallel(),
         interpret=interpret,
     )(*x_args, w, s_bits)
 
@@ -335,60 +343,8 @@ def _qmatmul_nibble(x, data, scales, out_dtype, block_o, interpret,
 
 
 # ---------------------------------------------------------------------------
-# sym_int8
+# sym_int8 (served by the generic byte-code kernel below)
 # ---------------------------------------------------------------------------
-
-def _kernel_i8(x_ref, w_ref, s_ref, o_ref, *, ck: int, block: int):
-    """One O-tile of the int8 GEMV: o = x @ (w * scale)^T, chunked over
-    K in-kernel. No packing — w is [bo, K] int8."""
-    M = x_ref.shape[0]
-    bo = w_ref.shape[0]
-    K = w_ref.shape[1]
-    w = w_ref[:]
-    s = _f16_bits_to_f32(s_ref[:])  # [bo, K/block]
-    x = x_ref[:].astype(jnp.bfloat16)
-
-    acc = jnp.zeros((M, bo), jnp.float32)
-    for c0, c in _chunks(K, ck):
-        wc = _slc(w, c0, c).astype(jnp.float32)
-        sc = _slc(s, c0 // block, c // block)
-        wd = (wc * _expand_scales(sc, c, block)).astype(jnp.bfloat16)
-        acc += jax.lax.dot_general(
-            _slc(x, c0, c), wd, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-    o_ref[:] = acc.astype(o_ref.dtype)
-
-
-@functools.partial(
-    jax.jit, static_argnames=("out_dtype", "block_o", "ck", "interpret",
-                              "block")
-)
-def _qmm_i8(x2, w, s_bits, out_dtype, block_o: int, ck: int,
-            interpret: bool, block: int):
-    M, K = x2.shape
-    O = w.shape[0]
-    nb = s_bits.shape[1]
-    return pl.pallas_call(
-        functools.partial(_kernel_i8, ck=ck, block=block),
-        grid=(O // block_o,),
-        in_specs=[
-            pl.BlockSpec((M, K), lambda o: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_o, K), lambda o: (o, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_o, nb), lambda o: (o, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
-        ),
-        interpret=interpret,
-    )(x2, w, s_bits)
-
 
 def qmatmul_int8(
     x: jax.Array,  # [..., K]
@@ -401,35 +357,8 @@ def qmatmul_int8(
     """y[..., O] = x @ dequant(W)^T for a sym_int8 QTensor's fields:
     weights cross HBM as int8 — half the traffic of bf16, which is the
     whole cost of a decode GEMV."""
-    from bigdl_tpu.ops.pallas import interpret_mode
-
-    if interpret is None:
-        interpret = interpret_mode()
-    *lead, K = x.shape
-    O, Kw = data.shape
-    assert Kw == K and K % BLOCK == 0
-
-    M = 1
-    for d in lead:
-        M *= d
-    Mp = round_up(max(M, 1), 8)
-    x2 = x.reshape(M, K)
-    if Mp != M:
-        x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
-
-    persist_row = K + (K // BLOCK) * 2
-    block_o = _pick_block_o(O, persist_row, cap=block_o)
-    ck = _chunk_target(block_o, block_o * persist_row + Mp * K * 2, K)
-
-    if scales.dtype == jnp.float16:
-        s_bits = jax.lax.bitcast_convert_type(scales, jnp.uint16)
-    else:
-        s_bits = jax.lax.bitcast_convert_type(
-            scales.astype(jnp.float16), jnp.uint16
-        )
-    y = _qmm_i8(x2, data, s_bits, jnp.dtype(out_dtype), block_o, ck,
-                interpret, BLOCK)
-    return y[:M].reshape(*lead, O)
+    return qmatmul_bytes(x, data, scales, None, "i8", BLOCK, out_dtype,
+                         block_o, interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -520,9 +449,7 @@ def _qmm_asym(x2, w, s_bits, m_bits, out_dtype, block_o: int, ck: int,
             (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
-        ),
+        compiler_params=_params_parallel(),
         interpret=interpret,
     )(*x_args, w, s_bits, m_bits)
 
@@ -627,9 +554,7 @@ def _qmm_q4k(x2, w, d_bits, dmin_bits, sc, mn, out_dtype, block_o: int,
             (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
-        ),
+        compiler_params=_params_parallel(),
         interpret=interpret,
     )(*x_args, w, d_bits, dmin_bits, sc, mn)
 
@@ -714,9 +639,7 @@ def _qmm_q6k(x2, w, d_bits, sc, out_dtype, block_o: int, ck: int,
             (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
-        ),
+        compiler_params=_params_parallel(),
         interpret=interpret,
     )(x2, w, d_bits, sc)
 
@@ -742,3 +665,437 @@ def qmatmul_q6k(
     y = _qmm_q6k(x2, data, _f16_bits(scales), sub_scales,
                  jnp.dtype(out_dtype), block_o, ck, interpret)
     return y[:M].reshape(*lead, O)
+
+
+# ---------------------------------------------------------------------------
+# byte-code GEMV: sym_int8 / asym_int5 / fp8_e4m3 / fp8_e5m2
+# ---------------------------------------------------------------------------
+#
+# One kernel for every format that stores one code byte per element:
+# int8 codes decode as identity, fp8 bytes decode arithmetically from
+# their bit fields (a 256-entry codebook realized with integer ops —
+# Mosaic has no vector gather, and a 256-way select tree would dwarf
+# the dequant math). Weights cross HBM at 1 byte/weight — half of bf16
+# — and the optional per-block mins fold in as a rank-1 term exactly
+# like the asym_int4 nibble kernel.
+
+def _fp8_bits_to_f32(b, exp_bits: int, mant_bits: int, bias: int):
+    """uint8 fp8 bit pattern (as int32) -> f32, integer ops only.
+    Exact for every finite pattern; the encoder saturates, so inf/nan
+    patterns never occur in stored weights. Subnormals decode exactly as
+    sign * mant * 2^(1 - bias - mant_bits)."""
+    sign = (b >> 7) & 1
+    exp = (b >> mant_bits) & ((1 << exp_bits) - 1)
+    mant = b & ((1 << mant_bits) - 1)
+    f32_bits = (sign << 31) | ((exp + 127 - bias) << 23) | (
+        mant << (23 - mant_bits))
+    val = jax.lax.bitcast_convert_type(f32_bits, jnp.float32)
+    sub = (1.0 - 2.0 * sign.astype(jnp.float32)) * (
+        mant.astype(jnp.float32)
+        * jnp.float32(2.0 ** (1 - bias - mant_bits))
+    )
+    return jnp.where(exp == 0, sub, val)
+
+
+def _decode_bytes(wc, decode: str):
+    """[bo, c] raw code bytes -> f32 values, per the static decode tag."""
+    if decode == "i8":
+        return wc.astype(jnp.float32)
+    if decode == "e4m3":
+        return _fp8_bits_to_f32(wc.astype(jnp.int32), 4, 3, 7)
+    if decode == "e5m2":
+        return _fp8_bits_to_f32(wc.astype(jnp.int32), 5, 2, 15)
+    raise ValueError(decode)
+
+
+def _kernel_bytes(x_ref, w_ref, s_ref, *rest, ck: int, block: int,
+                  decode: str, has_mins: bool):
+    """One O-tile of the byte-code GEMV: o = x @ (dec(w) * scale [+ m])^T,
+    chunked over K in-kernel (same VMEM story as _kernel_i8)."""
+    if has_mins:
+        m_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
+    M = x_ref.shape[0]
+    bo = w_ref.shape[0]
+    K = w_ref.shape[1]
+    w = w_ref[:]
+    s = _f16_bits_to_f32(s_ref[:])  # [bo, K/block]
+    mm = _f16_bits_to_f32(m_ref[:]) if has_mins else None
+    x = x_ref[:].astype(jnp.bfloat16)
+
+    acc = jnp.zeros((M, bo), jnp.float32)
+    for c0, c in _chunks(K, ck):
+        vals = _decode_bytes(_slc(w, c0, c), decode)
+        sb0, nbc = c0 // block, c // block
+        if has_mins:
+            stacked = jnp.concatenate(
+                [_slc(s, sb0, nbc), _slc(mm, sb0, nbc)], axis=0)
+            exp = _expand_scales(stacked, c, block)  # [2*bo, c]
+            wd = (vals * exp[:bo] + exp[bo:]).astype(jnp.bfloat16)
+        else:
+            wd = (vals * _expand_scales(_slc(s, sb0, nbc), c, block)
+                  ).astype(jnp.bfloat16)
+        acc += jax.lax.dot_general(
+            _slc(x, c0, c), wd, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "ck", "interpret",
+                              "block", "decode", "has_mins")
+)
+def _qmm_bytes(x2, w, s_bits, m_bits, out_dtype, block_o: int, ck: int,
+               interpret: bool, block: int, decode: str, has_mins: bool):
+    M, K = x2.shape
+    O = w.shape[0]
+    nb = s_bits.shape[1]
+    row = lambda o: (o, 0)
+    in_specs = [
+        pl.BlockSpec((M, K), lambda o: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_o, K), row, memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_o, nb), row, memory_space=pltpu.VMEM),
+    ]
+    args = [x2, w, s_bits]
+    if has_mins:
+        in_specs.append(
+            pl.BlockSpec((block_o, nb), row, memory_space=pltpu.VMEM))
+        args.append(m_bits)
+    return pl.pallas_call(
+        functools.partial(_kernel_bytes, ck=ck, block=block, decode=decode,
+                          has_mins=has_mins),
+        grid=(O // block_o,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
+        compiler_params=_params_parallel(),
+        interpret=interpret,
+    )(*args)
+
+
+def qmatmul_bytes(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K] one code byte per element
+    scales: jax.Array,  # [O, K // block] f16
+    mins: jax.Array | None = None,  # [O, K // block] f16 (w = dec(q)*d + m)
+    decode: str = "i8",  # i8 | e4m3 | e5m2
+    block: int = BLOCK,
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused dequant-GEMV for byte-per-element formats: asym_int5
+    (decode="i8" + mins) and fp8_e4m3/fp8_e5m2 (pass data bitcast to
+    uint8; the 256-entry byte codebook is realized arithmetically from
+    the fp8 bit fields)."""
+    O, Kw = data.shape
+    x2, lead, M, K, Mp, interpret = _gemv_prep(x, block_o, O, interpret)
+    assert Kw == K and K % block == 0
+    assert scales.shape[-1] * block == K, (scales.shape, block, K)
+
+    has_mins = mins is not None
+    persist_row = K + (K // block) * (4 if has_mins else 2)
+    block_o = _pick_block_o(O, persist_row, cap=block_o)
+    ck = _chunk_target(block_o, block_o * persist_row + Mp * K * 2, K,
+                       temp_bpe=16 if has_mins else 12)
+    y = _qmm_bytes(x2, data, _f16_bits(scales),
+                   _f16_bits(mins) if has_mins else None,
+                   jnp.dtype(out_dtype), block_o, ck, interpret, block,
+                   decode, has_mins)
+    return y[:M].reshape(*lead, O)
+
+
+def qmatmul_fp8(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K] float8_e4m3fn / float8_e5m2
+    scales: jax.Array,  # [O, K // block] f16
+    block: int = 128,
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused dequant-GEMV for fp8 weights: bytes cross HBM as stored
+    (half the traffic of the bf16 dequant fallback) and decode in-kernel
+    from the bit fields."""
+    decode = "e4m3" if data.dtype == jnp.float8_e4m3fn else "e5m2"
+    bits = jax.lax.bitcast_convert_type(data, jnp.uint8)
+    return qmatmul_bytes(x, bits, scales, None, decode, block, out_dtype,
+                         block_o, interpret)
+
+
+# ---------------------------------------------------------------------------
+# packed multi-plane GEMV: fp6 (4+2) / sym_int5 (4+1) / nf3 (2+1)
+# and the two-level k-quants q2_k (2) / q5_k (4+1)
+# ---------------------------------------------------------------------------
+#
+# Generalization of the nibble half-split trick (module docstring): a
+# b-bit plane over N elements stores byte j = elements j + m*(N*b/8) at
+# bit offset b*m, so the m-th split of every plane is a *contiguous*
+# byte range unpacked with one static shift — never a strided
+# deinterleave. The kernel walks chunks WITHIN the finest split (all
+# coarser splits are multiples of it), so each chunk reads one
+# contiguous, 128-aligned slice per plane and one slice of x.
+# Eligibility (ops/linear.py table): K % (128 * finest_split_count) == 0
+# — the same Mosaic lane-alignment economics that put q6_k's codes in
+# int8 planes; misaligned shapes fall back to the XLA dequant path.
+
+def _plane_layout(K: int, planes: tuple):
+    """Static per-plane (data col offset, bits, splits, split elems)."""
+    out = []
+    off = 0
+    for bits in planes:
+        s = 8 // bits
+        out.append((off, bits, s, K // s))
+        off += K // s
+    return out
+
+
+def _plane_chunk_code(w, layout, e0: int, c: int):
+    """Decode elements [e0, e0+c) of every plane from the concatenated
+    plane array `w` [bo, total_bytes] -> int32 codes [bo, c]. e0 must not
+    cross a split boundary of any plane (guaranteed by chunking within
+    the finest split)."""
+    code = None
+    shift = 0
+    for off, bits, _s, q in layout:
+        mp = e0 // q
+        piece = (
+            _slc(w, off + e0 - mp * q, c).astype(jnp.int32) >> (bits * mp)
+        ) & ((1 << bits) - 1)
+        code = piece if code is None else code | (piece << shift)
+        shift += bits
+    return code
+
+
+def _decode_code(code, decode):
+    """int32 codes -> f32 values, per the static decode spec:
+    ("offset", o) -> code - o; ("lut", codebook) -> select tree;
+    ("e2m3",) -> fp6 arithmetic decode (exact FP6_CODEBOOK values)."""
+    kind = decode[0]
+    if kind == "offset":
+        return (code - decode[1]).astype(jnp.float32)
+    if kind == "lut":
+        v = jnp.zeros(code.shape, jnp.float32)
+        for i, ci in enumerate(decode[1]):
+            if ci != 0.0:
+                v = jnp.where(code == i, jnp.float32(ci), v)
+        return v
+    if kind == "e2m3":
+        sign = 1.0 - 2.0 * ((code >> 5) & 1).astype(jnp.float32)
+        e = (code >> 3) & 3
+        m = (code & 7).astype(jnp.float32)
+        pow2 = jnp.where(e == 3, 4.0, jnp.where(e == 2, 2.0, 1.0))
+        mag = jnp.where(e == 0, m, (8.0 + m) * pow2) * jnp.float32(1 / 16)
+        return sign * mag
+    raise ValueError(decode)
+
+
+def _kernel_planes(x_ref, w_ref, s_ref, o_ref, *, K: int, ck: int,
+                   planes: tuple, decode: tuple, block: int):
+    """One O-tile of the multi-plane GEMV with single-level per-block
+    scales, chunked within the finest plane split."""
+    M = x_ref.shape[0]
+    bo = w_ref.shape[0]
+    layout = _plane_layout(K, planes)
+    qmin = min(q for _, _, _, q in layout)
+    w = w_ref[:]  # concatenated plane bytes; upcast per chunk (VMEM bound)
+    s = _f16_bits_to_f32(s_ref[:])  # [bo, K/block]
+    x = x_ref[:].astype(jnp.bfloat16)
+
+    acc = jnp.zeros((M, bo), jnp.float32)
+    for m0 in range(K // qmin):
+        for c0, c in _chunks(qmin, ck):
+            e0 = m0 * qmin + c0
+            vals = _decode_code(_plane_chunk_code(w, layout, e0, c), decode)
+            sb0, nbc = e0 // block, c // block
+            wd = (vals * _expand_scales(_slc(s, sb0, nbc), c, block)
+                  ).astype(jnp.bfloat16)
+            acc += jax.lax.dot_general(
+                _slc(x, e0, c), wd, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "ck", "interpret",
+                              "planes", "decode", "block")
+)
+def _qmm_planes(x2, w, s_bits, out_dtype, block_o: int, ck: int,
+                interpret: bool, planes: tuple, decode: tuple, block: int):
+    M, K = x2.shape
+    O = w.shape[0]
+    nb = s_bits.shape[1]
+    wb = w.shape[1]
+    row = lambda o: (o, 0)
+    return pl.pallas_call(
+        functools.partial(_kernel_planes, K=K, ck=ck, planes=planes,
+                          decode=decode, block=block),
+        grid=(O // block_o,),
+        in_specs=[
+            pl.BlockSpec((M, K), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, wb), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nb), row, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
+        compiler_params=_params_parallel(),
+        interpret=interpret,
+    )(x2, w, s_bits)
+
+
+def qmatmul_planes(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K*bits/8] concatenated packed planes
+    scales: jax.Array,  # [O, K // block] f16
+    planes: tuple,  # per-plane bit widths, low bits first
+    decode: tuple,  # ("offset", o) | ("lut", codebook) | ("e2m3",)
+    block: int,
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused dequant-GEMV for packed multi-plane formats (fp6 at 6,
+    sym_int5 at 5, nf3 at 3 bits/weight of HBM traffic vs 16 for the
+    dequant fallback)."""
+    O, wb = data.shape
+    x2, lead, M, K, Mp, interpret = _gemv_prep(x, block_o, O, interpret)
+    bits = sum(planes)
+    assert wb * 8 == K * bits and K % (8 // min(planes)) == 0 \
+        and K % block == 0
+
+    qmin = K // max(8 // b for b in planes)
+    persist_row = wb + (K // block) * 2
+    block_o = _pick_block_o(O, persist_row, cap=block_o)
+    ck = _chunk_target(block_o, block_o * persist_row + Mp * K * 2, qmin)
+    y = _qmm_planes(x2, data, _f16_bits(scales), jnp.dtype(out_dtype),
+                    block_o, ck, interpret, tuple(planes), decode, block)
+    return y[:M].reshape(*lead, O)
+
+
+def _kernel_planes_kq(x_ref, w_ref, d_ref, dmin_ref, sc_ref, mn_ref, o_ref,
+                      *, K: int, ck: int, planes: tuple, sub: int):
+    """One O-tile of the two-level asym multi-plane GEMV (q2_k / q5_k):
+    w = (d*sc)*q - (dmin*mn) per `sub`-element sub-block. Same stacked
+    expansion as _kernel_q4k, same plane walk as _kernel_planes."""
+    M = x_ref.shape[0]
+    bo = w_ref.shape[0]
+    per_super = 256 // sub
+    layout = _plane_layout(K, planes)
+    qmin = min(q for _, _, _, q in layout)
+    w = w_ref[:]
+    d32 = _f16_bits_to_f32(d_ref[:])  # [bo, K/256]
+    dmin32 = _f16_bits_to_f32(dmin_ref[:])
+    scf = sc_ref[:].astype(jnp.float32)  # [bo, K/sub]
+    mnf = mn_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.bfloat16)
+
+    acc = jnp.zeros((M, bo), jnp.float32)
+    for m0 in range(K // qmin):
+        for c0, c in _chunks(qmin, ck):
+            e0 = m0 * qmin + c0
+            vals = _plane_chunk_code(w, layout, e0, c).astype(jnp.float32)
+            sb0, nsc = e0 // sub, c // sub
+            s_eff = _expand_super(d32, nsc, sb0, per_super) * (
+                _slc(scf, sb0, nsc))
+            m_eff = _expand_super(dmin32, nsc, sb0, per_super) * (
+                _slc(mnf, sb0, nsc))
+            stacked = jnp.concatenate([s_eff, m_eff], axis=0)  # [2*bo, nsc]
+            exp = _expand_scales(stacked, c, sub)
+            wd = (vals * exp[:bo] - exp[bo:]).astype(jnp.bfloat16)
+            acc += jax.lax.dot_general(
+                _slc(x, e0, c), wd, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "block_o", "ck", "interpret",
+                              "planes", "sub")
+)
+def _qmm_planes_kq(x2, w, d_bits, dmin_bits, sc, mn, out_dtype,
+                   block_o: int, ck: int, interpret: bool, planes: tuple,
+                   sub: int):
+    M, K = x2.shape
+    O = w.shape[0]
+    nb = d_bits.shape[1]
+    nsub = sc.shape[1]
+    wb = w.shape[1]
+    row = lambda o: (o, 0)
+    return pl.pallas_call(
+        functools.partial(_kernel_planes_kq, K=K, ck=ck, planes=planes,
+                          sub=sub),
+        grid=(O // block_o,),
+        in_specs=[
+            pl.BlockSpec((M, K), lambda o: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, wb), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nb), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nb), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nsub), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, nsub), row, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (M, block_o), lambda o: (0, o), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, O), out_dtype),
+        compiler_params=_params_parallel(),
+        interpret=interpret,
+    )(x2, w, d_bits, dmin_bits, sc, mn)
+
+
+def _qmatmul_kq_planes(x, data, scales, mins, sub_scales, sub_mins,
+                       planes, sub, out_dtype, block_o, interpret):
+    O, wb = data.shape
+    x2, lead, M, K, Mp, interpret = _gemv_prep(x, block_o, O, interpret)
+    assert wb * 8 == K * sum(planes) and K % 256 == 0
+
+    qmin = K // max(8 // b for b in planes)
+    persist_row = wb + (K // 256) * 4 + (K // sub) * 2
+    block_o = _pick_block_o(O, persist_row, cap=block_o)
+    ck = _chunk_target(block_o, block_o * persist_row + Mp * K * 2, qmin,
+                       temp_bpe=20)
+    y = _qmm_planes_kq(x2, data, _f16_bits(scales), _f16_bits(mins),
+                       sub_scales, sub_mins, jnp.dtype(out_dtype), block_o,
+                       ck, interpret, tuple(planes), sub)
+    return y[:M].reshape(*lead, O)
+
+
+def qmatmul_q2k(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, K // 4] quarter-split packed 2-bit codes
+    scales: jax.Array,  # [O, K // 256] f16 super-scale d
+    mins: jax.Array,  # [O, K // 256] f16 super-scale dmin
+    sub_scales: jax.Array,  # [O, K // 16] uint8 4-bit sc
+    sub_mins: jax.Array,  # [O, K // 16] uint8 4-bit mn
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused GEMV for planar q2_k: w = (d*sc)*q - (dmin*mn) per
+    16-element sub-block, 2.625 bits/weight of HBM traffic."""
+    return _qmatmul_kq_planes(x, data, scales, mins, sub_scales, sub_mins,
+                              (2,), 16, out_dtype, block_o, interpret)
+
+
+def qmatmul_q5k(
+    x: jax.Array,  # [..., K]
+    data: jax.Array,  # [O, 5K/8] half-split nibbles ++ 1-bit plane
+    scales: jax.Array,  # [O, K // 256] f16 super-scale d
+    mins: jax.Array,  # [O, K // 256] f16 super-scale dmin
+    sub_scales: jax.Array,  # [O, K // 32] uint8 6-bit sc
+    sub_mins: jax.Array,  # [O, K // 32] uint8 6-bit mn
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused GEMV for planar q5_k: q4_k's two-level math with the 5th
+    code bit read from an extra packed plane (5.625 bits/weight)."""
+    return _qmatmul_kq_planes(x, data, scales, mins, sub_scales, sub_mins,
+                              (4, 1), 32, out_dtype, block_o, interpret)

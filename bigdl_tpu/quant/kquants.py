@@ -4,18 +4,23 @@ The reference reaches these formats through its native quantizers
 (`ggml_quantize_tensor` with q4_k/q6_k qtypes, ggml/quantize.py:28-57 +
 gguf_mixed_qtype :60-61 in /root/reference). Here:
 
-- storage is the llama.cpp super-block byte layout (256 elements; q2_K:
-  16B 4-bit sub-scale/min pairs + 64B 2-bit quants + fp16 d/dmin = 84B;
-  q3_K: 32B high-bit mask + 64B 2-bit quants + 12B 6-bit scales + fp16 d
-  = 110B; q4_K: fp16 d/dmin + 12B packed 6-bit sub-scales/mins + 128B
-  nibbles = 144B; q5_K: q4_K's header + 32B high bits + 128B nibbles =
-  176B; q6_K: 128B low nibbles + 64B high bits + 16 int8 sub-scales +
-  fp16 d = 210B) so GGUF k-quant tensors repack into QTensor **without**
-  dequantization (convert/gguf.py);
-- `dequant_q4_k` / `dequant_q6_k` are jnp (jit-safe) — they run in-graph
-  on TPU, fused by XLA into the consuming matmul like the other formats;
+This module speaks the llama.cpp super-block BYTE layout (256 elements;
+q2_K: 16B 4-bit sub-scale/min pairs + 64B 2-bit quants + fp16 d/dmin =
+84B; q3_K: 32B high-bit mask + 64B 2-bit quants + 12B 6-bit scales +
+fp16 d = 110B; q4_K: fp16 d/dmin + 12B packed 6-bit sub-scales/mins +
+128B nibbles = 144B; q5_K: q4_K's header + 32B high bits + 128B nibbles
+= 176B; q6_K: 128B low nibbles + 64B high bits + 16 int8 sub-scales +
+fp16 d = 210B):
+
 - the encoders are host-side numpy (RTN two-level scales — the
-  non-imatrix ggml path) used at checkpoint ingest.
+  non-imatrix ggml path) used at checkpoint ingest and GGUF export;
+- the `dequant_*` jnp decoders are the byte-layout oracle the planar
+  repack (quant/kq_planar.py) is verified against bit-for-bit, and the
+  numpy import path's decode backend (convert/gguf.py).
+
+RUNTIME storage is NOT these bytes: every k-quant QTensor holds the
+planar fields of quant/kq_planar.py, which both XLA dequant and the
+fused Pallas GEMV kernels read directly.
 """
 
 from __future__ import annotations

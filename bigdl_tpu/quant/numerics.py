@@ -62,6 +62,53 @@ def unpack_nibbles(packed: jax.Array) -> jax.Array:
     return jnp.concatenate([lo, hi], axis=-1)
 
 
+def pack_planes(codes: jax.Array, planes: tuple) -> jax.Array:
+    """[..., K] uint8 codes -> concatenated bit planes (uint8).
+
+    The multi-split generalization of pack_nibbles: a b-bit plane over K
+    elements is K*b/8 bytes where byte j carries elements j + m*(K*b/8)
+    at bit offset b*m (m = 0 .. 8/b - 1). `planes` lists each plane's
+    bit width, LOW bits of the code first (fp6 = (4, 2); sym_int5 =
+    (4, 1); nf3 = (2, 1)); plane arrays concatenate along the last axis.
+    Every unpack — XLA or the Pallas fused GEMV — is static shifts of
+    contiguous slices, never a strided deinterleave.
+    """
+    k = codes.shape[-1]
+    shift = 0
+    outs = []
+    for bits in planes:
+        s = 8 // bits
+        q = k // s
+        sub = (codes >> shift) & ((1 << bits) - 1)
+        acc = sub[..., :q].astype(jnp.uint8)
+        for m in range(1, s):
+            acc = acc | (sub[..., m * q:(m + 1) * q] << (bits * m)).astype(
+                jnp.uint8)
+        outs.append(acc)
+        shift += bits
+    return jnp.concatenate(outs, axis=-1)
+
+
+def unpack_planes(data: jax.Array, planes: tuple, k: int) -> jax.Array:
+    """Inverse of pack_planes: concatenated planes -> [..., K] uint8."""
+    off = 0
+    shift = 0
+    code = None
+    for bits in planes:
+        s = 8 // bits
+        q = k // s
+        plane = data[..., off:off + q]
+        vals = jnp.concatenate(
+            [(plane >> (bits * m)) & ((1 << bits) - 1) for m in range(s)],
+            axis=-1,
+        )
+        part = (vals.astype(jnp.uint8) << shift).astype(jnp.uint8)
+        code = part if code is None else code | part
+        off += q
+        shift += bits
+    return code
+
+
 def _signed_absmax(xb: jax.Array) -> jax.Array:
     """Per-block value with the largest magnitude, keeping its sign (ggml Q4_0)."""
     idx = jnp.argmax(jnp.abs(xb), axis=-1, keepdims=True)
@@ -91,37 +138,20 @@ def quantize_blockwise(x: jax.Array, spec: QTypeSpec) -> dict:
     Single-level scales/mins are float16 with shape [..., K //
     block_size], matching the reference's half-precision block headers.
     K-quants encode on host (numpy) through the llama.cpp codec
-    (quant/kquants.py) — q4_k/q6_k then repack into the TPU planar
-    layout (quant/kq_planar.py); q2/q3/q5_k keep the super-block bytes
-    and decode in-graph.
+    (quant/kquants.py) and repack into the TPU planar layout
+    (quant/kq_planar.py) that the fused Pallas GEMV reads.
     """
     x = x.astype(jnp.float32)
     name = spec.name
 
-    if name in ("q4_k", "q6_k"):
+    if spec.superblock:  # k-quants: host codec + planar repack
         from bigdl_tpu.quant import kq_planar, kquants
 
         xh = np.asarray(x)  # host-side encode (ingest path)
-        if name == "q4_k":
-            fields = kq_planar.from_q4k_blocks(kquants.quantize_q4_k(xh))
-        else:
-            fields = kq_planar.from_q6k_blocks(kquants.quantize_q6_k(xh))
+        enc = getattr(kquants, f"quantize_{name}")
+        repack = getattr(kq_planar, f"from_{name.replace('_', '')}_blocks")
+        fields = repack(enc(xh))
         return {k: jnp.asarray(v) for k, v in fields.items()}
-
-    if spec.storage == "ggml_block":
-        from bigdl_tpu.quant import kquants
-
-        xh = np.asarray(x)  # host-side encode (ingest path)
-        _ENC = {
-            "q2_k": kquants.quantize_q2_k, "q3_k": kquants.quantize_q3_k,
-            "q5_k": kquants.quantize_q5_k,
-        }
-        if name not in _ENC:
-            raise NotImplementedError(name)
-        blocks = _ENC[name](xh)
-        d_off = kquants.KQUANT_LAYOUT[name][1]
-        d = blocks[..., d_off:d_off + 2].copy().view(np.float16)[..., 0]
-        return dict(data=jnp.asarray(blocks), scales=jnp.asarray(d))
 
     if spec.storage.startswith("fp8"):
         xb = _blocked(x, spec.block_size)
@@ -143,6 +173,8 @@ def quantize_blockwise(x: jax.Array, spec: QTypeSpec) -> dict:
         codes = codes.reshape(x.shape)
         if spec.storage == "packed_u8":
             data = pack_nibbles(codes.astype(jnp.uint8))
+        elif spec.storage == "packed_planes":
+            data = pack_planes(codes.astype(jnp.uint8), spec.planes)
         else:
             data = codes.astype(jnp.int8)
         return dict(data=data, scales=scale.astype(jnp.float16))
@@ -166,8 +198,8 @@ def quantize_blockwise(x: jax.Array, spec: QTypeSpec) -> dict:
         smax = _signed_absmax(xb)
         d = smax / -16.0
         q = jnp.clip(jnp.round(xb * _safe_inv(d)[..., None]) + 16.0, 0, 31)
-        return dict(data=q.reshape(x.shape).astype(jnp.int8),
-                    scales=d.astype(jnp.float16))
+        data = pack_planes(q.reshape(x.shape).astype(jnp.uint8), spec.planes)
+        return dict(data=data, scales=d.astype(jnp.float16))
 
     if name == "asym_int5":
         mins = jnp.min(xb, axis=-1)
@@ -210,33 +242,27 @@ def dequantize_blockwise(
     """Inverse of quantize_blockwise; returns [..., K] in `dtype`."""
     name = spec.name
 
-    if name == "q4_k":
-        # planar two-level asym: w = (d*sc)*q - (dmin*mn); matches
-        # kquants.dequant_q4_k bit-for-bit (f32, same grouping)
-        codes = unpack_nibbles(data).astype(jnp.float32)
+    if name in ("q4_k", "q2_k", "q5_k"):
+        # planar two-level asym: w = (d*sc)*q - (dmin*mn); matches the
+        # kquants.dequant_* byte decoders bit-for-bit (f32, same grouping)
+        if spec.storage == "packed_u8":
+            codes = unpack_nibbles(data)
+        else:
+            k = data.shape[-1] * 8 // spec.bits
+            codes = unpack_planes(data, spec.planes, k)
+        codes = codes.astype(jnp.float32)
         s = kq_effective_scales(scales, sub_scales)
         m = kq_effective_scales(mins, sub_mins)
         vb = _blocked(codes, spec.block_size)
         y = vb * s[..., None] - m[..., None]
         return y.reshape(codes.shape).astype(dtype)
 
-    if name == "q6_k":
+    if name in ("q6_k", "q3_k"):
         # planar two-level sym: w = (d*sc)*q, codes already centered
         s = kq_effective_scales(scales, sub_scales)
         vb = _blocked(data.astype(jnp.float32), spec.block_size)
         y = vb * s[..., None]
         return y.reshape(data.shape).astype(dtype)
-
-    if spec.storage == "ggml_block":
-        from bigdl_tpu.quant import kquants
-
-        _DEC = {
-            "q2_k": kquants.dequant_q2_k, "q3_k": kquants.dequant_q3_k,
-            "q5_k": kquants.dequant_q5_k,
-        }
-        if name not in _DEC:
-            raise NotImplementedError(name)
-        return _DEC[name](data, dtype)
 
     if spec.storage.startswith("fp8"):
         xb = _blocked(data.astype(jnp.float32), spec.block_size)
@@ -245,6 +271,9 @@ def dequantize_blockwise(
 
     if spec.storage == "packed_u8":
         codes = unpack_nibbles(data)
+    elif spec.storage == "packed_planes":
+        codes = unpack_planes(data, spec.planes,
+                              data.shape[-1] * 8 // spec.bits)
     else:
         codes = data
 

@@ -50,9 +50,9 @@ class QTensor:
         spec = self.spec
         if spec.storage == "packed_u8":
             return (*self.data.shape[:-1], self.data.shape[-1] * 2)
-        if spec.storage == "ggml_block":
-            # data [..., n_superblocks, block_bytes]
-            return (*self.data.shape[:-2], self.data.shape[-2] * spec.superblock)
+        if spec.storage == "packed_planes":
+            # planes store sum(planes) == spec.bits bits per element
+            return (*self.data.shape[:-1], self.data.shape[-1] * 8 // spec.bits)
         return tuple(self.data.shape)
 
     @property

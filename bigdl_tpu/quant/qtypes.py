@@ -85,11 +85,20 @@ class QTypeSpec:
     block_size: int  # elements sharing one scale along the contraction axis
     asymmetric: bool = False  # stores per-block mins in addition to scales
     codebook: np.ndarray | None = None  # LUT types (nf4/nf3/fp4/fp6)
-    storage: str = "packed_u8"  # packed_u8 | int8 | fp8_e4m3 | fp8_e5m2 |
-    # ggml_block | dense. ggml_block = k-quant super-blocks kept in the
-    # llama.cpp byte layout (data [.., n_sb, block_bytes] uint8).
+    storage: str = "packed_u8"  # packed_u8 | packed_planes | int8 |
+    # fp8_e4m3 | fp8_e5m2 | dense. packed_u8 = nibble pairs (half-split);
+    # packed_planes = the multi-split generalization (see `planes`);
     # dense == not quantized (fp16/bf16 passthrough kept as plain arrays)
-    block_bytes: int = 0  # ggml_block: bytes per super-block
+    block_bytes: int = 0  # ggml import/export codec: bytes per super-block
+    # packed_planes: bit widths of the stored planes, low bits first
+    # (e.g. fp6 = (4, 2): a half-split nibble plane + a quarter-split
+    # 2-bit plane). A b-bit plane over K elements is K*b/8 bytes where
+    # byte j carries elements j + m*(K*b/8) at bit offset b*m — the
+    # multi-split generalization of pack_nibbles' half-split trick, so
+    # both XLA and the Pallas GEMV unpack it with static shifts of
+    # contiguous slices. Planes are concatenated along the last axis of
+    # `data` in declaration order.
+    planes: tuple = ()
     # two-level (super-block) scale factorization: the contraction axis
     # must be a multiple of this at encode time, and QTensor carries
     # per-super-block f16 scales (d, dmin) in scales/mins plus integer
@@ -113,27 +122,41 @@ def _register(spec: QTypeSpec) -> QTypeSpec:
 SYM_INT4 = _register(QTypeSpec("sym_int4", bits=4, block_size=32))
 # ggml Q4_1-compatible: block 32, scale + min.
 ASYM_INT4 = _register(QTypeSpec("asym_int4", bits=4, block_size=32, asymmetric=True))
-# ggml Q5_0-compatible numerics, stored as int8 codes for simplicity (round 1).
-SYM_INT5 = _register(QTypeSpec("sym_int5", bits=5, block_size=32, storage="int8"))
+# ggml Q5_0-compatible numerics; codes 0..31 stored as a half-split
+# nibble plane + an eighth-split 1-bit plane (5 bits/weight in HBM — the
+# fused GEMV reads both planes in-kernel; was int8 codes until round 6).
+SYM_INT5 = _register(QTypeSpec(
+    "sym_int5", bits=5, block_size=32, storage="packed_planes", planes=(4, 1)
+))
 ASYM_INT5 = _register(
     QTypeSpec("asym_int5", bits=5, block_size=32, asymmetric=True, storage="int8")
 )
 # ggml Q8_0-compatible: block 32, absmax/127.
 SYM_INT8 = _register(QTypeSpec("sym_int8", bits=8, block_size=32, storage="int8"))
 NF4 = _register(QTypeSpec("nf4", bits=4, block_size=64, codebook=NF4_CODEBOOK))
-NF3 = _register(QTypeSpec("nf3", bits=3, block_size=64, codebook=NF3_CODEBOOK, storage="int8"))
+NF3 = _register(QTypeSpec(
+    "nf3", bits=3, block_size=64, codebook=NF3_CODEBOOK,
+    storage="packed_planes", planes=(2, 1),
+))
 FP4 = _register(QTypeSpec("fp4", bits=4, block_size=64, codebook=FP4_CODEBOOK))
-FP6 = _register(QTypeSpec("fp6", bits=6, block_size=64, codebook=FP6_CODEBOOK, storage="int8"))
+FP6 = _register(QTypeSpec(
+    "fp6", bits=6, block_size=64, codebook=FP6_CODEBOOK,
+    storage="packed_planes", planes=(4, 2),
+))
 FP8_E4M3 = _register(QTypeSpec("fp8_e4m3", bits=8, block_size=128, storage="fp8_e4m3"))
 FP8_E5M2 = _register(QTypeSpec("fp8_e5m2", bits=8, block_size=128, storage="fp8_e5m2"))
 # k-quants: 256-element super-blocks with two-level scales (ggml q4_K =
 # 4.5 bit/weight, q6_K = 6.5625). llama.cpp's interleaved byte layout is
-# a CPU-SIMD artifact; on TPU, q4_k and q6_k live in a PLANAR layout the
-# Pallas fused GEMV can read (half-split nibble / int8 code planes +
-# factored super-scales — see quant/kq_planar.py), with the exact
-# byte-level repack done once at the GGUF / encoder boundary. q2/q3/q5_k
-# (rarely-deployed formats) still store raw super-block bytes
-# (storage="ggml_block") and decode in-graph.
+# a CPU-SIMD artifact; on TPU, EVERY k-quant lives in a PLANAR layout
+# the Pallas fused GEMV can read (packed code planes + factored
+# super-scales — see quant/kq_planar.py), with the exact byte-level
+# repack done once at the GGUF / encoder boundary:
+#   q2_k — quarter-split 2-bit plane, 4-bit sc/mn per 16 elements;
+#   q3_k — int8 centered codes + int8 sc per 16 (exactly q6_k's planar
+#          structure, so it shares the q6_k fused kernel);
+#   q4_k/q5_k — half-split nibbles (+ eighth-split 1-bit plane for
+#          q5_k), 6-bit sc/mn per 32;
+#   q6_k — int8 centered codes + int8 sc per 16.
 # KQUANT_LAYOUT is the single source of truth for the on-disk byte
 # layouts: name -> (block_bytes, byte offset of the fp16 super-scale d).
 # Consumed by quant/kquants.py (codecs), quant/kq_planar.py (repack),
@@ -145,12 +168,22 @@ KQUANT_LAYOUT = {
     "q5_k": (176, 0),
     "q6_k": (210, 208),
 }
+# q2_k planar: data = quarter-split packed 2-bit codes [.., K/4]
+# (codes 0..3), scales/mins = d/dmin f16 [.., K/256], sub_scales/
+# sub_mins = 4-bit sc/mn u8 [.., K/16];
+# w = (d*sc)*q - (dmin*mn) per 16-element sub-block. 2.625 bit/weight.
 Q2_K = _register(QTypeSpec(
-    "q2_k", bits=2, block_size=256, storage="ggml_block", block_bytes=84,
-    asymmetric=True, superblock=256,
+    "q2_k", bits=2, block_size=16, storage="packed_planes", planes=(2,),
+    block_bytes=84, asymmetric=True, superblock=256,
 ))
+# q3_k planar: data = int8 centered codes (q-4 in [-4,3]) [.., K],
+# scales = d f16 [.., K/256], sub_scales = int8 sc [.., K/16];
+# w = (d*sc)*q per 16-element sub-block — structurally IDENTICAL to
+# planar q6_k, so it shares q6_k's fused GEMV kernel. int8 code planes
+# trade 3.35 -> 8.56 bit/weight for Mosaic lane alignment at every K
+# (same tradeoff as q6_k below).
 Q3_K = _register(QTypeSpec(
-    "q3_k", bits=3, block_size=256, storage="ggml_block", block_bytes=110,
+    "q3_k", bits=3, block_size=16, storage="int8", block_bytes=110,
     superblock=256,
 ))
 # q4_k planar: data = half-split packed nibbles [.., K/2] (codes 0..15),
@@ -161,9 +194,13 @@ Q4_K = _register(QTypeSpec(
     "q4_k", bits=4, block_size=32, storage="packed_u8", block_bytes=144,
     asymmetric=True, superblock=256,
 ))
+# q5_k planar: data = half-split packed nibbles [.., K/2] ++ eighth-
+# split 1-bit plane [.., K/8] (codes 0..31), scales/mins = d/dmin f16
+# [.., K/256], sub_scales/sub_mins = 6-bit sc/mn u8 [.., K/32];
+# w = (d*sc)*q - (dmin*mn) per 32-element sub-block. 5.625 bit/weight.
 Q5_K = _register(QTypeSpec(
-    "q5_k", bits=5, block_size=256, storage="ggml_block", block_bytes=176,
-    asymmetric=True, superblock=256,
+    "q5_k", bits=5, block_size=32, storage="packed_planes", planes=(4, 1),
+    block_bytes=176, asymmetric=True, superblock=256,
 ))
 # q6_k planar: data = int8 codes (q-32) [.., K], scales = d f16
 # [.., K/256], sub_scales = int8 sc [.., K/16]; w = (d*sc)*q per

@@ -22,44 +22,70 @@ def synth_qtensor(qtype: str, O: int, K: int,
     rng = rng or np.random.default_rng(0)
     spec = resolve_qtype(qtype)
     f16 = jnp.float16
-    if qtype == "sym_int8":
+
+    def scales(nb, mag=0.01):
+        return jnp.asarray(rng.random((O, nb), np.float32) * mag, f16)
+
+    if qtype in ("sym_int8", "q3_k"):
+        sub = spec.block_size if spec.superblock else None
         fields = dict(
-            data=jnp.asarray(rng.integers(-127, 128, (O, K), np.int8)),
-            scales=jnp.asarray(rng.random((O, K // 32), np.float32) * 0.01,
-                               f16),
+            data=jnp.asarray(rng.integers(-127, 128, (O, K), np.int8)
+                             if qtype == "sym_int8"
+                             else rng.integers(-4, 4, (O, K), np.int8)),
+            scales=scales(K // (spec.superblock or spec.block_size)),
+        )
+        if sub:
+            fields["sub_scales"] = jnp.asarray(
+                rng.integers(-32, 32, (O, K // sub), np.int8))
+    elif qtype == "asym_int5":
+        fields = dict(
+            data=jnp.asarray(rng.integers(0, 32, (O, K), np.int8)),
+            scales=scales(K // 32),
+            mins=scales(K // 32, mag=-0.08),
+        )
+    elif qtype in ("fp8_e4m3", "fp8_e5m2"):
+        dt = jnp.float8_e4m3fn if qtype == "fp8_e4m3" else jnp.float8_e5m2
+        fields = dict(
+            data=jnp.asarray(rng.normal(size=(O, K)), np.float32).astype(dt),
+            scales=scales(K // 128),
         )
     elif qtype == "q6_k":
         fields = dict(
             data=jnp.asarray(rng.integers(-32, 32, (O, K), np.int8)),
-            scales=jnp.asarray(rng.random((O, K // 256), np.float32) * 0.01,
-                               f16),
+            scales=scales(K // 256),
             sub_scales=jnp.asarray(
                 rng.integers(-64, 64, (O, K // 16), np.int8)),
         )
-    elif qtype == "q4_k":
+    elif qtype in ("q4_k", "q5_k", "q2_k"):
+        sub = spec.block_size  # 32 / 32 / 16
+        nbytes = K * spec.bits // 8 if spec.storage == "packed_planes" \
+            else K // 2
+        smax = 16 if qtype == "q2_k" else 64
         fields = dict(
-            data=jnp.asarray(rng.integers(0, 256, (O, K // 2), np.uint8)),
-            scales=jnp.asarray(rng.random((O, K // 256), np.float32) * 0.01,
-                               f16),
-            mins=jnp.asarray(rng.random((O, K // 256), np.float32) * 0.01,
-                             f16),
-            sub_scales=jnp.asarray(rng.integers(0, 64, (O, K // 32),
+            data=jnp.asarray(rng.integers(0, 256, (O, nbytes), np.uint8)),
+            scales=scales(K // 256),
+            mins=scales(K // 256),
+            sub_scales=jnp.asarray(rng.integers(0, smax, (O, K // sub),
                                                 np.uint8)),
-            sub_mins=jnp.asarray(rng.integers(0, 64, (O, K // 32),
+            sub_mins=jnp.asarray(rng.integers(0, smax, (O, K // sub),
                                               np.uint8)),
         )
     elif qtype == "asym_int4":
         fields = dict(
             data=jnp.asarray(rng.integers(0, 256, (O, K // 2), np.uint8)),
-            scales=jnp.asarray(rng.random((O, K // 32), np.float32) * 0.01,
-                               f16),
-            mins=jnp.asarray(rng.random((O, K // 32), np.float32) * -0.08,
-                             f16),
+            scales=scales(K // 32),
+            mins=scales(K // 32, mag=-0.08),
+        )
+    elif spec.storage == "packed_planes":  # sym_int5 / fp6 / nf3
+        fields = dict(
+            data=jnp.asarray(rng.integers(0, 256, (O, K * spec.bits // 8),
+                                          np.uint8)),
+            scales=scales(K // spec.block_size),
         )
     else:  # sym_int4 / nf4 / fp4: packed nibbles + one scale per block
         nb = K // spec.block_size
         fields = dict(
             data=jnp.asarray(rng.integers(0, 256, (O, K // 2), np.uint8)),
-            scales=jnp.asarray(rng.random((O, nb), np.float32) * 0.01, f16),
+            scales=scales(nb),
         )
     return QTensor(qtype=qtype, **fields)

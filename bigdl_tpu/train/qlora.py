@@ -93,7 +93,7 @@ def merge_lora(params: dict, lora: dict, requantize: Optional[str] = None) -> di
 
     def base_rows(name: str) -> int:
         # QTensor.shape is the LOGICAL shape for every storage (for
-        # ggml_block, data.shape[-2] would be n_superblocks, not rows)
+        # packed_u8/packed_planes, data.shape[-1] is bytes, not elements)
         return params["layers"][name].shape[-2]
 
     def row_start(target: str) -> int:

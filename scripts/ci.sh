@@ -12,18 +12,27 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 # reject (and segfault on) cache entries whose recorded machine features
 # mismatch the executing host (tests/conftest.py has the full story)
 
+# -n 2: two worker processes halve per-process native-state accumulation
+# (intermittent XLA:CPU compiler segfaults in very long single processes;
+# tests/conftest.py documents the full story). Degrade to a single
+# process when pytest-xdist is not installed rather than erroring out.
+if python -c "import xdist" 2> /dev/null; then
+  XDIST=(-n 2)
+else
+  XDIST=()
+  echo "note: pytest-xdist not installed; running single-process"
+fi
+
 if [[ "${1:-}" == "--core" ]]; then
-  echo "== core gate (< 5 min): quant/native/model/engine basics"
-  python -m pytest tests/ -q -n 2 -m core
+  echo "== core gate (< 5 min): quant/native/model/engine basics +"
+  echo "   fused-GEMV kernel parity for every qtype (test_pallas -m core)"
+  python -m pytest tests/ -q "${XDIST[@]}" -m core
   echo "CORE OK"
   exit 0
 fi
 
 echo "== unit + distributed tests (8-device CPU mesh)"
-# -n 2: two worker processes halve per-process native-state accumulation
-# (intermittent XLA:CPU compiler segfaults in very long single processes;
-# tests/conftest.py documents the full story)
-python -m pytest tests/ -q -n 2
+python -m pytest tests/ -q "${XDIST[@]}"
 
 echo "== driver contract: single-chip entry + multi-chip dryrun"
 python -c "

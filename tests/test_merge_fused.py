@@ -59,10 +59,9 @@ def test_merged_with_attention_bias():
 
 
 def test_kquant_merge_behavior():
-    """Planar q4_k (codes + factored scales, all O-leading) merges into
-    fused qkv like sym_int4 — one of the planar layout's wins over raw
-    ggml super-blocks. q5_k still stores super-block bytes with a
-    trailing byte axis, so merging stays a silent no-op there. (Dims
+    """Planar k-quants (codes + factored scales, all O-leading) merge
+    into fused qkv like sym_int4 — one of the planar layout's wins over
+    raw ggml super-blocks; since round 6 that includes q5_k. (Dims
     >= 256 so the k-quants apply instead of falling back.)"""
     cfg = ModelConfig(
         vocab_size=64, hidden_size=256, intermediate_size=256,
@@ -79,8 +78,9 @@ def test_kquant_merge_behavior():
     b = TpuModel(cfg, merged, "q4_k").generate(PROMPTS, max_new_tokens=8)
     np.testing.assert_array_equal(a, b)
 
-    ggml = optimize_model(dense, cfg, "q5_k", merge_fused=True)
-    assert "wq" in ggml["layers"] and "wqkv" not in ggml["layers"]
+    q5 = optimize_model(dense, cfg, "q5_k", merge_fused=True)
+    assert "wqkv" in q5["layers"] and "wq" not in q5["layers"]
+    assert q5["layers"]["wqkv"].qtype == "q5_k"
 
 
 def test_merged_under_tp_mesh():

@@ -313,3 +313,198 @@ def test_linear_dispatch_kquant_uses_kernel(rng, monkeypatch, qtype):
     # prefill shapes stay on the XLA dequant path
     xp = jnp.asarray(rng.normal(size=(1, 64, K)), jnp.float32)
     assert not _use_qgemv(xp, qt)
+
+
+# ---------------------------------------------------------------------------
+# round 6: universal fused dequant-GEMV — every decodable qtype
+# ---------------------------------------------------------------------------
+
+def _gemv_oracle(x, qt):
+    return jnp.einsum(
+        "mk,ok->mo", x.astype(jnp.bfloat16), qt.dequantize(jnp.bfloat16),
+        preferred_element_type=jnp.bfloat16,
+    )
+
+
+@pytest.mark.core
+@pytest.mark.parametrize("m", [1, 4])
+@pytest.mark.parametrize("qtype", ["fp8_e4m3", "fp8_e5m2"])
+def test_qmatmul_fp8_matches_dequant(rng, m, qtype):
+    """fp8 byte-codebook GEMV: the in-kernel arithmetic bit decode must
+    match XLA's fp8->f32 cast for every encodable pattern (tight-tol:
+    the only rounding is the shared bf16 weight cast)."""
+    from bigdl_tpu.ops.pallas.qmatmul import qmatmul_fp8
+
+    K, O = 256, 128
+    x = jnp.asarray(rng.normal(size=(m, K)), jnp.float32).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(O, K)) * 0.1, jnp.float32)
+    qt = quantize(w, qtype)
+    y = qmatmul_fp8(x, qt.data, qt.scales, block_o=128, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y, jnp.float32), np.asarray(_gemv_oracle(x, qt), jnp.float32),
+        atol=0.1, rtol=0.05,
+    )
+
+
+@pytest.mark.core
+def test_qmatmul_bytes_asym_int5_matches_dequant(rng):
+    """asym_int5 through the byte-code kernel: w = q*d + m, the per-block
+    min folded in exactly like the asym_int4 nibble kernel."""
+    from bigdl_tpu.ops.pallas.qmatmul import qmatmul_bytes
+
+    K, O = 128, 128
+    x = jnp.asarray(rng.normal(size=(2, K)), jnp.float32).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(O, K)) * 0.1 + 0.05, jnp.float32)
+    qt = quantize(w, "asym_int5")
+    y = qmatmul_bytes(x, qt.data, qt.scales, qt.mins, decode="i8",
+                      block=32, block_o=128, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y, jnp.float32), np.asarray(_gemv_oracle(x, qt), jnp.float32),
+        atol=0.15, rtol=0.05,
+    )
+
+
+@pytest.mark.core
+@pytest.mark.parametrize("qtype,K", [("sym_int5", 1024), ("fp6", 512),
+                                     ("nf3", 1024)])
+def test_qmatmul_planes_matches_dequant(rng, qtype, K):
+    """Packed multi-plane GEMV (4+1 / 4+2 / 2+1 bit planes): in-kernel
+    plane reassembly + decode vs the unpack_planes dequant oracle.
+    Exact for sym_int5 (integer decode); tight-tol for fp6 (arithmetic
+    e2m3 == FP6_CODEBOOK) and nf3 (8-entry LUT tree)."""
+    from bigdl_tpu.ops.pallas.qmatmul import qmatmul_planes
+    from bigdl_tpu.quant.qtypes import resolve_qtype
+
+    O = 128
+    spec = resolve_qtype(qtype)
+    x = jnp.asarray(rng.normal(size=(1, K)), jnp.float32).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(O, K)) * 0.1, jnp.float32)
+    qt = quantize(w, qtype)
+    if qtype == "fp6":
+        decode = ("e2m3",)
+    elif spec.codebook is not None:
+        decode = ("lut", tuple(float(c) for c in spec.codebook))
+    else:
+        decode = ("offset", 16)
+    y = qmatmul_planes(x, qt.data, qt.scales, spec.planes, decode,
+                       spec.block_size, block_o=128, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y, jnp.float32), np.asarray(_gemv_oracle(x, qt), jnp.float32),
+        atol=0.15, rtol=0.05,
+    )
+
+
+@pytest.mark.core
+@pytest.mark.parametrize("qtype,K", [("q2_k", 512), ("q2_k", 768),
+                                     ("q5_k", 1024), ("q5_k", 768)])
+def test_qmatmul_kq_planes_matches_dequant(rng, qtype, K):
+    """q2_k / q5_k two-level multi-plane GEMV vs the planar dequant
+    oracle. 768 = odd super-block count (mid-super chunk starts through
+    the offset one-hot expansion, like the q4_k test)."""
+    from bigdl_tpu.ops.pallas.qmatmul import qmatmul_q2k, qmatmul_q5k
+
+    O = 128
+    x = jnp.asarray(rng.normal(size=(2, K)), jnp.float32).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(O, K)) * 0.1, jnp.float32)
+    qt = quantize(w, qtype)
+    assert qt.qtype == qtype
+    fn = qmatmul_q2k if qtype == "q2_k" else qmatmul_q5k
+    y = fn(x, qt.data, qt.scales, qt.mins, qt.sub_scales, qt.sub_mins,
+           block_o=128, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y, jnp.float32), np.asarray(_gemv_oracle(x, qt), jnp.float32),
+        atol=0.15, rtol=0.05,
+    )
+
+
+@pytest.mark.core
+def test_qmatmul_q3k_shares_q6k_kernel(rng):
+    """Planar q3_k is structurally q6_k (int8 centered codes, int8
+    sub-scales per 16) and must run through the q6_k kernel unchanged."""
+    from bigdl_tpu.ops.pallas.qmatmul import qmatmul_q6k
+
+    K, O = 256, 128
+    x = jnp.asarray(rng.normal(size=(1, K)), jnp.float32).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(O, K)) * 0.1, jnp.float32)
+    qt = quantize(w, "q3_k")
+    assert qt.qtype == "q3_k"
+    y = qmatmul_q6k(x, qt.data, qt.scales, qt.sub_scales, block_o=128,
+                    interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y, jnp.float32), np.asarray(_gemv_oracle(x, qt), jnp.float32),
+        atol=0.1, rtol=0.05,
+    )
+
+
+@pytest.mark.core
+def test_gemv_dispatch_coverage(rng, monkeypatch):
+    """EVERY qtype in the registry with a decode path must be registered
+    in _QGEMV_QTYPES and dispatch to a fused kernel at an eligible
+    decode shape — the acceptance gate against XLA-fallback cliffs
+    (BENCH_NOTES r03: 2.7x). Also checks the shared shape guards."""
+    monkeypatch.setenv("BIGDL_TPU_PALLAS", "interpret")
+    from bigdl_tpu.ops.linear import _GEMV_MAX_ROWS, _QGEMV_QTYPES, _use_qgemv
+    from bigdl_tpu.quant import qtype_registry
+
+    decodable = {n for n, s in qtype_registry().items() if not s.is_dense}
+    assert decodable == set(_QGEMV_QTYPES), (
+        "fused-GEMV registry out of sync with quant/qtypes.py"
+    )
+    for name, entry in _QGEMV_QTYPES.items():
+        K = entry.k_multiple if entry.k_multiple >= 256 else 256
+        x = jnp.zeros((1, 1, K), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(128, K)) * 0.1, jnp.float32)
+        qt = quantize(w, name)
+        assert qt.qtype == name, name
+        assert _use_qgemv(x, qt), f"{name}: eligible decode shape missed"
+        # prefill rows and odd-O shapes stay on the XLA dequant path
+        assert not _use_qgemv(
+            jnp.zeros((1, _GEMV_MAX_ROWS + 1, K), jnp.float32), qt), name
+
+
+@pytest.mark.core
+def test_flash_fp8_kv_dequant_in_kernel(rng):
+    """Dense fp8-KV attention: fp8 codes + per-(slot, head) scales
+    dequantize inside the flash kernel, matching dequantize-then-flash
+    bitwise (both f32 multiplies)."""
+    from bigdl_tpu.kvcache import _quantize_heads
+
+    B, T, S, Hq, Hkv, D = 2, 16, 32, 4, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, T, Hq, D)), jnp.float32)
+    kf = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    vf = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    kq, ks = _quantize_heads(kf)
+    vq, vs = _quantize_heads(vf)
+    start = jnp.asarray([0, 3], jnp.int32)
+    qoff = jnp.asarray(S - T, jnp.int32)
+
+    kd = kq.astype(jnp.float32) * ks.astype(jnp.float32)[..., None]
+    vd = vq.astype(jnp.float32) * vs.astype(jnp.float32)[..., None]
+    ref = flash_attention(q, kd, vd, start=start, q_offset=qoff,
+                          interpret=True)
+    out = flash_attention(q, kq, vq, start=start, q_offset=qoff,
+                          k_scale=ks, v_scale=vs, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_llama_fp8_kv_prefill_flash_matches_xla(rng, monkeypatch):
+    """End-to-end: fp8-KV prefill through the flash kernel's in-kernel
+    dequant == the XLA dequant-and-attend path."""
+    from bigdl_tpu import kvcache
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+
+    config = PRESETS["tiny-llama"]
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size, (2, 12)), jnp.int32)
+
+    def run(env):
+        monkeypatch.setenv("BIGDL_TPU_PALLAS", env)
+        cache = kvcache.init_cache(
+            config.num_hidden_layers, 2, 32, config.num_key_value_heads,
+            config.head_dim_, quantize_kv=True,
+        )
+        logits, _ = llama.forward(config, params, tokens, cache, mode="prefill")
+        return np.asarray(logits, np.float32)
+
+    np.testing.assert_allclose(run("interpret"), run("0"), atol=5e-2)

@@ -54,6 +54,24 @@ def test_pack_unpack_roundtrip(rng):
     np.testing.assert_array_equal(np.asarray(out), codes)
 
 
+@pytest.mark.parametrize("planes,bits", [((4, 1), 5), ((4, 2), 6),
+                                         ((2, 1), 3), ((2,), 2)])
+def test_pack_planes_roundtrip(rng, planes, bits):
+    """Multi-split plane packing (fp6/sym_int5/nf3/q2_k/q5_k storage) is
+    bijective, and the numpy ingest packer matches the jnp one."""
+    from bigdl_tpu.quant.kq_planar import pack_planes_np
+    from bigdl_tpu.quant.numerics import pack_planes, unpack_planes
+
+    k = 128
+    codes = rng.integers(0, 1 << bits, size=(4, k), dtype=np.uint8)
+    packed = pack_planes(jnp.asarray(codes), planes)
+    assert packed.shape == (4, k * bits // 8)
+    np.testing.assert_array_equal(np.asarray(packed),
+                                  pack_planes_np(codes, planes))
+    out = unpack_planes(packed, planes, k)
+    np.testing.assert_array_equal(np.asarray(out), codes)
+
+
 @pytest.mark.parametrize("qtype", QUANT_TYPES)
 def test_roundtrip_error(rng, qtype):
     x = rng.standard_normal((8, 256)).astype(np.float32)
