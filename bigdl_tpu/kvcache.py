@@ -127,6 +127,51 @@ def insert_row(cache: KVCache, pcache: KVCache, slot, pad) -> KVCache:
     return dataclasses.replace(cache, **upd)
 
 
+def swap_out_row(cache: KVCache, slot: int, n: Optional[int] = None):
+    """Copy one pool row's KV (every layer, first `n` slots — the row's
+    live region; None = full row) to host RAM — the dense-engine half of
+    serving preemption (the paged twin is kvpaged.swap_out_pages).
+    Returns (k, v, k_scale|None, v_scale|None) numpy arrays;
+    byte-preserving, so swap-in + decode is bit-exact. Slots past pos
+    are never read (attention masks them; decode overwrites at pos), so
+    the caller passes n >= pos to skip transferring the idle tail."""
+    import numpy as np
+
+    sl = slice(None) if n is None else slice(0, n)
+    k = np.asarray(jax.device_get(cache.k[:, slot, sl]))
+    v = np.asarray(jax.device_get(cache.v[:, slot, sl]))
+    ks = vs = None
+    if cache.quantized:
+        ks = np.asarray(jax.device_get(cache.k_scale[:, slot, sl]))
+        vs = np.asarray(jax.device_get(cache.v_scale[:, slot, sl]))
+    return k, v, ks, vs
+
+
+def swap_in_row(cache: KVCache, k, v, k_scale, v_scale, slot, pos,
+                start) -> KVCache:
+    """Write a swapped-out row blob back into the first k.shape[1] slots
+    of row `slot` (need not be the row it came from; the stale tail
+    beyond the blob is masked exactly like the tail insert_row leaves)
+    and restore the row's pos/start. jit-friendly with traced
+    slot/pos/start — the blob length is static from the array shape, so
+    one program compiles per distinct (bucketed) length; the engine
+    wraps it with a donated cache so the write is in place."""
+    k = jnp.asarray(k, cache.k.dtype)
+    n = k.shape[1]
+    upd = dict(
+        k=cache.k.at[:, slot, :n].set(k),
+        v=cache.v.at[:, slot, :n].set(jnp.asarray(v, cache.v.dtype)),
+        pos=cache.pos.at[slot].set(pos),
+        start=cache.start.at[slot].set(start),
+    )
+    if cache.quantized:
+        upd["k_scale"] = cache.k_scale.at[:, slot, :n].set(
+            jnp.asarray(k_scale, cache.k_scale.dtype))
+        upd["v_scale"] = cache.v_scale.at[:, slot, :n].set(
+            jnp.asarray(v_scale, cache.v_scale.dtype))
+    return dataclasses.replace(cache, **upd)
+
+
 def _quantize_heads(
     x: jax.Array, scale_dtype=jnp.float16
 ) -> tuple[jax.Array, jax.Array]:

@@ -97,6 +97,71 @@ def init_paged(
     )
 
 
+# ---------------------------------------------------------------------------
+# Host-RAM page swap (serving preemption)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class HostKVPages:
+    """A preempted request's KV pages parked in host RAM (all layers,
+    page-granular). The serving engine swaps a victim out here, releases
+    its device pages, and swaps back into freshly allocated (possibly
+    different) physical pages on resume — contents are byte-preserved, so
+    decode after swap-in is bit-exact with the uninterrupted run. On a
+    real TPU runtime `jax.device_get` stages through the runtime's host
+    transfer buffers; the arrays below are plain (pageable) numpy — a
+    pinned-allocation fast path is a perf follow-up, not a correctness
+    one."""
+
+    k: "object"  # np.ndarray [L, n, page, Hkv, D] in the pool dtype
+    v: "object"
+    k_scale: Optional[object] = None  # [L, n, page, Hkv] when quantized
+    v_scale: Optional[object] = None
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def nbytes(self) -> int:
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
+
+
+def swap_out_pages(cache: PagedKVCache, pages) -> HostKVPages:
+    """Copy the listed physical pages' KV (every layer) to host RAM.
+    `pages` is a host-side list/array of physical page ids; the gather +
+    device→host transfer is one fused program per distinct page count."""
+    import numpy as np
+
+    ids = jnp.asarray(list(pages), jnp.int32)
+    k = np.asarray(jax.device_get(cache.k[:, ids]))
+    v = np.asarray(jax.device_get(cache.v[:, ids]))
+    ks = vs = None
+    if cache.quantized:
+        ks = np.asarray(jax.device_get(cache.k_scale[:, ids]))
+        vs = np.asarray(jax.device_get(cache.v_scale[:, ids]))
+    return HostKVPages(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+def swap_in_pages(cache: PagedKVCache, k, v, k_scale, v_scale,
+                  pages: jax.Array) -> PagedKVCache:
+    """Write a host blob's pages back into physical pages `pages` (a [n]
+    int32 array; need not be the pages the blob came from). jit-friendly:
+    the engine wraps it with donated cache buffers so the scatter happens
+    in place; one compiled program per distinct page count."""
+    upd = {"k": cache.k.at[:, pages].set(jnp.asarray(k, cache.k.dtype)),
+           "v": cache.v.at[:, pages].set(jnp.asarray(v, cache.v.dtype))}
+    if cache.quantized:
+        upd["k_scale"] = cache.k_scale.at[:, pages].set(
+            jnp.asarray(k_scale, cache.k_scale.dtype))
+        upd["v_scale"] = cache.v_scale.at[:, pages].set(
+            jnp.asarray(v_scale, cache.v_scale.dtype))
+    return dataclasses.replace(cache, **upd)
+
+
 def update_layer(
     cache: PagedKVCache, layer: jax.Array, k_new: jax.Array, v_new: jax.Array
 ) -> PagedKVCache:

@@ -77,6 +77,18 @@ def _sampling_kwargs(payload: dict) -> dict:
         kw["repetition_penalty"] = p
     if "eos_token_id" in payload:
         kw["eos_token_id"] = int(payload["eos_token_id"])
+    for f in ("queue_deadline_s", "deadline_s"):
+        # per-request overload controls (docs/serving.md): how long the
+        # request may wait for a slot, and its total wall-clock budget
+        if f in payload:
+            try:
+                v = float(payload[f])
+            except (TypeError, ValueError):
+                invalid_input_error(
+                    False, f"{f} must be a number, got {payload[f]!r}"
+                )
+            invalid_input_error(v > 0, f"{f} must be > 0, got {v}")
+            kw[f] = v
     return kw
 
 
@@ -122,6 +134,16 @@ class ApiServer:
         truncate_prompts: bool = False,  # opt-in: keep over-long tails
         logprobs_top_k: int = 0,  # OpenAI top_logprobs alternatives
         journal: Optional[str] = None,  # crash-recovery request journal
+        request_timeout_s: float = 300.0,  # buffered-wait / stream-stall
+        # budget; on expiry the request is CANCELLED in the engine (the
+        # slot frees) and the client sees 504 — never a leaked slot
+        max_queue: Optional[int] = None,  # engine admission bound: over-
+        # capacity submits get 429 + Retry-After instead of queueing
+        queue_deadline_s: Optional[float] = None,  # default max queue
+        # wait; expired requests get 503 + Retry-After
+        deadline_s: Optional[float] = None,  # default total budget (504)
+        preemption: bool = True,  # host-RAM KV swap under page pressure
+        faults=None,  # FaultInjector for chaos testing (serving/faults.py)
     ):
         from bigdl_tpu.serving.metrics import Metrics
 
@@ -132,7 +154,11 @@ class ApiServer:
             draft_k=draft_k, adaptive_draft=adaptive_draft,
             truncate_prompts=truncate_prompts,
             logprobs_top_k=logprobs_top_k, journal=journal,
+            max_queue=max_queue, queue_deadline_s=queue_deadline_s,
+            deadline_s=deadline_s, preemption=preemption, faults=faults,
         )
+        self.request_timeout_s = request_timeout_s
+        self._t_start = time.time()
         self.tokenizer = tokenizer
         self.whisper = whisper
         self.whisper_tokenizer = whisper_tokenizer
@@ -150,17 +176,19 @@ class ApiServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _json_raw(self, code: int, obj: Any):
+            def _json_raw(self, code: int, obj: Any, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _json(self, code: int, obj: Any):  # annotated for metrics
-                self._status = code
-                return self._json_raw(code, obj)
+            def _json(self, code: int, obj: Any, headers=None):
+                self._status = code  # annotated for metrics
+                return self._json_raw(code, obj, headers)
 
             def do_GET(self):
                 if self.path == "/health":
@@ -304,11 +332,10 @@ class ApiServer:
 
                 if not stream:
                     req = outer.engine.submit(ids, maxnt, **kw)
-                    outer._wait(req)
+                    if outer._wait(req):
+                        return self._timeout_504(req)
                     if req.error:
                         return self._req_error(req)
-                    if not req.done:
-                        return self._json(504, {"error": "generation timed out"})
                     text, stop_reason, n_gen = tokens_until_cut(req.out_tokens)
                     body = {"generated_text": text}
                     if params.get("details"):
@@ -343,7 +370,7 @@ class ApiServer:
                 pieces: list[str] = []
                 pending = None  # (tok, piece)
                 stopped = False
-                for tok in outer._stream_iter(q):
+                for tok in outer._stream_iter(q, req=req):
                     piece = outer._decode_tok([tok])
                     if pending is not None:
                         emit(*pending, None)
@@ -406,14 +433,43 @@ class ApiServer:
 
             @staticmethod
             def _rejected(req):
-                return req.done and req.finish_reason == "invalid"
+                return req.done and req.finish_reason in (
+                    "invalid", "shed"
+                )
+
+            def _timeout_504(self, req, error="generation timed out"):
+                """504 with the partial output delivered (docs/serving.md):
+                whether the kill came from the server's wait budget or
+                the engine's own deadline, a buffered transport must not
+                drop tokens a streaming client would already have
+                received."""
+                body = {"error": error}
+                # one snapshot: the engine thread may still be appending
+                # until the cancel reaps, and tokens/text must agree
+                toks = list(req.out_tokens)
+                if toks:
+                    body["tokens"] = toks
+                    body["text"] = outer._decode_tok(toks)
+                return self._json(504, body)
 
             def _req_error(self, req):
                 """One mapping for every endpoint: submit-time rejection
-                ("invalid", a client mistake) is 400; anything else is a
-                server-side 500."""
-                code = 400 if req.finish_reason == "invalid" else 500
-                return self._json(code, {"error": req.error})
+                ("invalid", a client mistake) is 400; overload shedding
+                is 429 (queue full) / 503 (queue deadline) with a
+                Retry-After derived from current throughput; a blown
+                deadline is 504; anything else is a server-side 500."""
+                reason = req.finish_reason
+                if reason == "invalid":
+                    return self._json(400, {"error": req.error})
+                if reason == "shed":
+                    code = 429 if req.shed_kind == "queue_full" else 503
+                    return self._json(
+                        code, {"error": req.error},
+                        headers={"Retry-After": outer._retry_after()},
+                    )
+                if reason == "timeout":
+                    return self._timeout_504(req, req.error)
+                return self._json(500, {"error": req.error})
 
             def _transcribe(self, raw: bytes):
                 if outer.whisper is None:
@@ -477,7 +533,7 @@ class ApiServer:
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.end_headers()
-                    for tok in outer._stream_iter(q):
+                    for tok in outer._stream_iter(q, req=req):
                         text = outer._decode_tok([tok])
                         evt = json.dumps({"token": tok, "text": text})
                         self.wfile.write(f"data: {evt}\n\n".encode())
@@ -489,11 +545,10 @@ class ApiServer:
                     return None
                 req = outer.engine.submit(ids, maxnt,
                                           **_sampling_kwargs(payload))
-                outer._wait(req)
+                if outer._wait(req):
+                    return self._timeout_504(req)
                 if req.error:
                     return self._req_error(req)
-                if not req.done:
-                    return self._json(504, {"error": "generation timed out"})
                 return self._json(200, {
                     "tokens": req.out_tokens,
                     "text": outer._decode_tok(req.out_tokens),
@@ -504,11 +559,10 @@ class ApiServer:
                 maxnt = int(payload.get("max_tokens", 64))
                 req = outer.engine.submit(ids, maxnt,
                                           **_sampling_kwargs(payload))
-                outer._wait(req)
+                if outer._wait(req):
+                    return self._timeout_504(req)
                 if req.error:
                     return self._req_error(req)
-                if not req.done:
-                    return self._json(504, {"error": "generation timed out"})
                 choice = {
                     "index": 0,
                     "text": outer._decode_tok(req.out_tokens),
@@ -567,7 +621,7 @@ class ApiServer:
                     self.send_header("Content-Type", "text/event-stream")
                     self.end_headers()
                     cid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
-                    for tok in outer._stream_iter(q):
+                    for tok in outer._stream_iter(q, req=req):
                         chunk = {
                             "id": cid, "object": "chat.completion.chunk",
                             "choices": [{
@@ -586,11 +640,10 @@ class ApiServer:
                     return None
                 req = outer.engine.submit(ids, maxnt,
                                           **_sampling_kwargs(payload))
-                outer._wait(req)
+                if outer._wait(req):
+                    return self._timeout_504(req)
                 if req.error:
                     return self._req_error(req)
-                if not req.done:
-                    return self._json(504, {"error": "generation timed out"})
                 return self._json(200, {
                     "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
                     "object": "chat.completion",
@@ -640,26 +693,77 @@ class ApiServer:
             return ""
         return self.tokenizer.decode(tokens, skip_special_tokens=True)
 
-    def _stream_iter(self, q, timeout: float = 300.0):
-        """Yield tokens until the None sentinel; a timeout (e.g. dead
-        engine before fail_all delivered sentinels) ends the stream rather
-        than blocking the handler thread forever."""
+    def _retry_after(self) -> int:
+        """Seconds a shed client should back off: queue depth over the
+        engine's observed completion throughput (conservative 30 s before
+        the first completion — no rate signal yet). The lifetime-average
+        rate goes stale across idle stretches, so the advice is capped:
+        a shed client should re-probe within minutes regardless."""
+        eng = self.engine
+        rate = eng.requests_completed / max(time.time() - self._t_start,
+                                            1e-6)
+        if rate <= 0:
+            return 30
+        depth = eng._queue.qsize() + 1
+        return max(1, min(int(depth / rate) + 1, 120))
+
+    def _stream_iter(self, q, timeout: Optional[float] = None, req=None):
+        """Yield tokens until the None sentinel. A stall past the timeout
+        (dead engine, injected stuck step) ends the stream AND cancels
+        the request in the engine — a stalled client stream must not keep
+        burning a decode slot."""
+        timeout = self.request_timeout_s if timeout is None else timeout
         while True:
             try:
                 tok = q.get(timeout=timeout)
             except queue.Empty:
+                if req is not None and not req.done:
+                    self.engine.cancel(req)
+                    # re-check AFTER the cancel, mirroring _wait: a
+                    # request that finished in the race window must not
+                    # be stamped stalled or counted as a timeout
+                    if not req.done:
+                        # the error makes every stream consumer's
+                        # post-loop branch emit a failure event — without
+                        # it, a timeout-truncated stream ends with the
+                        # same [DONE]/final-success shape as a complete
+                        # one (the engine reaps the cancel as a clean
+                        # "stop" and never clears the stamp)
+                        req.error = (
+                            f"stream stalled > {timeout}s; "
+                            "request cancelled"
+                        )
+                        self.engine._bump("request_timeouts")
                 return
             if tok is None:
                 return
             self.metrics.count_tokens(1)
             yield tok
 
-    def _wait(self, req, timeout: float = 300.0):
+    def _wait(self, req, timeout: Optional[float] = None) -> bool:
+        """Block until the request finishes; True = the server-side wait
+        budget expired. Callers must 504 on True without re-checking
+        req.done — the engine reaps the cancel concurrently, and a late
+        done/'stop' must not turn a timeout into a 200 with silently
+        truncated output."""
+        timeout = self.request_timeout_s if timeout is None else timeout
         t0 = time.time()
         while not req.done and time.time() - t0 < timeout:
             time.sleep(0.005)
-        if req.done and not req.error:
+        if not req.done:
+            # engine-cancelling timeout: before this, a timed-out
+            # buffered request kept decoding into its slot forever
+            self.engine.cancel(req)
+            if not req.done:
+                self.engine._bump("request_timeouts")
+                return True
+            # lost the race: the engine finished (and, for its own
+            # deadline kill, already counted) the request between our
+            # last poll and the cancel — bumping would double-count it;
+            # fall through to normal handling of the finished request
+        if not req.error:
             self.metrics.count_tokens(len(req.out_tokens))
+        return False
 
     # ---- lifecycle ---------------------------------------------------------
 

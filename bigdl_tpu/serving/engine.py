@@ -24,10 +24,13 @@ directly (serving/api_server.py).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import itertools
 import queue
+import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -37,6 +40,8 @@ import numpy as np
 from bigdl_tpu import kvcache
 from bigdl_tpu.generate import GenerationConfig, sample_token_per_row
 from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.serving.faults import NULL_INJECTOR, FaultError
+from bigdl_tpu.serving.metrics import Histogram
 from bigdl_tpu.utils import round_up
 
 
@@ -64,9 +69,22 @@ class Request:
     out_top_logprobs: list[dict] = dataclasses.field(default_factory=list)
     done: bool = False
     finish_reason: str = ""  # "stop" (EOS) | "length" (budget) |
-    # "invalid" (rejected at submit — over-long prompt) | "error"
+    # "invalid" (rejected at submit — over-long prompt) | "error" |
+    # "shed" (overload: queue bound / queue deadline — retryable) |
+    # "timeout" (per-request deadline expired mid-flight)
     error: Optional[str] = None
+    # which admission limit shed the request ("queue_full" |
+    # "queue_deadline") — structured so the HTTP layer's 429-vs-503
+    # choice never depends on parsing the human-readable error text
+    shed_kind: Optional[str] = None
     stream: Optional[queue.SimpleQueue] = None  # receives (token|None=EOS)
+    # overload controls (None = engine default): how long the request may
+    # wait for a slot, and its total wall-clock budget from submit
+    queue_deadline_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+    submit_ts: float = 0.0  # stamped by submit()
+    admit_ts: Optional[float] = None  # first slot admission
+    preemptions: int = 0  # times this request was swapped to host RAM
 
 
 @dataclasses.dataclass
@@ -74,6 +92,34 @@ class _Slot:
     req: Optional[Request] = None
     remaining: int = 0
     eos: Optional[int] = None  # resolved per-request EOS id
+    seq: int = 0  # admission order — the preemption victim policy's age
+    # pos at the last swap-in; -1 = never preempted. A slot that cannot
+    # extend AND has emitted nothing since its resume proves the pool
+    # cannot support it (self-preempting again would livelock).
+    resumed_pos: int = -1
+
+
+@dataclasses.dataclass
+class _Preempted:
+    """A request parked in host RAM: everything needed to resume decode
+    bit-exactly — the KV blob plus the slot-side sampling/progress state
+    that normally lives in the engine's per-slot arrays."""
+
+    req: Request
+    cur: int  # last emitted token (next decode input)
+    remaining: int
+    eos: Optional[int]
+    pos: int  # tokens written (prompt + emitted)
+    start: int  # dense left-pad offset (0 for paged)
+    seq: int  # original admission age (kept: resumed requests stay old)
+    temp: float
+    topk: int
+    topp: float
+    dosample: bool
+    penalty: float
+    seen: Any  # [V] bool host row (repetition-penalty state)
+    blob: Any  # kvpaged.HostKVPages | dense (k, v, ks, vs) tuple
+    n_pages: int = 0  # paged: pages to reallocate on resume
 
 
 class InferenceEngine:
@@ -103,6 +149,20 @@ class InferenceEngine:
         # so the top-k pass compiles only into engines that opt in
         quantize_kv: bool = False,
         journal: Optional[str] = None,
+        # ---- overload protection (docs/serving.md) ----
+        max_queue: Optional[int] = None,  # bound on waiting submits;
+        # over-capacity submits fail fast with finish_reason="shed"
+        queue_deadline_s: Optional[float] = None,  # default max wait for
+        # a slot; expired-in-queue requests are shed, not served late
+        deadline_s: Optional[float] = None,  # default total wall-clock
+        # budget per request; expiry mid-decode finishes "timeout"
+        preemption: bool = True,  # page-pool exhaustion mid-decode swaps
+        # a victim's KV to host RAM and requeues it instead of silently
+        # truncating its output with "length"
+        preemption_policy: str = "youngest",  # victim choice: "youngest"
+        # (least progress lost, default) or "oldest"
+        faults: Optional[Any] = None,  # FaultInjector (serving/faults.py);
+        # None = the shared inert injector (zero-cost hooks)
     ):
         self.model = model
         self._journal = None  # attached at the END of __init__ (it
@@ -213,7 +273,9 @@ class InferenceEngine:
             self._bt_dirty = True
             self._slot_pos = [0] * n_slots  # host mirror of cache.pos
         self._rng = jax.random.PRNGKey(seed)
-        self._queue: "queue.SimpleQueue[Request]" = queue.SimpleQueue()
+        # queue.Queue (not SimpleQueue): the queue-deadline sweep filters
+        # the backing deque in place under .mutex
+        self._queue: "queue.Queue[Request]" = queue.Queue()
         self._slots = [_Slot() for _ in range(n_slots)]
         self._rid = itertools.count()
         # model sharded via TpuModel.to_mesh(): all jitted steps run SPMD
@@ -347,10 +409,71 @@ class InferenceEngine:
         self.truncate_prompts = truncate_prompts
         self.logprobs_top_k = logprobs_top_k
         self._waiting: Optional[Request] = None  # paged OOM retry slot
-        # rids whose client went away (stop-string hit, disconnect):
-        # handler threads add, the engine thread frees the slot at the
-        # top of its next step — no cross-thread _finish races
-        self._cancelled: set[int] = set()
+        # rid -> Request whose client went away (stop-string hit,
+        # disconnect, server timeout): handler threads add, the engine
+        # thread frees the slot at the top of its next step — no
+        # cross-thread _finish races. The Request is kept (not just the
+        # rid) so the reaper can prune entries that lost the race with a
+        # normal finish; a bare rid set would grow forever in a
+        # long-running server.
+        self._cancelled: dict[int, Request] = {}
+
+        # ---- overload protection state ----
+        if preemption_policy not in ("youngest", "oldest"):
+            raise ValueError(
+                f"preemption_policy must be 'youngest' or 'oldest', "
+                f"got {preemption_policy!r}"
+            )
+        self.max_queue = max_queue
+        self.queue_deadline_s = queue_deadline_s
+        self.deadline_s = deadline_s
+        self.preemption = preemption
+        self.preemption_policy = preemption_policy
+        self._faults = faults if faults is not None else NULL_INJECTOR
+        # True while fail_all tears down after an (injected) crash:
+        # crash points must not re-fire inside the cleanup's _finish
+        # calls or the cleanup itself dies and the engine thread hangs
+        self._cleanup = False
+        # serializes the max_queue check-then-put across handler threads
+        # so the admission bound is exact, not best-effort
+        self._admission_lock = threading.Lock()
+        # guards counters bumped from handler threads AND the engine
+        # thread (requests_shed, request_timeouts) — see _bump
+        self._stat_lock = threading.Lock()
+        # one deadline-bearing submit arms the per-step queue sweep for
+        # the engine's lifetime; deployments that never set a deadline
+        # never pay the O(queue) scan under queue.mutex each step
+        self._deadlines_seen = (queue_deadline_s is not None
+                                or deadline_s is not None)
+        # preempted requests parked in host RAM, FIFO: the resume order.
+        # Only the engine thread touches it.
+        self._preempted: "collections.deque[_Preempted]" = collections.deque()
+        # operator/server-initiated preemption (thread-safe, like cancel)
+        self._preempt_requested: set[int] = set()
+        self._seq = itertools.count(1)  # slot admission age
+        # observability (serving/metrics.py renders these)
+        self.preemptions = 0
+        self.preemption_resumes = 0
+        self.requests_shed = 0
+        self.request_timeouts = 0
+        self.requests_completed = 0
+        self.queue_wait = Histogram()
+        # swap-in programs (swap-OUT is a plain device_get, no jit). The
+        # donated cache makes the restore an in-place scatter. Family
+        # caches (nested pools / property pos) have no row-swap story:
+        # preemption is gated off for them.
+        if self._family_cache is not None:
+            self.preemption = False
+        elif paged:
+            from bigdl_tpu import kvpaged
+
+            self._swap_in = self._with_mesh(jax.jit(
+                kvpaged.swap_in_pages, donate_argnames=("cache",)
+            ))
+        else:
+            self._dense_swap_in = self._with_mesh(jax.jit(
+                kvcache.swap_in_row, donate_argnames=("cache",)
+            ))
 
         # crash-recovery request journal (serving/journal.py): accepted
         # requests are appended as JSONL, completions tombstoned.
@@ -366,7 +489,17 @@ class InferenceEngine:
             entries, max_rid = RequestJournal.scan(journal)
             self._rid = itertools.count(max_rid + 1)
             self._journal = RequestJournal(journal)
-            self.recovered_requests = replay(self, entries)
+            # replay bypasses the admission bound: every entry was ACCEPTED
+            # by the previous process, and a shed here would erase its only
+            # journal record (replay tombstones the old rid the moment the
+            # replacement submit lands) — recovery must never shrink to
+            # max_queue. No thread races: __init__ hasn't returned, so no
+            # handler thread can interleave a live submit.
+            bound, self.max_queue = self.max_queue, None
+            try:
+                self.recovered_requests = replay(self, entries)
+            finally:
+                self.max_queue = bound
 
     def _with_mesh(self, fn):
         if self._mesh is None:
@@ -667,6 +800,8 @@ class InferenceEngine:
         top_p: Optional[float] = None,
         repetition_penalty: Optional[float] = None,
         eos_token_id: Optional[int] = None,
+        queue_deadline_s: Optional[float] = None,
+        deadline_s: Optional[float] = None,
     ) -> Request:
         if repetition_penalty is not None and repetition_penalty <= 0:
             raise ValueError(
@@ -687,7 +822,16 @@ class InferenceEngine:
             top_k=top_k, top_p=top_p,
             repetition_penalty=repetition_penalty,
             eos_token_id=eos_token_id,
+            queue_deadline_s=(queue_deadline_s
+                              if queue_deadline_s is not None
+                              else self.queue_deadline_s),
+            deadline_s=(deadline_s if deadline_s is not None
+                        else self.deadline_s),
+            submit_ts=time.time(),
         )
+        if req.queue_deadline_s is not None or req.deadline_s is not None:
+            self._deadlines_seen = True  # benign handler-thread race: a
+            # plain bool store, read by the engine thread next step
         if not req.prompt:
             req.error = "empty prompt — nothing to generate"
             req.finish_reason = "invalid"
@@ -728,9 +872,30 @@ class InferenceEngine:
             if stream is not None:
                 stream.put(None)
             return req
-        if self._journal is not None:
-            self._journal.record_submit(req)
-        self._queue.put(req)
+        if self.max_queue is None:
+            # unbounded admission needs no check-then-put atomicity:
+            # don't serialize every handler thread's submit (journal
+            # append + flush included) behind one lock for a bound that
+            # can never reject
+            if self._journal is not None:
+                self._journal.record_submit(req)
+            self._queue.put(req)
+            return req
+        with self._admission_lock:
+            if self._queue.qsize() >= self.max_queue:
+                # bounded admission: overload surfaces as a fast explicit
+                # rejection the client can retry, not as unbounded queue
+                # latency. Checked BEFORE the journal append — a shed
+                # request was never accepted, so a crash must not replay
+                # it.
+                self._shed_request(req, "queue_full", (
+                    f"queue full: {self._queue.qsize()} waiting >= "
+                    f"max_queue {self.max_queue}; retry later"
+                ), journaled=False)
+                return req
+            if self._journal is not None:
+                self._journal.record_submit(req)
+            self._queue.put(req)
         return req
 
     def _slot_sampling(self, req: Request) -> tuple[float, int, float, bool]:
@@ -761,6 +926,8 @@ class InferenceEngine:
     def _alloc_page(self) -> Optional[int]:
         """A free page, evicting the LRU unreferenced prefix-cache page
         when the free list is dry."""
+        if self._faults.fire("alloc_page") is not None:
+            return None  # injected pool exhaustion (serving/faults.py)
         if self._free_pages:
             pg = self._free_pages.pop()
             self._page_ref[pg] = 1
@@ -975,23 +1142,195 @@ class InferenceEngine:
         """Before a decode step, every active slot whose next `need_tokens`
         writes would run past its allocation gets more pages (speculative
         verify writes draft_k tokens before rolling back — the pages must
-        exist or the scatter clamps into a neighbour page); a slot that
-        can't extend is finished with 'length' (pool exhausted)."""
+        exist or the scatter clamps into a neighbour page). A slot that
+        cannot extend because the POOL is dry preempts a victim to host
+        RAM (youngest-first) instead of silently truncating its output;
+        'length' remains only for true logical capacity (max_pages_per_row)
+        or a pool that provably cannot support the request at all."""
         for i in np.nonzero(self.active)[0]:
             slot = int(i)
-            while self._slot_pos[slot] + need_tokens > self._slot_written[slot]:
+            while (self.active[slot]
+                   and self._slot_pos[slot] + need_tokens
+                   > self._slot_written[slot]):
                 idx = len(self._slot_pages[slot])
                 if idx >= self.max_pages_per_row:  # logical capacity hit
                     self._finish(slot, "length")
                     break
-                pg = self._alloc_page()
+                pg = self._alloc_page_preempting(slot)
                 if pg is None:
-                    self._finish(slot, "length")
+                    if self.active[slot]:  # not self-preempted: stuck
+                        self._finish(slot, "length")
                     break
                 self._slot_pages[slot].append(pg)
                 self._slot_written[slot] += self.page_size
                 self._bt_host[slot, idx] = pg
                 self._bt_dirty = True
+
+    # ---- preemption (host-RAM KV swap) ------------------------------------
+
+    def _alloc_page_preempting(self, slot: int) -> Optional[int]:
+        """_alloc_page, escalating to preemption under pool pressure:
+        swap victims out (policy order) until a page frees. With no other
+        victim, the requesting slot preempts ITSELF — but only if it has
+        made progress since its last resume; a no-progress self-preempt
+        proves the pool cannot support the request (swap-in would need
+        the very pages that are missing) and would livelock."""
+        while True:
+            pg = self._alloc_page()
+            if pg is not None or not self.preemption:
+                return pg
+            victim = self._pick_victim(exclude=slot)
+            if victim is not None:
+                self._preempt_slot(victim)
+                continue
+            s = self._slots[slot]
+            if s.resumed_pos < 0 or self._slot_pos[slot] > s.resumed_pos:
+                self._preempt_slot(slot)  # caller sees the slot inactive
+            return None
+
+    def _pick_victim(self, exclude: int) -> Optional[int]:
+        """Victim slot per policy. youngest = most recently (re)admitted:
+        it loses the least progress and, being FIFO-resumed behind older
+        preempted work, cannot starve the oldest request — the oldest is
+        never chosen while anyone else is active, so it always completes
+        and frees its pages."""
+        cands = [(s.seq, i) for i, s in enumerate(self._slots)
+                 if s.req is not None and i != exclude]
+        if not cands:
+            return None
+        pick = max(cands) if self.preemption_policy == "youngest" \
+            else min(cands)
+        return pick[1]
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Swap a slot's KV to host RAM and requeue its request with the
+        tokens generated so far; the slot frees WITHOUT finishing the
+        request (its stream sees a pause, never a sentinel). Decode after
+        the matching swap-in is bit-exact: the blob preserves the cache
+        bytes and the resume restores cur/seen/sampling state untouched."""
+        s = self._slots[slot]
+        req = s.req
+        if self.paged:
+            pos = self._slot_pos[slot]
+            n_keep = -(-pos // self.page_size)  # pages holding real KV
+            from bigdl_tpu import kvpaged
+
+            blob = kvpaged.swap_out_pages(
+                self.cache, self._slot_pages[slot][:n_keep]
+            )
+            start = 0
+        else:
+            pos = int(np.asarray(self.cache.pos[slot]))
+            start = int(np.asarray(self.cache.start[slot]))
+            # only the live region [0, pos) travels; bucketing to 64
+            # bounds the distinct swap-in program shapes (mirrors the
+            # paged twin's one-program-per-page-count)
+            n = min(round_up(max(pos, 1), 64), self.cache.max_len)
+            blob = kvcache.swap_out_row(self.cache, slot, n)
+            n_keep = 0
+        entry = _Preempted(
+            req=req, cur=int(np.asarray(self.cur[slot])),
+            remaining=s.remaining, eos=s.eos, pos=pos, start=start,
+            seq=s.seq, temp=float(self._temp[slot]),
+            topk=int(self._topk[slot]), topp=float(self._topp[slot]),
+            dosample=bool(self._dosample[slot]),
+            penalty=float(self._penalty[slot]),
+            seen=np.asarray(self.seen[slot]), blob=blob, n_pages=n_keep,
+        )
+        req.preemptions += 1
+        self.preemptions += 1
+        self._preempted.append(entry)
+        # free the slot WITHOUT _finish: the request is alive, just parked
+        self._free_slot_state(slot)
+        if not self.paged:
+            self.cache = dataclasses.replace(
+                self.cache, pos=self.cache.pos.at[slot].set(0)
+            )
+
+    def _resume_preempted(self, entry: _Preempted, slot: int) -> bool:
+        """Swap a parked request back into `slot` (fresh pages / any free
+        row — physical placement is irrelevant, the block table / row
+        index re-maps it). False = the pool cannot hold the restore yet;
+        the entry stays queued and newer admissions wait behind it."""
+        req = entry.req
+        if self.paged:
+            fresh: list[int] = []
+            for _ in range(entry.n_pages):
+                pg = self._alloc_page()
+                if pg is None:  # roll back; retry when pages free up
+                    for q in fresh:
+                        self._page_ref[q] = 0
+                        self._free_pages.append(q)
+                    return False
+                fresh.append(pg)
+            self._slot_pages[slot] = fresh
+            self._slot_written[slot] = entry.n_pages * self.page_size
+            row = np.zeros((self.max_pages_per_row,), np.int32)
+            row[: entry.n_pages] = fresh
+            self._bt_host[slot] = row
+            self._bt_dirty = True
+            b = entry.blob
+            self.cache = self._swap_in(
+                self.cache, b.k, b.v, b.k_scale, b.v_scale,
+                jnp.asarray(fresh, jnp.int32),
+            )
+            self.cache = dataclasses.replace(
+                self.cache,
+                pos=self.cache.pos.at[slot].set(entry.pos),
+                start=self.cache.start.at[slot].set(0),
+            )
+            self._slot_pos[slot] = entry.pos
+        else:
+            k, v, ks, vs = entry.blob
+            self.cache = self._dense_swap_in(
+                self.cache, k, v, ks, vs, jnp.asarray(slot),
+                jnp.asarray(entry.pos, jnp.int32),
+                jnp.asarray(entry.start, jnp.int32),
+            )
+        self.cur = self.cur.at[slot].set(entry.cur)
+        self.seen = self.seen.at[slot].set(jnp.asarray(entry.seen))
+        self._temp[slot], self._topk[slot] = entry.temp, entry.topk
+        self._topp[slot], self._dosample[slot] = entry.topp, entry.dosample
+        self._penalty[slot] = entry.penalty
+        self._slots[slot] = _Slot(
+            req=req, remaining=entry.remaining, eos=entry.eos,
+            seq=entry.seq, resumed_pos=entry.pos,
+        )
+        self.active[slot] = True
+        if self.speculative:
+            # the draft pool was not swapped (drafts are advisory — any
+            # draft content yields the same emitted tokens); rebuild the
+            # row from the full context so acceptance rates stay healthy
+            self._admit_draft(slot, req.prompt + req.out_tokens,
+                              self.max_len - req.max_new_tokens)
+        self.preemption_resumes += 1
+        return True
+
+    def preempt(self, req: Request) -> None:
+        """Thread-safe operator/server-initiated preemption: park the
+        request's KV in host RAM at the engine thread's next step and
+        requeue it for resume. Works for dense and paged pools. Only a
+        request currently DECODING in a slot is acted on — one that is
+        still queued, already parked, or finished has no device KV to
+        swap, so the call is a no-op for it (the marker is dropped at the
+        next step rather than lingering to ambush a later admission)."""
+        if self._family_cache is not None:
+            raise NotImplementedError(
+                f"preemption is not wired for "
+                f"{self.config.model_type}'s family cache"
+            )
+        self._preempt_requested.add(req.rid)
+
+    def _reap_preempt_requests(self) -> None:
+        if not self._preempt_requested:
+            return
+        # swap-then-clear: rids that don't match a live slot are dropped,
+        # not kept — handler threads may add() concurrently and those
+        # land in the fresh set for the next step
+        pending, self._preempt_requested = self._preempt_requested, set()
+        for i, s in enumerate(self._slots):
+            if s.req is not None and s.req.rid in pending:
+                self._preempt_slot(i)
 
     # ---- admission --------------------------------------------------------
 
@@ -1003,6 +1342,42 @@ class InferenceEngine:
             return self._queue.get_nowait()
         except queue.Empty:
             return None
+
+    def _shed_request(self, req: Request, kind: str, msg: str,
+                      journaled: bool = True) -> None:
+        """Overload rejection: explicit, fast, retryable (the API server
+        maps kind "queue_full" to 429 and "queue_deadline" to 503, both
+        with Retry-After)."""
+        req.shed_kind = kind
+        self._finish_detached(req, "shed", error=msg, journaled=journaled)
+        self._bump("requests_shed")
+
+    def _finish_detached(self, req: Request, reason: str,
+                         error: Optional[str] = None,
+                         journaled: bool = True) -> None:
+        """Terminal state for a request NOT currently in a slot (queued /
+        parked): mirrors _finish's journal + stream discipline.
+        journaled=False is for requests that were never accepted (shed at
+        submit) — they have no journal entry to tombstone."""
+        if error is not None:
+            req.error = error
+        req.finish_reason = reason
+        req.done = True
+        if journaled and self._journal is not None:
+            self._journal.record_done(req.rid)
+        if req.stream is not None:
+            req.stream.put(None)
+
+    @staticmethod
+    def _expired(req: Request, now: float) -> Optional[str]:
+        """The deadline a request has blown, if any."""
+        if (req.deadline_s is not None
+                and now - req.submit_ts > req.deadline_s):
+            return "deadline_s"
+        if (req.admit_ts is None and req.queue_deadline_s is not None
+                and now - req.submit_ts > req.queue_deadline_s):
+            return "queue_deadline_s"
+        return None
 
     def _activate(self, slot: int, req: Request, logits_last) -> None:
         """Shared post-prefill bookkeeping: sample the first token, arm
@@ -1037,8 +1412,12 @@ class InferenceEngine:
         eos = (req.eos_token_id if req.eos_token_id is not None
                else self.gen.eos_token_id)
         self._slots[slot] = _Slot(
-            req=req, remaining=req.max_new_tokens - 1, eos=eos
+            req=req, remaining=req.max_new_tokens - 1, eos=eos,
+            seq=next(self._seq),
         )
+        if req.admit_ts is None:
+            req.admit_ts = time.time()
+            self.queue_wait.observe(req.admit_ts - req.submit_ts)
         self._temp[slot], self._topk[slot] = temp, topk
         self._topp[slot], self._dosample[slot] = topp, dosample
         self._penalty[slot] = penalty
@@ -1081,9 +1460,42 @@ class InferenceEngine:
             slot = self._free_slot()
             if slot is None:
                 return
+            # preempted requests resume FIRST, in preemption order: they
+            # are the oldest in-flight work, and admitting new requests
+            # past a blocked resume would starve it of the very pages it
+            # waits for
+            if self._preempted:
+                # dead entries (cancelled / expired) at ANY depth were
+                # already dropped by _sweep_preempted this step
+                entry = self._preempted[0]
+                req = entry.req
+                if self._resume_preempted(entry, slot):
+                    self._preempted.popleft()
+                    continue
+                if not self.active.any():
+                    # nothing left to free pages: the pool cannot hold
+                    # the restore, ever — fail instead of hanging
+                    self._preempted.popleft()
+                    self._fail_request(req, (
+                        f"cannot resume preempted request: restoring "
+                        f"{entry.n_pages} pages exceeds the free pool; "
+                        "raise n_pages"
+                    ))
+                    continue
+                return  # wait for pages before admitting anything newer
             req = self._pop_request()
             if req is None:
                 return
+            if req.rid in self._cancelled:  # cancelled while queued: a
+                # timed-out/disconnected client must not burn the slot
+                self._cancelled.pop(req.rid, None)
+                self._finish_detached(req, "stop")
+                continue
+            now = time.time()
+            which = self._expired(req, now)
+            if which is not None:
+                self._expire_queued(req, which, now)
+                continue
             if self.paged:
                 if not self._admit_paged(req, slot):
                     self._waiting = req  # pool full: retry after frees
@@ -1110,14 +1522,34 @@ class InferenceEngine:
         if s.remaining <= 0:
             self._finish(slot, "length")
 
-    def _finish(self, slot: int, reason: str = "stop") -> None:
+    def _finish(self, slot: int, reason: str = "stop",
+                counted: bool = True) -> None:
         s = self._slots[slot]
         s.req.finish_reason = reason
         s.req.done = True
+        if counted and reason in ("stop", "length"):
+            # genuine completions only: cancelled/timed-out requests also
+            # land here as "stop" but must not inflate the throughput
+            # that _retry_after derives Retry-After from
+            self.requests_completed += 1
+        if (not self._cleanup
+                and self._faults.fire("crash_before_done") is not None):
+            # simulated process death in the journal's at-least-once
+            # window: the request completed but its tombstone was never
+            # written, so a successor engine must replay it
+            raise FaultError(
+                "injected crash before journal tombstone "
+                f"(rid {s.req.rid})"
+            )
         if self._journal is not None:
             self._journal.record_done(s.req.rid)
         if s.req.stream is not None:
             s.req.stream.put(None)
+        self._free_slot_state(slot)
+
+    def _free_slot_state(self, slot: int) -> None:
+        """Release a slot's engine-side state (sampling rows, pages)
+        without touching the request's terminal fields."""
         self._slots[slot] = _Slot()
         self.active[slot] = False
         self._dosample[slot] = False  # idle rows decode deterministic garbage
@@ -1138,6 +1570,7 @@ class InferenceEngine:
         )
         self._penalty[:] = 1.0
         self.active[:] = False
+        self._preempted.clear()  # blobs reference the old pool's layout
         if self.paged:
             self._free_pages = list(range(1, self.n_pages))  # 0 = scratch
             self._page_ref = [0] * self.n_pages
@@ -1155,18 +1588,184 @@ class InferenceEngine:
         """Thread-safe: stop generating for a request whose consumer is
         gone (stop-string cut, client disconnect). The slot frees on the
         engine thread's next step."""
-        self._cancelled.add(req.rid)
+        if req.done:  # lost the race with a normal finish: nothing to do
+            return
+        self._cancelled[req.rid] = req
 
     def _reap_cancelled(self) -> None:
+        # prune marks that lost the cancel-vs-finish race (the request
+        # finished between the caller's done-check and its cancel()).
+        # list() snapshots the items atomically (C-level copy) — handler
+        # threads insert concurrently, and iterating the live dict here
+        # would intermittently die with 'dict changed size'.
+        for rid, q in list(self._cancelled.items()):
+            if q.done:
+                self._cancelled.pop(rid, None)
         for i, s in enumerate(self._slots):
             if s.req is not None and s.req.rid in self._cancelled:
-                self._cancelled.discard(s.req.rid)
-                self._finish(i, "stop")
+                self._cancelled.pop(s.req.rid, None)
+                self._finish(i, "stop", counted=False)
+
+    def _inject_nan(self, lps: "np.ndarray") -> "np.ndarray":
+        """Chaos hook shared by the plain and speculative decode paths:
+        when nan_logits is armed, poison the victim rows' host-side
+        logprobs as if the model had produced non-finite values for
+        them (the quarantine guard downstream must catch it)."""
+        f = self._faults.fire("nan_logits")
+        if f is None:
+            return lps
+        lps = lps.copy()
+        victims = f.get("slots")
+        if victims is None:
+            act = np.nonzero(self.active)[0]
+            victims = [int(act[0])] if act.size else []
+        for v in victims:
+            lps[v] = np.nan
+        return lps
+
+    def _bump(self, counter: str) -> None:
+        """Increment an overload counter race-free: requests_shed and
+        request_timeouts are bumped from HTTP handler threads AND the
+        engine thread, and `+=` on an attribute is not atomic."""
+        with self._stat_lock:
+            setattr(self, counter, getattr(self, counter) + 1)
+
+    def _expire_queued(self, req: Request, which: str, now: float) -> None:
+        """Terminal handling for a request that expired before admission:
+        queue-deadline → shed (retryable 503), total deadline → timeout.
+        One copy — the admission pop and the saturation sweep must never
+        drift in message or counter discipline."""
+        if which == "queue_deadline_s":
+            self._shed_request(req, "queue_deadline", (
+                f"queue deadline: waited {now - req.submit_ts:.2f}s > "
+                f"queue_deadline_s={req.queue_deadline_s}"
+            ))
+        else:
+            self._finish_detached(
+                req, "timeout",
+                error=f"deadline_s={req.deadline_s} exceeded before "
+                "admission",
+            )
+            self._bump("request_timeouts")
+
+    def _sweep_preempted(self) -> None:
+        """Drop parked requests whose client cancelled or whose deadline
+        expired, at ANY depth of the deque — a blocked head must not
+        keep an already-dead request (and its host KV blob) parked
+        indefinitely behind it. Engine-thread only, like _preempted."""
+        if not self._preempted:
+            return
+        now = time.time()
+        keep: "collections.deque[_Preempted]" = collections.deque()
+        for entry in self._preempted:
+            req = entry.req
+            if req.rid in self._cancelled:
+                self._cancelled.pop(req.rid, None)
+                self._finish_detached(req, "stop")
+                continue
+            if self._expired(req, now) is not None:
+                # finish BEFORE the bump: once done is set, a racing
+                # server-side wait timeout sees it and stands down, so
+                # the counter records the request exactly once
+                self._finish_detached(
+                    req, "timeout",
+                    error=f"deadline_s={req.deadline_s} exceeded "
+                    "while preempted",
+                )
+                self._bump("request_timeouts")
+                continue
+            keep.append(entry)
+        self._preempted = keep
+
+    def _sweep_queue(self) -> None:
+        """Drop requests that died while still WAITING in the queue —
+        expired deadlines AND cancelled clients — even when no slot
+        frees: a saturated engine must not 429 new clients over a queue
+        of already-dead work. A deadline-dead request's client gets its
+        promised fast 503 instead of waiting for a slot that may be
+        minutes away; a cancelled entry (server timeout, disconnect)
+        stops counting against max_queue the next step, not when a slot
+        eventually frees."""
+        if not self._deadlines_seen and not self._cancelled:
+            return
+        now = time.time()
+        # the paged OOM-retry slot waits like a queue entry and gets the
+        # same dead-work treatment — _admit can return early (blocked
+        # preemption resume) for many steps without ever popping it
+        if self._waiting is not None:
+            req = self._waiting
+            if req.rid in self._cancelled:
+                self._waiting = None
+                self._cancelled.pop(req.rid, None)
+                self._finish_detached(req, "stop")
+            else:
+                which = self._expired(req, now)
+                if which is not None:
+                    self._waiting = None
+                    self._expire_queued(req, which, now)
+        if self._queue.empty():
+            return
+        expired: list[tuple[Request, str]] = []
+        cancelled: list[Request] = []
+        with self._queue.mutex:  # surgery on the deque under the queue's
+            # own lock; qsize()/put() stay consistent, FIFO order is
+            # kept. One partition pass: each verdict computed once, and
+            # the mutex (which blocks handler-thread submits) is held for
+            # a single scan
+            q = self._queue.queue
+            keep = []
+            for r in q:
+                which = self._expired(r, now)
+                if r.rid in self._cancelled:
+                    cancelled.append(r)
+                elif which is not None:
+                    expired.append((r, which))
+                else:
+                    keep.append(r)
+            if expired or cancelled:
+                q.clear()
+                q.extend(keep)
+        for req in cancelled:  # journal/stream work outside the lock
+            self._cancelled.pop(req.rid, None)
+            self._finish_detached(req, "stop")
+        for req, which in expired:
+            self._expire_queued(req, which, now)
+
+    def _reap_deadlines(self) -> None:
+        """Kill in-flight requests past their total wall-clock budget:
+        partial output is delivered, finish_reason records 'timeout'."""
+        now = time.time()
+        for i, s in enumerate(self._slots):
+            if s.req is None or s.req.deadline_s is None:
+                continue
+            if s.req.rid in self._cancelled:
+                # a server-side wait timeout got here first: it already
+                # counted the timeout, and the next _reap_cancelled will
+                # free the slot — bumping again would double-count the
+                # one request in request_timeouts_total
+                continue
+            if now - s.req.submit_ts > s.req.deadline_s:
+                s.req.error = (
+                    f"deadline_s={s.req.deadline_s} exceeded after "
+                    f"{len(s.req.out_tokens)} tokens"
+                )
+                # finish (sets done) BEFORE the bump: a racing _wait
+                # timeout stands down on done, so one timed-out request
+                # is never counted twice
+                self._finish(i, "timeout")
+                self._bump("request_timeouts")
 
     def step(self) -> bool:
         """Admit queued requests, advance every active slot one token.
         Returns True if any work remains."""
+        f = self._faults.fire("slow_step")
+        if f is not None:  # injected device stall (serving/faults.py)
+            time.sleep(float(f.get("seconds", 0.05)))
         self._reap_cancelled()
+        self._reap_preempt_requests()
+        self._reap_deadlines()
+        self._sweep_preempted()
+        self._sweep_queue()
         self._admit()
         if self.paged:
             # reserve for the CURRENT ladder K (== draft_k when not
@@ -1181,7 +1780,8 @@ class InferenceEngine:
                 )
                 self._bt_dirty = False
         if not self.active.any():
-            return not self._queue.empty() or self._waiting is not None
+            return (not self._queue.empty() or self._waiting is not None
+                    or bool(self._preempted))
         self._rng, k = jax.random.split(self._rng)
         if self.speculative:
             return self._step_speculative(k)
@@ -1200,13 +1800,24 @@ class InferenceEngine:
             raise
         self.cur = nxt
         toks = np.asarray(nxt)
-        lps_h = np.asarray(lps)
+        lps_h = self._inject_nan(np.asarray(lps))
         tops_h = None
         if top is not None:
             tops_h = (np.asarray(top[0]), np.asarray(top[1]))
         for i in np.nonzero(self.active)[0]:
             i = int(i)
             s = self._slots[i]
+            if not np.isfinite(lps_h[i]):
+                # non-finite logits guard: quarantine the ONE poisoned
+                # slot (its sampled token/logprob are garbage) instead of
+                # letting the exception path fail_all the whole batch —
+                # per-row decode means other slots' math is untouched
+                s.req.error = (
+                    "non-finite logits in decode step; request "
+                    "quarantined (other slots unaffected)"
+                )
+                self._finish(i, "error")
+                continue
             s.remaining -= 1
             if self.paged:
                 self._slot_pos[i] += 1
@@ -1239,7 +1850,7 @@ class InferenceEngine:
             raise
         self.cur = cur2
         choice_h = np.asarray(choice)
-        lp_h = np.asarray(lp_all)
+        lp_h = self._inject_nan(np.asarray(lp_all))
         n_acc_h = np.asarray(n_acc)
         self.spec_rounds += 1
         if self.adaptive_draft:
@@ -1247,6 +1858,15 @@ class InferenceEngine:
         for i in np.nonzero(self.active)[0]:
             i = int(i)
             s = self._slots[i]
+            if not np.all(np.isfinite(lp_h[i, : int(n_acc_h[i]) + 1])):
+                # same quarantine as the plain path: one poisoned row
+                # must not take the batch down
+                s.req.error = (
+                    "non-finite logits in speculative verify; request "
+                    "quarantined (other slots unaffected)"
+                )
+                self._finish(i, "error")
+                continue
             if self.paged:  # mirror the post-rollback pool position
                 self._slot_pos[i] += int(n_acc_h[i]) + 1
             for t in range(int(n_acc_h[i]) + 1):
@@ -1279,31 +1899,46 @@ class InferenceEngine:
 
     def _fail_request(self, req: Request, msg: str) -> None:
         """Terminal failure for a request not (or no longer) in a slot."""
-        req.error = msg
-        req.finish_reason = "error"
-        req.done = True
-        if self._journal is not None:
-            self._journal.record_done(req.rid)
-        if req.stream is not None:
-            req.stream.put(None)
+        self._finish_detached(req, "error", error=msg)
 
     def fail_all(self, msg: str) -> None:
         """Mark every in-flight and queued request failed (engine-thread
-        crash path — streams get their sentinel so clients unblock)."""
-        for i, s in enumerate(self._slots):
-            if s.req is not None:
+        crash path — streams get their sentinel so clients unblock).
+        Injected crash points are suppressed for the duration: cleanup
+        after a crash must not itself crash (an armed crash_before_done
+        with charges left would otherwise kill the engine thread)."""
+        self._cleanup = True
+        try:
+            for i, s in enumerate(self._slots):
+                if s.req is None:
+                    continue
+                if s.req.done:
+                    # crashed INSIDE _finish (injected crash_before_done):
+                    # the request completed — deliver the sentinel it
+                    # never got and free the slot, but do NOT rewrite its
+                    # terminal state or journal a tombstone; the whole
+                    # point of the crash window is that a successor
+                    # engine replays this request (at-least-once)
+                    if s.req.stream is not None:
+                        s.req.stream.put(None)
+                    self._free_slot_state(i)
+                    continue
                 s.req.error = msg
                 self._finish(i, "error")
-        if self._waiting is not None:
-            req, self._waiting = self._waiting, None
-            self._fail_request(req, msg)
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            self._fail_request(req, msg)
-        self.active[:] = False
+            if self._waiting is not None:
+                req, self._waiting = self._waiting, None
+                self._fail_request(req, msg)
+            while self._preempted:  # parked requests die with the engine
+                self._fail_request(self._preempted.popleft().req, msg)
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._fail_request(req, msg)
+            self.active[:] = False
+        finally:
+            self._cleanup = False
 
     def run_until_idle(self, max_steps: int = 100000) -> None:
         for _ in range(max_steps):

@@ -57,11 +57,18 @@ class FastChatWorker:
         heartbeat_s: float = HEARTBEAT_S,
         truncate_prompts: bool = False,
         journal: Optional[str] = None,  # crash-recovery request journal
+        # overload protection (docs/serving.md), same knobs as ApiServer
+        max_queue: Optional[int] = None,
+        queue_deadline_s: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        preemption: bool = True,
     ):
         self.engine = InferenceEngine(
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, speculative=speculative, draft_k=draft_k,
             truncate_prompts=truncate_prompts, journal=journal,
+            max_queue=max_queue, queue_deadline_s=queue_deadline_s,
+            deadline_s=deadline_s, preemption=preemption,
         )
         self.tokenizer = tokenizer
         self.controller_addr = controller_addr
@@ -279,8 +286,13 @@ class FastChatWorker:
             if req.error:
                 # 50007 = FastChat CONTEXT_OVERFLOW: a client mistake
                 # (over-long prompt rejected at submit), not a worker
-                # failure — gateways must not health-flap on it
-                code = 50007 if req.finish_reason == "invalid" else 50002
+                # failure — gateways must not health-flap on it.
+                # 42903 = ENGINE_OVERLOADED: shed requests (queue bound
+                # / queue deadline) and per-request deadline kills
+                # (docs/serving.md) are retryable load pressure, not
+                # worker failures either.
+                code = {"invalid": 50007, "shed": 42903,
+                        "timeout": 42903}.get(req.finish_reason, 50002)
                 yield {"text": req.error, "error_code": code, "usage": {},
                        "finish_reason": "error"}
             else:

@@ -26,12 +26,16 @@ from __future__ import annotations
 import json
 import os
 import threading
+import warnings
 from typing import Optional
 
-# sampling/stop fields that survive a restart (stream deliberately not)
+# sampling/stop/deadline fields that survive a restart (stream
+# deliberately not). Deadlines are measured from the REPLAYED submit's
+# own clock — the previous process's wall-clock budget is unknowable
+# after a crash, and a fresh window errs on serving, not dropping.
 _REPLAY_FIELDS = (
     "max_new_tokens", "do_sample", "temperature", "top_k", "top_p",
-    "repetition_penalty", "eos_token_id",
+    "repetition_penalty", "eos_token_id", "queue_deadline_s", "deadline_s",
 )
 
 
@@ -69,21 +73,38 @@ class RequestJournal:
     @staticmethod
     def scan(path: str) -> tuple[list[dict], int]:
         """Parse a journal file -> (submit entries with no done marker,
-        in submission order; highest rid seen). Torn trailing lines
-        (crash mid-append) are skipped."""
+        in submission order; highest rid seen). A truncated TRAILING line
+        (the crash-mid-append case this journal must expect) is skipped
+        with a warning; undecodable interior lines are skipped with a
+        louder warning (they mean corruption beyond a torn tail). Either
+        way recovery proceeds — a damaged line must never block replay of
+        the intact entries around it."""
         if not os.path.exists(path):
             return [], -1
         submits: dict[int, dict] = {}
         max_rid = -1
+        # one-line lookbehind instead of readlines(): a long-lived
+        # journal can be large and recovery must stream it. An
+        # undecodable line is only a torn tail if NOTHING follows it.
+        torn: Optional[tuple[int, str]] = None
         with open(path, "r", encoding="utf-8") as f:
-            for line in f:
+            for i, line in enumerate(f):
                 line = line.strip()
                 if not line:
                     continue
+                if torn is not None:
+                    warnings.warn(
+                        f"{path}: skipping undecodable journal line "
+                        f"{torn[0] + 1} (interior corruption): "
+                        f"{torn[1][:60]!r}",
+                        stacklevel=2,
+                    )
+                    torn = None
                 try:
                     obj = json.loads(line)
                 except json.JSONDecodeError:
-                    continue  # torn write at crash point
+                    torn = (i, line)
+                    continue
                 rid = obj.get("rid")
                 if not isinstance(rid, int):
                     continue  # malformed entry must not block recovery
@@ -94,6 +115,12 @@ class RequestJournal:
                     submits[rid] = obj
                 elif obj.get("op") == "done":
                     submits.pop(rid, None)
+        if torn is not None:
+            warnings.warn(
+                f"{path}: skipping truncated trailing journal "
+                f"line (crash mid-append): {torn[1][:60]!r}",
+                stacklevel=2,
+            )
         return list(submits.values()), max_rid
 
     @staticmethod

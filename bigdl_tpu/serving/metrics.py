@@ -17,6 +17,46 @@ from collections import defaultdict
 _BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
+class Histogram:
+    """Minimal lock-free Prometheus histogram: one writer (the engine
+    thread observes), any reader (a racing render sees a value at most
+    one observation stale — fine for scraping)."""
+
+    def __init__(self, buckets=_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.sum = 0.0
+
+    def observe(self, x: float) -> None:
+        for i, ub in enumerate(self.buckets):
+            if x <= ub:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.sum += x
+
+    def render_series(self, name: str, label: str = "") -> list:
+        """The bucket/sum/count sample lines only (no HELP/TYPE) —
+        labelled histogram families emit one HELP/TYPE header over many
+        series. `label` is a preformatted 'key="value",' prefix."""
+        lines = []
+        cum = 0
+        for i, ub in enumerate(self.buckets):
+            cum += self.counts[i]
+            lines.append(f'{name}_bucket{{{label}le="{ub}"}} {cum}')
+        cum += self.counts[-1]
+        lines.append(f'{name}_bucket{{{label}le="+Inf"}} {cum}')
+        suffix = f"{{{label[:-1]}}}" if label else ""
+        lines.append(f"{name}_sum{suffix} {self.sum:.6f}")
+        lines.append(f"{name}_count{suffix} {cum}")
+        return lines
+
+    def render(self, name: str, help_text: str) -> list:
+        return [f"# HELP {name} {help_text}",
+                f"# TYPE {name} histogram"] + self.render_series(name)
+
+
 class Metrics:
     def __init__(self, engine=None):
         self._lock = threading.Lock()
@@ -24,23 +64,21 @@ class Metrics:
         self.requests = defaultdict(int)  # (endpoint, status) -> count
         self.tokens_generated = 0
         self.requests_failed = 0
-        self.hist_counts = defaultdict(lambda: [0] * (len(_BUCKETS) + 1))
-        self.hist_sum = defaultdict(float)
+        self.hist = defaultdict(Histogram)  # endpoint -> latency histogram
 
     # -- recording ----------------------------------------------------------
     def observe_request(self, endpoint: str, status: int, seconds: float):
         with self._lock:
             self.requests[(endpoint, status)] += 1
-            if status >= 500:
+            if status >= 500 and status != 503:
+                # 503 is deliberate load shedding (queue deadline,
+                # docs/serving.md) — the designed healthy overload
+                # response, tracked by bigdl_tpu_requests_shed_total;
+                # counting it here would make the failure-rate alert
+                # fire on backpressure (and inconsistently: the 429
+                # shed path never counted)
                 self.requests_failed += 1
-            counts = self.hist_counts[endpoint]
-            for i, ub in enumerate(_BUCKETS):
-                if seconds <= ub:
-                    counts[i] += 1
-                    break
-            else:
-                counts[-1] += 1
-            self.hist_sum[endpoint] += seconds
+            self.hist[endpoint].observe(seconds)
 
     def count_tokens(self, n: int):
         with self._lock:
@@ -68,25 +106,9 @@ class Metrics:
                 "# HELP bigdl_tpu_request_seconds request latency",
                 "# TYPE bigdl_tpu_request_seconds histogram",
             ]
-            for ep, counts in sorted(self.hist_counts.items()):
-                cum = 0
-                for i, ub in enumerate(_BUCKETS):
-                    cum += counts[i]
-                    lines.append(
-                        f'bigdl_tpu_request_seconds_bucket{{endpoint="{ep}",'
-                        f'le="{ub}"}} {cum}'
-                    )
-                cum += counts[-1]
-                lines.append(
-                    f'bigdl_tpu_request_seconds_bucket{{endpoint="{ep}",'
-                    f'le="+Inf"}} {cum}'
-                )
-                lines.append(
-                    f'bigdl_tpu_request_seconds_sum{{endpoint="{ep}"}} '
-                    f"{self.hist_sum[ep]:.6f}"
-                )
-                lines.append(
-                    f'bigdl_tpu_request_seconds_count{{endpoint="{ep}"}} {cum}'
+            for ep, hist in sorted(self.hist.items()):
+                lines += hist.render_series(
+                    "bigdl_tpu_request_seconds", f'endpoint="{ep}",'
                 )
         if self.engine is not None:
             busy = int(self.engine.active.sum())
@@ -100,7 +122,37 @@ class Metrics:
                 "# HELP bigdl_tpu_queue_depth requests waiting for a slot",
                 "# TYPE bigdl_tpu_queue_depth gauge",
                 f"bigdl_tpu_queue_depth {self.engine._queue.qsize()}",
+                # overload-protection observability (docs/serving.md):
+                # preemption activity, load shedding, and deadline kills
+                # are invisible without these — an operator must be able
+                # to tell "we truncated output" never happens from graphs
+                "# HELP bigdl_tpu_preemptions_total requests swapped to "
+                "host RAM under page-pool pressure",
+                "# TYPE bigdl_tpu_preemptions_total counter",
+                f"bigdl_tpu_preemptions_total {self.engine.preemptions}",
+                "# HELP bigdl_tpu_preemption_resumes_total preempted "
+                "requests swapped back in and resumed",
+                "# TYPE bigdl_tpu_preemption_resumes_total counter",
+                f"bigdl_tpu_preemption_resumes_total "
+                f"{self.engine.preemption_resumes}",
+                "# HELP bigdl_tpu_requests_shed_total requests rejected "
+                "at/behind admission (queue bound or queue deadline)",
+                "# TYPE bigdl_tpu_requests_shed_total counter",
+                f"bigdl_tpu_requests_shed_total {self.engine.requests_shed}",
+                "# HELP bigdl_tpu_request_timeouts_total requests killed "
+                "by a deadline or server wait timeout",
+                "# TYPE bigdl_tpu_request_timeouts_total counter",
+                f"bigdl_tpu_request_timeouts_total "
+                f"{self.engine.request_timeouts}",
+                "# HELP bigdl_tpu_preempted_waiting preempted requests "
+                "parked in host RAM awaiting resume",
+                "# TYPE bigdl_tpu_preempted_waiting gauge",
+                f"bigdl_tpu_preempted_waiting {len(self.engine._preempted)}",
             ]
+            lines += self.engine.queue_wait.render(
+                "bigdl_tpu_queue_wait_seconds",
+                "submit-to-first-admission wait",
+            )
             if self.engine.paged:
                 lines += [
                     "# HELP bigdl_tpu_free_pages allocatable KV pages",

@@ -25,8 +25,9 @@ fi
 
 if [[ "${1:-}" == "--core" ]]; then
   echo "== core gate (< 5 min): quant/native/model/engine basics +"
-  echo "   fused-GEMV kernel parity for every qtype (test_pallas -m core)"
-  python -m pytest tests/ -q "${XDIST[@]}" -m core
+  echo "   fused-GEMV kernel parity for every qtype (test_pallas -m core) +"
+  echo "   fault-injection chaos suite (CPU-only; slow storm variants excluded)"
+  python -m pytest tests/ -q "${XDIST[@]}" -m "core or (chaos and not slow)"
   echo "CORE OK"
   exit 0
 fi
