@@ -217,10 +217,15 @@ class TpuModel:
             return contextlib.nullcontext()
         return jax.set_mesh(self.mesh)
 
-    def save_low_bit(self, path: str) -> None:
+    def save_low_bit(self, path: str, *, faults=None) -> None:
+        """Atomic, digest-manifested save (convert/low_bit.py): a kill
+        mid-save leaves any previous checkpoint at `path` bit-identical,
+        and the written artifact carries per-tensor crc32/sha256 digests
+        for load-time verification."""
         from bigdl_tpu.convert import save_low_bit
 
-        save_low_bit(path, self.config, self.params, self.qtype)
+        save_low_bit(path, self.config, self.params, self.qtype,
+                     faults=faults)
 
     def generate(
         self,
@@ -513,11 +518,31 @@ class AutoModelForCausalLM:
         return _merged_model(config, params, qtype, merge_fused)
 
     @classmethod
-    def load_low_bit(cls, path: str) -> TpuModel:
+    def load_low_bit(cls, path: str, verify: str = "fast",
+                     salvage: bool = False) -> TpuModel:
+        """Load a save_low_bit checkpoint with integrity verification
+        (convert/low_bit.py): verify="off"|"fast" (crc32)|"full" (sha256
+        + NaN/inf + scale-range validation). Corruption raises a
+        structured IntegrityError naming every bad tensor; salvage=True
+        loads the valid subset instead and leaves the quarantine report
+        on the returned model as `model.salvage_report` (None = clean).
+        A salvaged model is for inspection/weight recovery — forward
+        passes will fail on the quarantined tensors."""
         from bigdl_tpu.convert import load_low_bit
 
-        config, params, qtype = load_low_bit(path)
-        return _merged_model(config, params, qtype)
+        if salvage:
+            config, params, qtype, report = load_low_bit(
+                path, verify=verify, salvage=True,
+            )
+        else:
+            config, params, qtype = load_low_bit(path, verify=verify)
+            report = None
+        # a quarantined (partial) tree can't run the fused merge — the
+        # missing tensors would KeyError mid-surgery
+        model = _merged_model(config, params, qtype,
+                              merge_fused=report is None)
+        model.salvage_report = report
+        return model
 
     @classmethod
     def from_gguf(cls, path: str, qtype: Optional[str] = None) -> TpuModel:

@@ -10,6 +10,7 @@ binary; here every path is the same XLA program) plus `llm_convert`
     python -m bigdl_tpu.cli serve    <model_dir> --port 8000
     python -m bigdl_tpu.cli bench    <model_dir>
     python -m bigdl_tpu.cli chat     <model_dir>
+    python -m bigdl_tpu.cli verify   <ckpt_dir | ckpt.npz>
 """
 
 from __future__ import annotations
@@ -313,6 +314,51 @@ def cmd_txt2img(args):
           f"{args.steps} steps, cfg {args.guidance})")
 
 
+def cmd_verify(args):
+    """Offline integrity + numerical validation (docs/durability.md):
+    `full` mode — sizes/shapes/crc32/sha256 against the artifact's
+    integrity manifest plus NaN/inf and scale-range scans — with a
+    per-tensor report. Exit code 1 on ANY finding, so CI and operators
+    can gate a deploy on a clean checkpoint. Accepts a save_low_bit
+    directory or a train-checkpoint .npz (a rotation directory verifies
+    every candidate)."""
+    path = args.path
+    reports = []
+    if os.path.isdir(path):
+        if os.path.exists(os.path.join(path, "bigdl_tpu_config.json")):
+            from bigdl_tpu.convert.low_bit import verify_low_bit
+
+            reports.append(verify_low_bit(path))
+        else:
+            from bigdl_tpu.train.checkpoint import (
+                list_train_checkpoints, verify_train_checkpoint,
+            )
+
+            ckpts = list_train_checkpoints(path)
+            if not ckpts:
+                raise SystemExit(
+                    f"{path}: neither a low-bit checkpoint "
+                    "(bigdl_tpu_config.json) nor a train-checkpoint "
+                    "rotation directory (ckpt-*.npz)"
+                )
+            reports += [verify_train_checkpoint(p) for p in ckpts]
+    elif path.endswith(".npz"):
+        from bigdl_tpu.train.checkpoint import verify_train_checkpoint
+
+        reports.append(verify_train_checkpoint(path))
+    else:
+        raise SystemExit(
+            f"{path}: expected a checkpoint directory or a .npz file"
+        )
+    ok = True
+    for rep in reports:
+        print(rep.format())
+        ok = ok and rep.ok
+    if not ok:
+        raise SystemExit(1)
+    print("OK")
+
+
 def cmd_bench(args):
     model = _load(args.model, args.qtype)
     n_in, n_out = args.in_len, args.out_len
@@ -447,6 +493,15 @@ def main(argv=None):
                         "in constant memory")
     ch.add_argument("--streaming-sink", type=int, default=4)
     ch.set_defaults(fn=cmd_chat)
+
+    v = sub.add_parser(
+        "verify",
+        help="full integrity + numerical validation of a low-bit or "
+             "train checkpoint; exit 1 on any finding",
+    )
+    v.add_argument("path", help="save_low_bit dir, train .npz, or a "
+                                "rotation dir of ckpt-*.npz")
+    v.set_defaults(fn=cmd_verify)
 
     b = sub.add_parser("bench", help="quick decode-latency check", parents=[qp])
     b.add_argument("model")

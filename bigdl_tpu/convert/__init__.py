@@ -7,7 +7,7 @@ from bigdl_tpu.convert.hf import (
     layer_tensors,
     top_tensors,
 )
-from bigdl_tpu.convert.low_bit import save_low_bit, load_low_bit
+from bigdl_tpu.convert.low_bit import save_low_bit, load_low_bit, verify_low_bit
 
 __all__ = [
     "params_from_state_dict",
@@ -16,4 +16,5 @@ __all__ = [
     "top_tensors",
     "save_low_bit",
     "load_low_bit",
+    "verify_low_bit",
 ]

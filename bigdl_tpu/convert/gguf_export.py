@@ -172,12 +172,20 @@ def write_gguf(
     path: str,
     metadata: dict[str, Any],
     tensors: dict[str, tuple[tuple, int, Any]],  # name -> (shape, type, get)
+    *,
+    faults=None,
 ) -> None:
     """Write a GGUF v3 file STREAMING: payload sizes are computed from
     (shape, ggml_type) alone, the directory is written first, and each
     tensor is materialized (get() -> f32 array), encoded, written, and
     dropped — peak host memory stays ~one tensor, not the model
-    (a 7B export would otherwise hold ~35 GB of f32 + blocks)."""
+    (a 7B export would otherwise hold ~35 GB of f32 + blocks).
+
+    The file lands through the atomic tmp+fsync+rename protocol
+    (utils/durability.py): a kill mid-export — or a mid-stream encoder
+    error — never leaves a truncated .gguf where a previous export
+    stood. `faults` threads a DiskFaultInjector through the write
+    (tests only)."""
     metadata = dict(metadata)
     metadata["general.alignment"] = ALIGN
 
@@ -189,7 +197,7 @@ def write_gguf(
         _w_str(meta_buf, k)
         _w_value(meta_buf, v)
 
-    with open(path, "wb") as f:
+    def _write_body(f) -> None:
         f.write(struct.pack("<IIQQ", GGUF_MAGIC, 3, len(tensors), len(metadata)))
         f.write(meta_buf.getvalue())
         offset = 0
@@ -211,6 +219,10 @@ def write_gguf(
             f.write(data)
             pad = (len(data) + ALIGN - 1) // ALIGN * ALIGN - len(data)
             f.write(b"\x00" * pad)
+
+    from bigdl_tpu.utils.durability import atomic_write
+
+    atomic_write(path, _write_body, faults=faults)
 
 
 # ---------------------------------------------------------------------------
@@ -239,6 +251,7 @@ def export_gguf(
     qtype: str = "q8_0",
     name: str = "bigdl-tpu-export",
     extra_metadata: Optional[dict] = None,
+    faults=None,
 ) -> None:
     """Export a llama-family param tree to GGUF (weights quantize to
     `qtype`; norms stay f32). QTensor leaves dequantize first — GGUF
@@ -380,4 +393,4 @@ def export_gguf(
             )
     if extra_metadata:
         md.update(extra_metadata)
-    write_gguf(path, md, tensors)
+    write_gguf(path, md, tensors, faults=faults)

@@ -4,12 +4,23 @@ Equivalent of the reference's `save_low_bit`/`load_low_bit`
 (transformers/model.py:58-104, optimize.py:40-57,137-196): quantize once,
 reload in seconds without re-running conversion. Format: a directory with
 
-    bigdl_tpu_config.json   {format_version, qtype, model_config, manifest}
+    bigdl_tpu_config.json   {format_version, qtype, model_config,
+                             manifest, integrity}
     weights.npz             flat arrays; bf16/fp8 stored as integer views
 
 The manifest records each pytree path, its dtype, and which paths fold
 back into QTensor nodes, so loading needs no model code — it rebuilds the
 exact param pytree.
+
+Durability (utils/durability.py): both files are written through the
+atomic tmp+fsync+rename protocol, so a kill mid-save leaves the previous
+checkpoint bit-identical; the `integrity` section records per-tensor
+crc32/sha256 digests that `load_low_bit(verify="fast"|"full")` checks,
+raising a structured IntegrityError (never a bare KeyError) that names
+every corrupted / missing / extra tensor. `salvage=True` loads the valid
+subset instead and returns the quarantine report. Low-bit formats make
+this non-optional: a flipped byte in packed codes or scales doesn't
+crash, it silently dequantizes garbage.
 """
 
 from __future__ import annotations
@@ -17,7 +28,9 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any
+import re
+import warnings
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +38,8 @@ import numpy as np
 
 from bigdl_tpu.models.config import ModelConfig
 from bigdl_tpu.quant import QTensor
+from bigdl_tpu.utils import durability
+from bigdl_tpu.utils.durability import IntegrityError
 
 # v2: sym_int4/asym_int4/codebook4 nibble packing changed from
 # interleaved (2i, 2i+1 per byte) to half-split (j, j+K/2 per byte) —
@@ -87,26 +102,73 @@ def _flatten(tree: Any, prefix: str, arrays: dict, manifest: dict) -> None:
     manifest[prefix] = {"kind": "array", "dtype": dt}
 
 
-def save_low_bit(path: str, config: ModelConfig, params: dict, qtype: str) -> None:
+# matches current + superseded weights archives AND their stale tmps
+# ("weights-<token>.npz.tmp-<pid>"), which the post-commit GC sweeps;
+# anchored so unrelated operator files (weights.npz.bak) are never swept
+_WEIGHTS_RE = re.compile(r"^weights(-[0-9a-f]{8})?\.npz(\.tmp-\d+)?$")
+
+
+def save_low_bit(path: str, config: ModelConfig, params: dict, qtype: str,
+                 *, faults=None) -> None:
+    """Atomic, digest-manifested save with ONE commit point: the config
+    rename. A fresh save writes the documented `weights.npz`; an
+    overwrite writes a uniquely-named `weights-<token>.npz` sibling
+    (never touching the file the live config references), then commits
+    the config (whose `weights_file` points at the new archive and
+    whose integrity section was computed from it, in the same
+    serialization pass), then garbage-collects the superseded archive.
+    A kill at ANY instant therefore leaves the referenced (config,
+    weights) pair complete: before the commit it is the old pair,
+    after it the new one. `faults` threads a
+    utils/diskfaults.DiskFaultInjector through both atomic writes
+    (tests only)."""
     os.makedirs(path, exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
     manifest: dict[str, dict] = {}
     _flatten(params, "", arrays, manifest)
-    np.savez(os.path.join(path, "weights.npz"), **arrays)
+    overwrite = os.path.exists(os.path.join(path, "bigdl_tpu_config.json"))
+    wname = (f"weights-{os.urandom(4).hex()}.npz" if overwrite
+             else "weights.npz")
+    tensors: dict[str, dict] = {}
+    durability.atomic_write(
+        os.path.join(path, wname),
+        lambda f: tensors.update(durability.write_npz(f, arrays)),
+        faults=faults,
+    )
     meta = {
         "format_version": FORMAT_VERSION,
         "qtype": qtype,
         "model_config": dataclasses.asdict(config),
         "manifest": manifest,
+        "weights_file": wname,
+        "integrity": durability.integrity_section(tensors),
     }
-    with open(os.path.join(path, "bigdl_tpu_config.json"), "w") as f:
-        json.dump(meta, f, indent=1)
+    durability.atomic_write(
+        os.path.join(path, "bigdl_tpu_config.json"),
+        lambda f: f.write(json.dumps(meta, indent=1).encode()),
+        faults=faults,
+    )
+    # sweep superseded weights archives (and their stale tmps) ONLY
+    # after observing that the commit actually landed: the on-disk
+    # config must reference the new archive and the archive must exist.
+    # A lost write on either file (drop_file) then degrades to detection
+    # at load, never to deleting the only copy the surviving config
+    # references.
+    try:
+        with open(os.path.join(path, "bigdl_tpu_config.json")) as f:
+            committed = json.load(f).get("weights_file") == wname
+    except (OSError, ValueError):  # pragma: no cover - racing reader
+        committed = False
+    if committed and os.path.exists(os.path.join(path, wname)):
+        for name in os.listdir(path):
+            if name != wname and _WEIGHTS_RE.match(name):
+                try:
+                    os.unlink(os.path.join(path, name))
+                except OSError:  # pragma: no cover - racing cleanup
+                    pass
 
 
-def load_low_bit(path: str) -> tuple[ModelConfig, dict, str]:
-    """Returns (config, params, qtype)."""
-    with open(os.path.join(path, "bigdl_tpu_config.json")) as f:
-        meta = json.load(f)
+def _check_version(meta: dict) -> None:
     ver = meta["format_version"]
     if ver != FORMAT_VERSION:
         # older versions are still bit-compatible unless the checkpoint
@@ -118,11 +180,81 @@ def load_low_bit(path: str) -> tuple[ModelConfig, dict, str]:
         )
         if not ok:
             raise ValueError(f"unsupported format_version {ver}")
+
+
+def _read_arrays(
+    path: str, meta: dict, verify: str,
+) -> tuple[dict, dict, list, list]:
+    """Read + verify every stored array (durability.verify_npz_members).
+    Returns (arrays, corrupted, missing, extra); raises IntegrityError
+    only for artifact-level failures (the weights archive gone or
+    unreadable as a zip). Structural problems (missing/extra members,
+    unreadable members) are detected in EVERY verify mode — only the
+    digest comparison is mode-gated."""
+    manifest = meta["manifest"]
+    integrity = (meta.get("integrity") or {}).get("tensors")
+    wname = meta.get("weights_file", "weights.npz")
+    wpath = os.path.join(path, wname)
+    expected = {k for k, v in manifest.items() if v["kind"] == "array"}
+    if not os.path.exists(wpath):
+        durability.VERIFY_FAILURES.inc()
+        raise IntegrityError(
+            path, missing=expected, detail=f"{wname} does not exist",
+        )
+    if integrity is None and verify == "full":
+        warnings.warn(
+            f"{path}: no integrity manifest (pre-durability checkpoint); "
+            "digest verification skipped — re-save to add digests"
+        )
+    return durability.verify_npz_members(wpath, integrity, verify, expected)
+
+
+def load_low_bit(
+    path: str, *, verify: str = "fast", salvage: bool = False,
+):
+    """Returns (config, params, qtype) — or, with salvage=True,
+    (config, params, qtype, report) where report is the un-raised
+    IntegrityError (None when the checkpoint is clean) and `params`
+    holds only the tensors that verified.
+
+    verify: "off" skips digest comparison (structural and zip-level
+    checks still apply), "fast" checks sizes/shapes/crc32, "full" adds
+    sha256 plus numerical validation (NaN/inf scan of float tensors and
+    scales, per-qtype scale-range sanity)."""
+    durability.check_verify_mode(verify)
+    with open(os.path.join(path, "bigdl_tpu_config.json")) as f:
+        meta = json.load(f)
+    missing_keys = [k for k in ("format_version", "qtype", "model_config",
+                                "manifest") if k not in meta]
+    if missing_keys:
+        # parseable JSON with rotted key names must not KeyError deep
+        # in the loader — it is corruption like any other
+        durability.VERIFY_FAILURES.inc()
+        raise IntegrityError(
+            path, detail="damaged config record (missing keys: "
+                         f"{', '.join(missing_keys)})",
+        )
+    _check_version(meta)
     config = ModelConfig(**meta["model_config"])
     manifest = meta["manifest"]
-    npz = np.load(os.path.join(path, "weights.npz"))
+    arrays, corrupted, missing, extra = _read_arrays(path, meta, verify)
+    if verify == "full":
+        for fnd in durability.validate_numerics(arrays, manifest):
+            corrupted.setdefault(fnd.tensor, f"{fnd.issue}: {fnd.detail}")
+            arrays.pop(fnd.tensor, None)
+
+    report = None
+    if corrupted or missing or extra:
+        durability.VERIFY_FAILURES.inc()
+        report = IntegrityError(
+            path, corrupted=corrupted, missing=missing, extra=extra,
+        )
+        if not salvage:
+            raise report
+        warnings.warn(f"salvage load: {report}")
 
     params: dict = {}
+    quarantined: list[str] = []
 
     def put(path_key: str, value) -> None:
         parts = path_key.split(".")
@@ -136,13 +268,70 @@ def load_low_bit(path: str) -> tuple[ModelConfig, dict, str]:
     for key, info in manifest.items():
         if info["kind"] == "qtensor":
             fields = {}
+            ok = True
             for field in ARRAY_FIELDS:
                 fkey = f"{key}@{field}"
-                if fkey in manifest:
-                    fields[field] = _decode(npz[fkey], manifest[fkey]["dtype"])
-                else:
+                if fkey not in manifest:
                     fields[field] = None
-            put(key, QTensor(qtype=info["qtype"], **fields))
+                elif fkey in arrays:
+                    fields[field] = _decode(arrays[fkey],
+                                            manifest[fkey]["dtype"])
+                else:  # a field of this QTensor is corrupt/missing:
+                    ok = False  # quarantine the whole logical tensor
+            if ok:
+                put(key, QTensor(qtype=info["qtype"], **fields))
+            else:
+                quarantined.append(key)
         elif "@" not in key:
-            put(key, _decode(npz[key], info["dtype"]))
+            if key in arrays:
+                put(key, _decode(arrays[key], info["dtype"]))
+            else:
+                quarantined.append(key)
+    if report is not None:
+        report.quarantined_params = sorted(quarantined)
+    if salvage:
+        return config, params, meta["qtype"], report
     return config, params, meta["qtype"]
+
+
+def verify_low_bit(path: str) -> durability.VerifyReport:
+    """Full-mode per-tensor verification WITHOUT building the param tree
+    (the `bigdl-tpu verify` CLI). Always runs integrity `full` plus
+    numerical validation; never raises for tensor findings — they land
+    in the report rows."""
+    try:
+        with open(os.path.join(path, "bigdl_tpu_config.json")) as f:
+            meta = json.load(f)
+        _check_version(meta)
+        # pull the structure INSIDE the guard: a parseable-but-damaged
+        # config (rot inside a key name) must yield a report, not a
+        # bare KeyError from the verify CLI
+        manifest = meta["manifest"]
+        if not isinstance(manifest, dict):
+            raise KeyError("manifest")
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return durability.VerifyReport(
+            path, "low_bit", rows=[],
+            detail=f"unreadable config: {type(e).__name__}: {e}",
+        )
+    try:
+        arrays, corrupted, missing, extra = _read_arrays(path, meta, "full")
+    except IntegrityError as e:
+        return durability.VerifyReport(
+            path, "low_bit", rows=durability.rows_from_error(e),
+            detail=e.detail,
+        )
+    rows = durability.rows_from_error(IntegrityError(
+        path, corrupted=corrupted, missing=missing, extra=extra,
+    ))
+    flagged = set(corrupted) | set(missing) | set(extra)
+    for fnd in durability.validate_numerics(arrays, manifest):
+        rows.append(durability.TensorReport(
+            fnd.tensor, "numerics", f"{fnd.issue}: {fnd.detail}",
+        ))
+        flagged.add(fnd.tensor)
+    rows += [
+        durability.TensorReport(k, "ok")
+        for k in sorted(arrays) if k not in flagged
+    ]
+    return durability.VerifyReport(path, "low_bit", rows=rows)

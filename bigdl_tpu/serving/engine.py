@@ -457,6 +457,7 @@ class InferenceEngine:
         self.requests_shed = 0
         self.request_timeouts = 0
         self.requests_completed = 0
+        self.journal_corrupt_lines = 0  # set at journal attach below
         self.queue_wait = Histogram()
         # swap-in programs (swap-OUT is a plain device_get, no jit). The
         # donated cache makes the restore an in-place scatter. Family
@@ -486,7 +487,20 @@ class InferenceEngine:
         if journal is not None:
             from bigdl_tpu.serving.journal import RequestJournal, replay
 
-            entries, max_rid = RequestJournal.scan(journal)
+            stats: dict = {}
+            entries, max_rid = RequestJournal.scan(journal, stats=stats)
+            # corrupt lines seen at attach (interior rot / crc
+            # mismatches) — exported as
+            # bigdl_tpu_journal_corrupt_lines_total
+            self.journal_corrupt_lines = stats.get("corrupt_lines", 0)
+            # startup compaction: rewrite the journal down to its
+            # pending tail (tombstoned pairs and corrupt lines dropped,
+            # atomic rename) BEFORE the append handle opens — the one
+            # moment compaction cannot race a live writer. The rid
+            # counter still seeds from the PRE-compaction max so a rid
+            # whose lines were just dropped is never reissued into any
+            # overlapping recovery window.
+            RequestJournal.compact(journal, entries=entries)
             self._rid = itertools.count(max_rid + 1)
             self._journal = RequestJournal(journal)
             # replay bypasses the admission bound: every entry was ACCEPTED

@@ -63,7 +63,13 @@ class _Arm:
 
 class FaultInjector:
     """Seedable hook table; thread-safe (handler threads and the engine
-    thread may hit different points concurrently)."""
+    thread may hit different points concurrently).
+
+    `points` is a class attribute so other subsystems can reuse the
+    arm/disarm/fire discipline with their own injection-point table
+    (utils/diskfaults.py does, for storage faults)."""
+
+    points = POINTS
 
     def __init__(self, seed: int = 0):
         self._rng = random.Random(seed)
@@ -78,9 +84,9 @@ class FaultInjector:
         """Arm `point` to fire `times` times (-1 = forever) after skipping
         the first `after` eligible calls. Extra kwargs ride along as the
         payload dict `fire` returns. Returns self for chaining."""
-        if point not in POINTS:
+        if point not in self.points:
             raise ValueError(
-                f"unknown injection point {point!r}; known: {POINTS}"
+                f"unknown injection point {point!r}; known: {self.points}"
             )
         with self._lock:
             self._arms[point] = _Arm(times=times, after=after, prob=prob,

@@ -10,24 +10,49 @@ replays the unfinished tail into `engine.recovered_requests` — pairing with
 deploy/'s restartPolicy so a killed pod resumes its queue instead of
 dropping it.
 
-Format: one JSON object per line.
-  {"op": "submit", "rid": 7, "prompt": [...], "max_new_tokens": 64, ...}
-  {"op": "done", "rid": 7}
+Format: one JSON object per line, followed by a tab and the crc32 of the
+JSON bytes (hex, 8 chars):
+  {"op": "submit", "rid": 7, "prompt": [...], "max_new_tokens": 64, ...}\t1a2b3c4d
+  {"op": "done", "rid": 7}\t5e6f7a8b
+
+The crc suffix detects INTERIOR corruption (bit rot inside a record that
+may even still parse as JSON) per-record — before it, only the
+torn-trailing-line crash case was detectable. Compact JSON never
+contains a raw tab, so the split is unambiguous; checksum-less lines
+from pre-crc journals parse exactly as before (backward compatible).
 
 A request is pending iff its last submit has no matching done. Replayed
 requests get NEW rids (each old entry is superseded by a tombstone once
 its replacement is recorded), and streaming consumers are not
 resurrected — a replayed request completes as a plain buffered request
 retrievable via the API server's GET /recovered.
+
+On engine attach the journal is COMPACTED first (scan → rewrite holding
+only the pending submits, through the atomic tmp+fsync+rename protocol)
+— tombstoned pairs and corrupt lines stop accumulating across restarts,
+and the rewrite happens strictly before the append handle opens, so the
+live-inode hazard of mid-flight compaction never arises.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import warnings
+import zlib
 from typing import Optional
+
+_CRC_RE = re.compile(r"^[0-9a-f]{8}$")
+
+
+def _crc_of(body: str) -> str:
+    return f"{zlib.crc32(body.encode('utf-8')) & 0xFFFFFFFF:08x}"
+
+
+def _crc_line(body: str) -> str:
+    return f"{body}\t{_crc_of(body)}"
 
 # sampling/stop/deadline fields that survive a restart (stream
 # deliberately not). Deadlines are measured from the REPLAYED submit's
@@ -50,7 +75,7 @@ class RequestJournal:
         self._f = open(path, "a", encoding="utf-8")
 
     def _append(self, obj: dict) -> None:
-        line = json.dumps(obj, separators=(",", ":"))
+        line = _crc_line(json.dumps(obj, separators=(",", ":")))
         with self._lock:
             self._f.write(line + "\n")
             self._f.flush()
@@ -71,16 +96,29 @@ class RequestJournal:
             self._f.close()
 
     @staticmethod
-    def scan(path: str) -> tuple[list[dict], int]:
+    def scan(path: str, stats: Optional[dict] = None) -> tuple[list[dict], int]:
         """Parse a journal file -> (submit entries with no done marker,
         in submission order; highest rid seen). A truncated TRAILING line
         (the crash-mid-append case this journal must expect) is skipped
-        with a warning; undecodable interior lines are skipped with a
-        louder warning (they mean corruption beyond a torn tail). Either
-        way recovery proceeds — a damaged line must never block replay of
-        the intact entries around it."""
+        with a warning; undecodable interior lines and per-line crc32
+        mismatches ANYWHERE are skipped with a louder warning (they mean
+        corruption beyond a torn tail). Either way recovery proceeds — a
+        damaged line must never block replay of the intact entries
+        around it.
+
+        `stats`, when given, receives `corrupt_lines` — the count of
+        interior-undecodable + crc-mismatched lines (NOT the expected
+        torn tail); the engine exports it as
+        `bigdl_tpu_journal_corrupt_lines_total`."""
+        if stats is not None:
+            stats.setdefault("corrupt_lines", 0)
         if not os.path.exists(path):
             return [], -1
+
+        def corrupt(n: int = 1) -> None:
+            if stats is not None:
+                stats["corrupt_lines"] += n
+
         submits: dict[int, dict] = {}
         max_rid = -1
         # one-line lookbehind instead of readlines(): a long-lived
@@ -93,6 +131,7 @@ class RequestJournal:
                 if not line:
                     continue
                 if torn is not None:
+                    corrupt()
                     warnings.warn(
                         f"{path}: skipping undecodable journal line "
                         f"{torn[0] + 1} (interior corruption): "
@@ -100,6 +139,23 @@ class RequestJournal:
                         stacklevel=2,
                     )
                     torn = None
+                # crc-suffixed line (compact JSON never holds a raw tab,
+                # so rpartition is unambiguous). A torn tail can never
+                # masquerade here: truncation eats the crc digits first,
+                # so a full 8-hex suffix means the line was written
+                # whole — a mismatch is bit rot, torn-position or not.
+                body, sep, tail = line.rpartition("\t")
+                if sep and _CRC_RE.fullmatch(tail):
+                    if _crc_of(body) != tail:
+                        corrupt()
+                        warnings.warn(
+                            f"{path}: skipping journal line {i + 1} with "
+                            f"crc32 mismatch (interior corruption): "
+                            f"{body[:60]!r}",
+                            stacklevel=2,
+                        )
+                        continue
+                    line = body
                 try:
                     obj = json.loads(line)
                 except json.JSONDecodeError:
@@ -128,16 +184,25 @@ class RequestJournal:
         return RequestJournal.scan(path)[0]
 
     @staticmethod
-    def compact(path: str) -> None:
-        """Atomic rewrite keeping only pending submits. Offline
-        maintenance ONLY — the os.replace swaps the inode out from
-        under any live engine's open append handle."""
-        pending = RequestJournal.pending(path)
-        tmp = f"{path}.tmp-{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            for e in pending:
-                f.write(json.dumps(e, separators=(",", ":")) + "\n")
-        os.replace(tmp, path)
+    def compact(path: str, entries: Optional[list] = None) -> None:
+        """Atomic rewrite keeping only pending submits (tombstoned pairs
+        and corrupt lines dropped; every surviving line crc-suffixed),
+        through the tmp+fsync+rename protocol. Startup or offline
+        maintenance ONLY — the os.replace swaps the inode out from under
+        any live engine's open append handle. Pass `entries` (a prior
+        scan's pending list) to skip the rescan the engine already did."""
+        if not os.path.exists(path):
+            return
+        if entries is None:
+            entries = RequestJournal.pending(path)
+        from bigdl_tpu.utils.durability import atomic_write
+
+        def write(f) -> None:
+            for e in entries:
+                body = json.dumps(e, separators=(",", ":"))
+                f.write((_crc_line(body) + "\n").encode("utf-8"))
+
+        atomic_write(path, write)
 
 
 def replay(engine, entries: list[dict]) -> list:

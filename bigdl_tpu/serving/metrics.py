@@ -17,6 +17,14 @@ from collections import defaultdict
 _BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
+def _verify_failures() -> int:
+    """Process-wide checkpoint verification failure count (lazy import:
+    metrics must stay importable without dragging the convert stack)."""
+    from bigdl_tpu.utils.durability import VERIFY_FAILURES
+
+    return VERIFY_FAILURES.value
+
+
 class Histogram:
     """Minimal lock-free Prometheus histogram: one writer (the engine
     thread observes), any reader (a racing render sees a value at most
@@ -103,6 +111,15 @@ class Metrics:
                 "# HELP bigdl_tpu_requests_failed_total 5xx responses",
                 "# TYPE bigdl_tpu_requests_failed_total counter",
                 f"bigdl_tpu_requests_failed_total {self.requests_failed}",
+                # artifact durability (utils/durability.py): process-wide
+                # count of checkpoint integrity-verification failures —
+                # a nonzero here means a load saw corruption (raised or
+                # salvaged) and restarts are running on borrowed time
+                "# HELP bigdl_tpu_checkpoint_verify_failures_total "
+                "checkpoint integrity verification failures",
+                "# TYPE bigdl_tpu_checkpoint_verify_failures_total counter",
+                f"bigdl_tpu_checkpoint_verify_failures_total "
+                f"{_verify_failures()}",
                 "# HELP bigdl_tpu_request_seconds request latency",
                 "# TYPE bigdl_tpu_request_seconds histogram",
             ]
@@ -148,6 +165,11 @@ class Metrics:
                 "parked in host RAM awaiting resume",
                 "# TYPE bigdl_tpu_preempted_waiting gauge",
                 f"bigdl_tpu_preempted_waiting {len(self.engine._preempted)}",
+                "# HELP bigdl_tpu_journal_corrupt_lines_total interior-"
+                "corrupt journal lines skipped at recovery scan",
+                "# TYPE bigdl_tpu_journal_corrupt_lines_total counter",
+                f"bigdl_tpu_journal_corrupt_lines_total "
+                f"{getattr(self.engine, 'journal_corrupt_lines', 0)}",
             ]
             lines += self.engine.queue_wait.render(
                 "bigdl_tpu_queue_wait_seconds",

@@ -15,7 +15,13 @@ from bigdl_tpu.train.recipes import (
     relora_reset,
     sample_lisa_mask,
 )
-from bigdl_tpu.train.checkpoint import load_train_state, save_train_state
+from bigdl_tpu.train.checkpoint import (
+    list_train_checkpoints,
+    load_latest_train_state,
+    load_train_state,
+    save_train_state,
+    save_train_state_rotating,
+)
 from bigdl_tpu.train.dpo import dpo_loss, make_dpo_step, sequence_logprob
 from bigdl_tpu.train.galore import GaLoreState, galore
 
@@ -37,4 +43,7 @@ __all__ = [
     "galore",
     "save_train_state",
     "load_train_state",
+    "save_train_state_rotating",
+    "load_latest_train_state",
+    "list_train_checkpoints",
 ]

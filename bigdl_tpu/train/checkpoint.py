@@ -19,12 +19,22 @@ freshly-initialized lora/opt_state the caller already has) and unflattens
 onto their treedef, verifying leaf shapes and dtypes — the standard JAX
 restore pattern, which keeps optax's nested NamedTuples out of the file
 format.
+
+Durability (utils/durability.py): the meta record carries per-leaf
+crc32/sha256 digests checked by `load_train_state(verify=...)`, the
+write goes through the shared atomic tmp+fsync+rename protocol (with an
+optional disk-fault injector for tests), and `save_train_state_rotating`
+/ `load_latest_train_state` implement keep-last-k retention where resume
+scans candidates newest-first and *skips* corrupt checkpoints with a
+warning — one rotted file costs one save interval, not the run.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
+import warnings
 from typing import Any, Optional
 
 import jax
@@ -33,6 +43,8 @@ import numpy as np
 
 from bigdl_tpu.convert.low_bit import _decode as _decode_bits
 from bigdl_tpu.convert.low_bit import _encode as _encode_bits
+from bigdl_tpu.utils import durability
+from bigdl_tpu.utils.durability import IntegrityError
 
 
 def _encode(arr) -> tuple[np.ndarray, str]:
@@ -56,11 +68,13 @@ def save_train_state(
     rng: jax.Array,
     params: Optional[dict] = None,
     resets: int = 0,
+    faults=None,
 ) -> None:
     """Atomically write the full training state to `path` (one file).
     Pass `params` when the base mutates (ReLoRA merges); plain QLoRA's
     frozen base reloads from its own checkpoint and needs only the
-    adapter state here."""
+    adapter state here. `faults` threads a DiskFaultInjector through the
+    atomic write (tests only)."""
     state = {"lora": lora, "opt_state": opt_state, "rng": rng}
     if params is not None:
         state["params"] = params
@@ -71,27 +85,69 @@ def save_train_state(
         a, dt = _encode(leaf)
         arrays[f"leaf_{i:05d}"] = a
         dtypes.append(dt)
-    arrays["meta"] = np.asarray(json.dumps({
-        "format_version": 2,
-        "step": int(step),
-        "resets": int(resets),
-        "n_leaves": len(leaves),
-        "dtypes": dtypes,
-        "has_params": params is not None,
-    }))
+
+    def write(f) -> None:
+        # one serialization pass: each leaf is encoded to .npy bytes
+        # once, digested, and written (durability.write_npz); the meta
+        # member — carrying those digests — lands in the same zip last
+        # (it cannot self-digest; the zip member crc32 still covers it)
+        import zipfile
+
+        with zipfile.ZipFile(f, "w", zipfile.ZIP_STORED) as zf:
+            tensors = {}
+            for k in sorted(arrays):
+                tensors[k] = durability.add_npz_member(zf, k, arrays[k])
+            meta = {
+                "format_version": 2,
+                "step": int(step),
+                "resets": int(resets),
+                "n_leaves": len(leaves),
+                "dtypes": dtypes,
+                "has_params": params is not None,
+                "integrity": durability.integrity_section(tensors),
+            }
+            durability.add_npz_member(zf, "meta",
+                                      np.asarray(json.dumps(meta)))
 
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)
-    tmp = f"{path}.tmp-{os.getpid()}"
-    try:
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    durability.atomic_write(path, write, faults=faults)
+
+
+def _verify_leaves(path: str, meta: dict, verify: str) -> dict:
+    """Read + verify every leaf member (durability.verify_npz_members).
+    Returns {name: np.ndarray} of clean leaves; raises IntegrityError
+    naming each corrupt/missing one. Structural checks (missing members,
+    unreadable members — the zip layer's own member crc fires on read)
+    apply in every mode; digest comparison is mode-gated; `full` adds a
+    non-finite scan of float leaves."""
+    n_leaves = meta.get("n_leaves")
+    dtypes = meta.get("dtypes")
+    if not isinstance(n_leaves, int) or not isinstance(dtypes, list):
+        # parseable meta JSON with rotted keys is corruption, not a
+        # KeyError — the rotation scan must be able to skip past it
+        durability.VERIFY_FAILURES.inc()
+        raise IntegrityError(
+            path, detail="damaged meta record (n_leaves/dtypes missing)",
+        )
+    names = [f"leaf_{i:05d}" for i in range(n_leaves)]
+    integrity = (meta.get("integrity") or {}).get("tensors")
+    arrays, corrupted, missing, extra = durability.verify_npz_members(
+        path, integrity, verify, names, ignore={"meta"},
+    )
+    if verify == "full":
+        for n, dt in zip(names, dtypes):
+            if n not in arrays:
+                continue
+            detail = durability.scan_non_finite(arrays[n], dt)
+            if detail is not None:
+                corrupted[n] = f"non_finite: {detail}"
+                arrays.pop(n)
+    if corrupted or missing or extra:
+        durability.VERIFY_FAILURES.inc()
+        raise IntegrityError(path, corrupted=corrupted, missing=missing,
+                             extra=extra)
+    return arrays
 
 
 def load_train_state(
@@ -100,14 +156,40 @@ def load_train_state(
     like_lora: dict,
     like_opt_state: Any,
     like_params: Optional[dict] = None,
+    verify: str = "fast",
 ) -> dict:
     """Returns {lora, opt_state, rng, step, resets[, params]}; the
     `like_*` templates (e.g. a freshly-initialized lora + optimizer.init)
-    provide the pytree structure to unflatten onto."""
-    npz = np.load(path, allow_pickle=False)
-    meta = json.loads(str(npz["meta"]))
+    provide the pytree structure to unflatten onto.
+
+    verify: "off" | "fast" (crc32, default) | "full" (sha256 + non-finite
+    scan of float leaves). Digest mismatches, unreadable members, and
+    missing leaves raise a structured IntegrityError naming each bad
+    leaf; an unreadable file raises IntegrityError too (FileNotFoundError
+    stays FileNotFoundError) — so the rotation scan can distinguish
+    corruption (skip, warn) from config drift (raise)."""
+    durability.check_verify_mode(verify)
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    try:
+        npz = np.load(path, allow_pickle=False)
+        meta = json.loads(str(npz["meta"]))
+    except Exception as e:
+        durability.VERIFY_FAILURES.inc()
+        raise IntegrityError(
+            path, detail=f"unreadable checkpoint: {type(e).__name__}: {e}",
+        ) from e
+    missing_keys = [k for k in ("format_version", "step", "resets",
+                                "has_params") if k not in meta]
+    if missing_keys:
+        durability.VERIFY_FAILURES.inc()
+        raise IntegrityError(
+            path, detail="damaged meta record (missing keys: "
+                         f"{', '.join(missing_keys)})",
+        )
     if meta["format_version"] != 2:
         raise ValueError(f"unsupported format_version {meta['format_version']}")
+    arrays = _verify_leaves(path, meta, verify)
     like = {
         "lora": like_lora, "opt_state": like_opt_state,
         "rng": jax.random.PRNGKey(0),
@@ -128,7 +210,7 @@ def load_train_state(
 
     leaves = []
     for i, (dt, ref) in enumerate(zip(meta["dtypes"], like_leaves)):
-        leaf = _decode(npz[f"leaf_{i:05d}"], dt)
+        leaf = _decode(arrays[f"leaf_{i:05d}"], dt)
         # typed-vs-raw PRNG keys have different logical shapes; the rng
         # leaf's template is a placeholder, so skip its checks
         if dt != "prng_key" and hasattr(ref, "shape"):
@@ -148,3 +230,115 @@ def load_train_state(
     state["step"] = meta["step"]
     state["resets"] = meta["resets"]
     return state
+
+
+# ---------------------------------------------------------------------------
+# rotation: keep-last-k retention + corrupt-skipping resume
+# ---------------------------------------------------------------------------
+
+_CKPT_RE = re.compile(r"^ckpt-(\d{8})\.npz$")
+# stale tmps of crashed rotating saves, swept by the rotation prune
+_CKPT_TMP_RE = re.compile(r"^ckpt-\d{8}\.npz\.tmp-\d+$")
+
+
+def list_train_checkpoints(ckpt_dir: str) -> list:
+    """Rotated checkpoint paths in `ckpt_dir`, NEWEST (highest step)
+    first."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    found = []
+    for name in os.listdir(ckpt_dir):
+        m = _CKPT_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    return [p for _, p in sorted(found, reverse=True)]
+
+
+def save_train_state_rotating(
+    ckpt_dir: str, *, step: int, keep_last: int = 3, faults=None, **state,
+) -> str:
+    """Save `ckpt-<step:08d>.npz` into `ckpt_dir` (atomic, digested),
+    then prune everything beyond the newest `keep_last` checkpoints.
+    Prune runs AFTER the new save commits — a kill anywhere leaves at
+    least the previous `keep_last` generation intact. Returns the new
+    checkpoint path."""
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    if step < 0 or step > 10 ** 8 - 1:
+        raise ValueError(f"step {step} outside the 8-digit rotation range")
+    path = os.path.join(ckpt_dir, f"ckpt-{step:08d}.npz")
+    save_train_state(path, step=step, faults=faults, **state)
+    # prune beyond keep_last, PLUS stale tmps from earlier killed saves:
+    # atomic_write's sweep only covers its own target path, and rotation
+    # uses a new filename every step, so a crashed step's tmp would
+    # otherwise persist forever
+    stale = [
+        os.path.join(ckpt_dir, n) for n in os.listdir(ckpt_dir)
+        if _CKPT_TMP_RE.match(n)
+    ]
+    for old in list_train_checkpoints(ckpt_dir)[keep_last:] + stale:
+        try:
+            os.unlink(old)
+        except OSError:  # pragma: no cover - racing cleanup is fine
+            pass
+    return path
+
+
+def load_latest_train_state(
+    ckpt_dir: str,
+    *,
+    like_lora: dict,
+    like_opt_state: Any,
+    like_params: Optional[dict] = None,
+    verify: str = "fast",
+) -> Optional[dict]:
+    """Resume from the newest loadable rotated checkpoint: candidates
+    are scanned newest-first and corrupt ones (IntegrityError — rot,
+    torn files, digest mismatches) are SKIPPED with a warning instead of
+    killing the resume; template/config mismatches still raise (an older
+    checkpoint would mismatch identically — skipping would hide a real
+    bug). Returns the loaded state dict with its source under
+    state["path"], or None when no loadable checkpoint exists."""
+    for path in list_train_checkpoints(ckpt_dir):
+        try:
+            state = load_train_state(
+                path, like_lora=like_lora, like_opt_state=like_opt_state,
+                like_params=like_params, verify=verify,
+            )
+        except (IntegrityError, FileNotFoundError) as e:
+            warnings.warn(
+                f"skipping corrupt train checkpoint {path}: {e}"
+            )
+            continue
+        state["path"] = path
+        return state
+    return None
+
+
+def verify_train_checkpoint(path: str) -> "durability.VerifyReport":
+    """Full-mode per-leaf verification for the `bigdl-tpu verify` CLI;
+    findings land in the report rows instead of raising."""
+    try:
+        npz = np.load(path, allow_pickle=False)
+        meta = json.loads(str(npz["meta"]))
+    except Exception as e:
+        return durability.VerifyReport(
+            path, "train", rows=[],
+            detail=f"unreadable checkpoint: {type(e).__name__}: {e}",
+        )
+    try:
+        arrays = _verify_leaves(path, meta, "full")
+    except IntegrityError as e:
+        rows = durability.rows_from_error(e)
+        bad = e.bad_tensors
+        n_leaves = meta.get("n_leaves")
+        rows += [
+            durability.TensorReport(f"leaf_{i:05d}", "ok")
+            for i in range(n_leaves if isinstance(n_leaves, int) else 0)
+            if f"leaf_{i:05d}" not in bad
+        ]
+        return durability.VerifyReport(path, "train", rows=rows,
+                                       detail=e.detail)
+    return durability.VerifyReport(path, "train", rows=[
+        durability.TensorReport(n, "ok") for n in sorted(arrays)
+    ])

@@ -1,0 +1,497 @@
+"""Artifact durability: integrity manifests, atomic writes, numerical
+validation, and the structured IntegrityError shared by every on-disk
+artifact (low-bit checkpoints, train checkpoints, GGUF exports).
+
+Low-bit checkpoints are the silent-scramble failure class in person: a
+flipped byte in packed codes or scales doesn't crash, it *dequantizes
+garbage* (the exact hazard convert/low_bit.py's FORMAT_VERSION gate
+documents for layout drift — bit rot produces it without any version
+change). So durability is layered:
+
+1. **Integrity manifest** — per-tensor content digests (crc32 fast path,
+   sha256 full mode), byte sizes, shapes and storage dtypes recorded at
+   save time; load verifies in modes ``off | fast | full`` and raises a
+   structured :class:`IntegrityError` naming every corrupted / missing /
+   extra tensor instead of KeyError-ing deep in the loader.
+2. **Atomic write protocol** — :func:`atomic_write`: write a
+   ``tmp-<pid>`` sibling, flush + fsync, ``os.replace`` into place,
+   fsync the directory, and sweep stale tmps from earlier killed saves.
+   A kill at any instant leaves the previous artifact bit-identical.
+3. **Numerical validation** — NaN/inf scan of float tensors and scales
+   plus per-qtype scale-range sanity (:func:`validate_numerics`),
+   producing a quarantine report; loaders offer a salvage mode that
+   loads the valid subset.
+4. **Fault injection** — every save path threads a
+   `utils/diskfaults.DiskFaultInjector` through :func:`atomic_write`,
+   so tests drive all of the above deterministically on CPU.
+
+`VERIFY_FAILURES` counts every integrity-verification failure process-
+wide; serving/metrics.py exports it as
+``bigdl_tpu_checkpoint_verify_failures_total``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import hashlib
+import io
+import os
+import threading
+import zipfile
+import zlib
+from typing import Callable, Optional
+
+import numpy as np
+
+VERIFY_MODES = ("off", "fast", "full")
+
+
+def check_verify_mode(mode: str) -> str:
+    if mode not in VERIFY_MODES:
+        raise ValueError(
+            f"verify mode {mode!r} not in {VERIFY_MODES}"
+        )
+    return mode
+
+
+class _Counter:
+    """Process-wide thread-safe counter (metrics exposition)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+# every IntegrityError raised (or salvaged past) by a loader bumps this
+VERIFY_FAILURES = _Counter()
+
+
+class IntegrityError(ValueError):
+    """A checkpoint failed integrity verification. Structured: names
+    every offending tensor so the operator (and the salvage path) can
+    act per-tensor instead of guessing from a KeyError traceback.
+
+    - ``corrupted``: {tensor_name: reason} — digest/shape/size mismatch,
+      unreadable member, or a numerics finding (full mode)
+    - ``missing``: tensors the manifest lists but the file lacks
+    - ``extra``: arrays present in the file but absent from the manifest
+    - ``detail``: artifact-level problem (file gone, unreadable zip, …)
+
+    Subclasses ValueError so pre-existing ``except ValueError`` load
+    guards keep working.
+    """
+
+    def __init__(self, path: str, *, corrupted: Optional[dict] = None,
+                 missing=(), extra=(), detail: Optional[str] = None):
+        self.path = path
+        self.corrupted = dict(corrupted or {})
+        self.missing = sorted(missing)
+        self.extra = sorted(extra)
+        self.detail = detail
+        parts = []
+        if detail:
+            parts.append(detail)
+        if self.corrupted:
+            parts.append("corrupted: " + "; ".join(
+                f"{k} ({v})" for k, v in sorted(self.corrupted.items())
+            ))
+        if self.missing:
+            parts.append(f"missing: {', '.join(self.missing)}")
+        if self.extra:
+            parts.append(f"extra: {', '.join(self.extra)}")
+        super().__init__(
+            f"{path}: integrity check failed — " + " | ".join(parts)
+        )
+
+    @property
+    def bad_tensors(self) -> set:
+        return set(self.corrupted) | set(self.missing)
+
+
+# ---------------------------------------------------------------------------
+# digests
+# ---------------------------------------------------------------------------
+
+def crc32_hex(data) -> str:
+    return f"{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+
+
+def add_npz_member(zf: "zipfile.ZipFile", key: str, a) -> dict:
+    """Serialize one array into an open (uncompressed) npz zip and
+    return its integrity entry — digests and zip member share ONE
+    serialization pass (the .npy bytes are encoded exactly once).
+
+    Digests cover the serialized .npy MEMBER bytes — exactly what the
+    zip stores — not the raw array bytes. That choice makes `fast`
+    verification nearly free at load: the zip central directory already
+    records each member's crc32, so a metadata-only compare against the
+    manifest plus the zip layer's own payload-crc check during the
+    (unavoidable) read proves payload == manifest transitively, with
+    zero extra bandwidth."""
+    b = np.asanyarray(a)
+    buf = io.BytesIO()
+    np.lib.format.write_array(buf, b, allow_pickle=False)
+    raw = buf.getvalue()
+    zf.writestr(key + ".npy", raw)
+    return {
+        "crc32": crc32_hex(raw),
+        "sha256": hashlib.sha256(raw).hexdigest(),
+        "nbytes": len(raw),
+        "shape": list(b.shape),
+        "dtype": b.dtype.name,
+    }
+
+
+def write_npz(f, arrays: dict) -> dict:
+    """Write `arrays` as an uncompressed .npz (np.load-compatible) to
+    the open file object `f`, returning the integrity `tensors` map.
+    One tensor is serialized, digested, written, and dropped at a time —
+    peak extra memory is one member's bytes, and nothing is serialized
+    twice (np.savez + separate digesting would double the encode cost)."""
+    tensors = {}
+    with zipfile.ZipFile(f, "w", zipfile.ZIP_STORED) as zf:
+        for k in sorted(arrays):
+            tensors[k] = add_npz_member(zf, k, arrays[k])
+    return tensors
+
+
+def integrity_section(tensors: dict) -> dict:
+    """The `integrity` section saved into a checkpoint's metadata."""
+    return {
+        "version": 1,
+        "scheme": "npy-member",  # digests cover the .npy member bytes
+        "tensors": tensors,
+    }
+
+
+def verify_npz_members(
+    path: str,
+    integrity: Optional[dict],
+    mode: str,
+    expected,
+    ignore=frozenset(),
+):
+    """Read + verify every expected member of an .npz. Returns
+    (arrays, corrupted, missing, extra); raises IntegrityError only for
+    artifact-level failures (file unreadable as a zip archive).
+
+    Detection layers by mode:
+    - every mode: structural (missing/extra members) and the zip layer's
+      own payload-vs-member-crc check, which fires during the read —
+      even ``off`` cannot hand silently-rotted bytes onward;
+    - ``fast``: + zip-directory crc32/size vs the manifest (metadata
+      compare, no extra payload pass) and shape/dtype of the decoded
+      array;
+    - ``full``: + an independent sha256 over the member bytes (distrusts
+      the zip metadata entirely).
+
+    `integrity` is the saved `{name: digest_entry}` map (None for
+    pre-durability checkpoints: digest checks skip); `ignore` names
+    members exempt from expected/extra accounting (e.g. the train
+    checkpoint's self-describing "meta").
+    """
+    expected = set(expected)
+    try:
+        zf = zipfile.ZipFile(path)
+    except Exception as e:
+        VERIFY_FAILURES.inc()
+        raise IntegrityError(
+            path, detail=f"unreadable archive: {type(e).__name__}: {e}",
+        ) from e
+    corrupted: dict = {}
+    arrays: dict = {}
+    with zf:
+        infos = {}
+        for i in zf.infolist():
+            nm = i.filename
+            if nm.endswith(".npy"):
+                nm = nm[:-4]
+            infos[nm] = i
+        missing = sorted(expected - infos.keys())
+        extra = sorted(infos.keys() - expected - set(ignore))
+        for key in sorted(expected & infos.keys()):
+            info = infos[key]
+            entry = integrity.get(key) if integrity else None
+            if mode != "off" and integrity is not None:
+                if entry is None:
+                    corrupted[key] = "not in integrity manifest"
+                    continue
+                if info.file_size != entry["nbytes"]:
+                    corrupted[key] = (
+                        f"{info.file_size} bytes != recorded "
+                        f"{entry['nbytes']}"
+                    )
+                    continue
+                if f"{info.CRC & 0xFFFFFFFF:08x}" != entry["crc32"]:
+                    corrupted[key] = "crc32 mismatch (zip directory vs " \
+                                     "manifest)"
+                    continue
+            try:
+                # zipfile verifies the payload against the member crc
+                # during this read — a flipped payload byte fails here
+                raw = zf.read(info)
+            except Exception as e:
+                corrupted[key] = f"unreadable ({type(e).__name__}: {e})"
+                continue
+            if mode == "full" and entry is not None:
+                if hashlib.sha256(raw).hexdigest() != entry["sha256"]:
+                    corrupted[key] = "sha256 mismatch"
+                    continue
+            try:
+                a = np.lib.format.read_array(
+                    io.BytesIO(raw), allow_pickle=False,
+                )
+            except Exception as e:
+                corrupted[key] = f"undecodable npy ({type(e).__name__}: {e})"
+                continue
+            if mode != "off" and entry is not None:
+                if list(a.shape) != list(entry["shape"]):
+                    corrupted[key] = (
+                        f"shape {list(a.shape)} != recorded {entry['shape']}"
+                    )
+                    continue
+                if a.dtype.name != entry["dtype"]:
+                    corrupted[key] = (
+                        f"dtype {a.dtype.name} != recorded {entry['dtype']}"
+                    )
+                    continue
+            arrays[key] = a
+    return arrays, corrupted, missing, extra
+
+
+# ---------------------------------------------------------------------------
+# numerical validation (quarantine report)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Finding:
+    tensor: str
+    issue: str  # "non_finite" | "scale_range"
+    detail: str
+
+
+# storage dtypes worth a non-finite scan (manifest `dtype` names).
+# uint views of bf16/fp8 decode through low_bit._decode first.
+FLOAT_DTYPES = (
+    "float16", "float32", "float64", "bfloat16",
+    "float8_e4m3fn", "float8_e5m2",
+)
+
+# per-qtype plausibility ceiling for |scale|: block scales derive from
+# weight absmax over qmax (quant/numerics.quantize_blockwise), so for
+# the formats WE quantize a magnitude in the tens of thousands means
+# the fp16 bytes were scrambled, not that the model is big — trained
+# transformer weights sit orders of magnitude below 1e4. Unlisted
+# qtypes (gguf-imported trees with foreign scale conventions, future
+# formats) get a conservative default instead of a false positive.
+_SCALE_MAX_DEFAULT = 1e6
+_SCALE_MAX = {q: 1e4 for q in (
+    "sym_int4", "asym_int4", "sym_int5", "asym_int5", "sym_int8",
+    "nf4", "nf3", "fp4", "fp6", "fp8_e4m3", "fp8_e5m2",
+    "q2_k", "q3_k", "q4_k", "q5_k", "q6_k",
+)}
+
+
+def scale_bound(qtype: Optional[str]) -> float:
+    return _SCALE_MAX.get(qtype, _SCALE_MAX_DEFAULT)
+
+
+def _stored_to_f32(a: np.ndarray, dtype_name: str) -> np.ndarray:
+    """Stored array -> float32, entirely on the numpy side (ml_dtypes
+    handles the bf16/fp8 integer views) — the validation scans must not
+    round-trip every tensor through jnp device transfers the real load
+    is about to pay anyway."""
+    if a.dtype.kind in "ui" and dtype_name not in (
+        "float16", "float32", "float64",
+    ):
+        import ml_dtypes
+
+        dt = {
+            "bfloat16": ml_dtypes.bfloat16,
+            "float8_e4m3fn": ml_dtypes.float8_e4m3fn,
+            "float8_e5m2": ml_dtypes.float8_e5m2,
+        }[dtype_name]
+        a = a.view(dt)
+    return a.astype(np.float32)
+
+
+def scan_non_finite(a: np.ndarray, dtype_name: str) -> Optional[str]:
+    """NaN/inf scan of one stored array (bf16/fp8 integer views counted
+    correctly). Returns a detail string like '3 NaN / 0 inf of 4096
+    values', or None when clean or the dtype is not a float storage
+    dtype."""
+    if dtype_name not in FLOAT_DTYPES:
+        return None
+    x = _stored_to_f32(a, dtype_name)
+    n_nan = int(np.isnan(x).sum())
+    n_inf = int(np.isinf(x).sum())
+    if n_nan or n_inf:
+        return f"{n_nan} NaN / {n_inf} inf of {x.size} values"
+    return None
+
+
+def validate_numerics(arrays: dict, manifest: dict) -> list:
+    """NaN/inf scan of float tensors (dense leaves, scales, mins) plus
+    scale-range sanity per qtype. `manifest` is the low-bit manifest
+    (path -> {kind, dtype[, qtype]}); `arrays` the stored np arrays
+    keyed the same way. Returns a list of Findings (empty = healthy)."""
+    findings: list[Finding] = []
+    for key in sorted(arrays):
+        info = manifest.get(key)
+        if info is None or info.get("kind") != "array":
+            continue
+        dt = info["dtype"]
+        if dt not in FLOAT_DTYPES:
+            continue
+        detail = scan_non_finite(arrays[key], dt)
+        if detail is not None:
+            findings.append(Finding(key, "non_finite", detail))
+            continue
+        if key.endswith("@scales"):
+            parent = key[: -len("@scales")]
+            qtype = (manifest.get(parent) or {}).get("qtype")
+            x = _stored_to_f32(arrays[key], dt)
+            amax = float(np.abs(x).max()) if x.size else 0.0
+            bound = scale_bound(qtype)
+            if amax > bound:
+                findings.append(Finding(
+                    key, "scale_range",
+                    f"|scale| max {amax:.3g} exceeds {bound:.0e} "
+                    f"for qtype {qtype}",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# atomic write protocol
+# ---------------------------------------------------------------------------
+
+def clean_stale_tmps(path: str) -> list:
+    """Remove `path`.tmp-* siblings left by earlier killed saves. Called
+    before each save: two live writers racing one target path is already
+    undefined, so any surviving tmp is garbage by construction."""
+    removed = []
+    for tmp in glob.glob(glob.escape(path) + ".tmp-*"):
+        try:
+            os.unlink(tmp)
+            removed.append(tmp)
+        except OSError:  # pragma: no cover - racing cleanup is fine
+            pass
+    return removed
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the containing directory so the rename itself is durable
+    (POSIX: a crashed machine may otherwise forget the dirent)."""
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:  # pragma: no cover - e.g. exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, writer: Callable, *, faults=None) -> None:
+    """Crash-safe file replacement: `writer(f)` streams the payload into
+    a ``tmp-<pid>`` sibling, which is flushed, fsynced, and renamed over
+    `path`. A kill at ANY instant leaves either the old file (possibly
+    plus a stale tmp the next save sweeps) or the complete new file —
+    never a torn or missing artifact.
+
+    `faults` (utils/diskfaults.DiskFaultInjector) drives the injected
+    failure modes: ``torn_rename`` raises DiskFaultError pre-rename with
+    the tmp left behind (simulated SIGKILL — deliberately NOT cleaned
+    up), ``drop_file`` discards the write, ``bit_flip``/``truncate``
+    corrupt the committed file post-rename (storage rot).
+    """
+    from bigdl_tpu.utils.diskfaults import (
+        NULL_DISK_INJECTOR, DiskFaultError, apply_post_commit,
+    )
+
+    inj = faults if faults is not None else NULL_DISK_INJECTOR
+    clean_stale_tmps(path)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        if inj.fire("torn_rename") is not None:
+            # simulated kill between fsync and rename: the tmp stays on
+            # disk exactly as a real SIGKILL would leave it
+            raise DiskFaultError(f"torn_rename injected before {path}")
+        if inj.fire("drop_file") is not None:
+            os.unlink(tmp)
+            return
+        os.replace(tmp, path)
+    except DiskFaultError:
+        raise
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    _fsync_dir(path)
+    apply_post_commit(path, inj)
+
+
+# ---------------------------------------------------------------------------
+# per-tensor verification report (CLI `bigdl-tpu verify`)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TensorReport:
+    name: str
+    status: str  # "ok" | "corrupt" | "missing" | "extra" | "numerics"
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class VerifyReport:
+    path: str
+    kind: str  # "low_bit" | "train"
+    rows: list
+    detail: Optional[str] = None  # artifact-level failure
+
+    @property
+    def ok(self) -> bool:
+        return self.detail is None and all(
+            r.status == "ok" for r in self.rows
+        )
+
+    def format(self) -> str:
+        lines = [f"{self.path} [{self.kind}]"]
+        if self.detail:
+            lines.append(f"  ARTIFACT {self.detail}")
+        width = max((len(r.name) for r in self.rows), default=0)
+        n_bad = 0
+        for r in sorted(self.rows, key=lambda r: (r.status == "ok", r.name)):
+            if r.status == "ok":
+                continue
+            n_bad += 1
+            lines.append(
+                f"  {r.status.upper():8s} {r.name:<{width}s}  {r.detail}"
+            )
+        lines.append(
+            f"  {len(self.rows) - n_bad}/{len(self.rows)} tensors ok"
+            + ("" if self.ok else f", {n_bad} findings")
+        )
+        return "\n".join(lines)
+
+
+def rows_from_error(err: IntegrityError) -> list:
+    rows = [TensorReport(k, "corrupt", v) for k, v in err.corrupted.items()]
+    rows += [TensorReport(k, "missing", "listed in manifest, absent "
+                          "from file") for k in err.missing]
+    rows += [TensorReport(k, "extra", "present in file, absent from "
+                          "manifest") for k in err.extra]
+    return rows

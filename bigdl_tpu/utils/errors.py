@@ -21,6 +21,7 @@ from bigdl_tpu.utils.common import (  # noqa: F401  (re-exports)
     get_logger,
     invalid_input_error,
 )
+from bigdl_tpu.utils.durability import IntegrityError  # noqa: F401  (re-export)
 
 
 def log_event(event: str, **fields: Any) -> None:

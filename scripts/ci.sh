@@ -26,7 +26,9 @@ fi
 if [[ "${1:-}" == "--core" ]]; then
   echo "== core gate (< 5 min): quant/native/model/engine basics +"
   echo "   fused-GEMV kernel parity for every qtype (test_pallas -m core) +"
-  echo "   fault-injection chaos suite (CPU-only; slow storm variants excluded)"
+  echo "   fault-injection chaos suite (CPU-only; slow storm variants excluded) +"
+  echo "   storage-corruption matrix (test_durability: injected bit_flip/"
+  echo "   truncate/torn_rename/drop_file x checkpoint/train/journal)"
   python -m pytest tests/ -q "${XDIST[@]}" -m "core or (chaos and not slow)"
   echo "CORE OK"
   exit 0
