@@ -215,7 +215,9 @@ class TpuModel:
 
         if self.mesh is None:
             return contextlib.nullcontext()
-        return jax.set_mesh(self.mesh)
+        from bigdl_tpu.parallel._compat import set_mesh
+
+        return set_mesh(self.mesh)
 
     def save_low_bit(self, path: str, *, faults=None) -> None:
         """Atomic, digest-manifested save (convert/low_bit.py): a kill

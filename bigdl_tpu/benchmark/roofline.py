@@ -1,0 +1,111 @@
+"""Analytic bytes-moved / FLOPs model for the fused dequant matmul
+family — the first increment of the ROADMAP "hardware-independent perf
+gate".
+
+Evaluates, on any machine with no device attached, the HBM traffic and
+FLOP count of
+
+* the fused Pallas kernel at the REAL block shapes it would pick (the
+  tile policy is imported from `ops/pallas/tiling.py`, the same module
+  the kernels use — the model cannot drift from the implementation), and
+* the XLA dequant fallback it replaces (materialize a bf16 copy of W,
+  then matmul),
+
+so every perf-flavored change lands with a number even when the TPU
+tunnel is down, and the next live window validates the model against
+measured GB/s (BENCH_NOTES r03 banked 2.7x end-to-end for the GEMV
+class; the ratio here is the bandwidth-bound prediction).
+
+This module's own code needs no jax (only `quant.qtypes` + the tile
+policy); importing it still initializes the bigdl_tpu package, so
+bench.py's jax-free parent evaluates it in a CPU-pinned child.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.ops.pallas.tiling import (
+    finest_split, pick_block_m, pick_block_o, round_up,
+)
+from bigdl_tpu.quant.qtypes import resolve_qtype
+
+_X_BPE = 2  # activations cross as bf16 (the kernels' compute dtype)
+_OUT_BPE = 2
+
+
+def weight_bytes_per_row(qtype: str, K: int) -> int:
+    """Stored bytes per output row: packed codes + every scale field —
+    exactly what the kernel's weight-side BlockSpecs fetch."""
+    spec = resolve_qtype(qtype)
+    if spec.storage == "packed_u8":
+        data = K // 2
+    elif spec.storage == "packed_planes":
+        data = K * sum(spec.planes) // 8
+    else:  # int8 / fp8: one code byte per element
+        data = K
+    if spec.superblock:
+        nsuper = K // spec.superblock
+        nsub = K // spec.block_size
+        scales = nsuper * 2 + nsub  # f16 d + integer sc
+        if spec.asymmetric:
+            scales += nsuper * 2 + nsub  # f16 dmin + integer mn
+    else:
+        scales = (K // spec.block_size) * 2  # f16 d
+        if spec.asymmetric:
+            scales += (K // spec.block_size) * 2  # f16 m
+    return data + scales
+
+
+def qmatmul_cost(qtype: str, M: int, K: int, O: int) -> dict:
+    """Analytic cost of the fused dequant matmul y[M,O] = x[M,K] @ W^T.
+
+    HBM traffic follows the kernel's actual fetch pattern (qmatmul._qmm):
+    grid (m, o) with o innermost — the x row tile stays resident across a
+    full sweep of weight tiles (fetched once per M tile == once total),
+    packed weights are re-fetched once per M tile, the output is written
+    once."""
+    spec = resolve_qtype(qtype)
+    row_bytes = weight_bytes_per_row(qtype, K)
+    w_total = O * row_bytes
+
+    block_m = pick_block_m(M, K)
+    mp = round_up(max(M, 1), block_m)
+    block_o = pick_block_o(O, row_bytes, cap=256)
+    grid_m = mp // block_m
+
+    fused_bytes = w_total * grid_m + mp * K * _X_BPE + mp * O * _OUT_BPE
+    # XLA fallback: read packed W + scales, write the dequantized bf16
+    # copy, read it back into the matmul, plus the same x/out traffic
+    xla_bytes = (w_total + 2 * K * O * 2 + M * K * _X_BPE
+                 + M * O * _OUT_BPE)
+    flops = 2 * M * K * O
+    return {
+        "qtype": qtype,
+        "shape": f"m{M}xk{K}xo{O}",
+        "block_m": block_m,
+        "block_o": block_o,
+        "grid_m": grid_m,
+        "weight_bits_per_el": round(row_bytes * 8 / K, 3),
+        "fused_bytes": fused_bytes,
+        "xla_dequant_bytes": xla_bytes,
+        "flops": flops,
+        "fused_intensity": round(flops / fused_bytes, 2),
+        # bandwidth-bound speedup prediction for the fused path; > 1
+        # means the fused kernel moves fewer HBM bytes for the same math
+        "bytes_ratio_vs_xla": round(xla_bytes / fused_bytes, 2),
+    }
+
+
+def gemm_matrix(qtypes, Ms=(1, 128, 512, 2048), K: int = 4096,
+                O: int = 4096) -> dict:
+    """The bench.py analytic sweep: every fused format at decode and
+    prefill shapes. Pure host math — lands a number with the tunnel
+    down."""
+    out = {}
+    for qt in qtypes:
+        spec = resolve_qtype(qt)
+        if K % (spec.superblock or spec.block_size):
+            continue
+        for m in Ms:
+            c = qmatmul_cost(qt, m, K, O)
+            out[f"{qt}_m{m}"] = c
+    return out

@@ -4,23 +4,38 @@ Equivalent of `LowBitLinear.forward` in the reference
 (low_bit_linear.py:606-716): one entry point that dispatches on weight
 type and shape. The prefill/decode split the reference implements with
 two SYCL kernels (`xe_linear.forward_new` vs `xe_batch.batch_forward`)
-maps to: decode-shaped (few rows) sym_int4 matmuls go to the Pallas
-fused dequant-GEMV kernel (packed weights cross HBM as nibbles); other
-shapes use an in-graph dequant that XLA fuses into the matmul.
+maps to: decode-shaped (few rows) matmuls go to the fused Pallas
+dequant-GEMV (packed weights cross HBM as stored), larger shapes —
+prefill, continuous batches, speculative verify, QLoRA training — go to
+the fused tiled dequant-GEMM (weight tiles decode once in VMEM and feed
+the MXU; the dequantized copy never round-trips HBM). Only ineligible
+shapes (odd O/K, exempt formats) take the in-graph XLA dequant that XLA
+fuses into the matmul.
+
+The fused paths are wrapped in a custom_vjp so training (QLoRA's frozen
+low-bit base) can differentiate through them: dx = g @ dequant(W) runs
+on the XLA dequant path in the backward (XLA rematerializes the
+dequant — exactly the pre-fused behavior), while the forward keeps the
+fused kernel. A fused low-bit backward is the ROADMAP follow-up
+("Training Transformers with 4-bit Integers", arxiv 2306.11987).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.quant import QTensor
 
 # Decode GEMV threshold, same role as the reference's `use_batch_forward`
 # heuristic (low_bit_linear.py:272-309): below this many rows the matmul
-# is weight-bandwidth-bound and the packed kernel wins.
+# is weight-bandwidth-bound and the whole-M-block GEMV contract wins;
+# above it the tiled GEMM amortizes each decoded weight tile over a
+# [block_m, K] row tile.
 _GEMV_MAX_ROWS = 32
 
 
@@ -118,59 +133,138 @@ def _run_q6k(x, w, bo):
 
 
 class _GemvEntry(NamedTuple):
-    """Eligibility + kernel for one qtype, registered in one place.
+    """Eligibility + kernels for one qtype, registered in one place.
 
     k_multiple folds every per-format shape rule into one divisibility
     check on the LOGICAL contraction dim: whole quant blocks per packed
     plane (sym/asym_int4 64, nf4/fp4 128), whole super-blocks (k-quants
     256), and 128-lane alignment of the finest plane split for the
     multi-plane kernels (fp6/q2_k 512; sym_int5/nf3/q5_k 1024 — the
-    eighth-split 1-bit plane slices at K/8-byte offsets)."""
+    eighth-split 1-bit plane slices at K/8-byte offsets).
+
+    `run` serves decode shapes (rows <= _GEMV_MAX_ROWS, whole-M block),
+    `gemm` serves everything above (M-tiled; both resolve to the unified
+    kernel in ops/pallas/qmatmul.py, which reads the format through the
+    shared decoder in ops/pallas/qdecode.py). A format without a fused
+    GEMM path MUST say why in `gemm_exempt` — the dispatch-coverage test
+    fails any entry that silently leaves prefill shapes on the XLA
+    dequant path."""
     k_multiple: int
     run: Callable  # (x [M, K] compute dtype, w, block_o) -> y [M, O]
+    gemm: Optional[Callable] = None  # rows > _GEMV_MAX_ROWS kernel
+    gemm_exempt: Optional[str] = None  # stated reason when gemm is None
+
+
+def _entry(k_multiple: int, run: Callable) -> _GemvEntry:
+    # every current format's kernel is M-tiled, so the same callable
+    # serves both shape classes; a future format that can only GEMV must
+    # pass an explicit gemm_exempt reason instead
+    return _GemvEntry(k_multiple, run, gemm=run)
 
 
 # every qtype with a decode path dispatches to a fused Pallas kernel —
 # the in-kernel decode mirrors QTensor.dequantize exactly
 _QGEMV_QTYPES = {
-    "sym_int4": _GemvEntry(64, _run_sym_int4),
-    "asym_int4": _GemvEntry(64, _run_asym_int4),
-    "nf4": _GemvEntry(128, _run_codebook),
-    "fp4": _GemvEntry(128, _run_codebook),
-    "sym_int8": _GemvEntry(32, _run_int8),
-    "asym_int5": _GemvEntry(32, _run_asym_int5),
-    "fp8_e4m3": _GemvEntry(128, _run_fp8),
-    "fp8_e5m2": _GemvEntry(128, _run_fp8),
-    "sym_int5": _GemvEntry(1024, _run_planes),
-    "fp6": _GemvEntry(512, _run_planes),
-    "nf3": _GemvEntry(1024, _run_planes),
-    "q2_k": _GemvEntry(512, _run_q2k),
-    "q3_k": _GemvEntry(256, _run_q6k),
-    "q4_k": _GemvEntry(256, _run_q4k),
-    "q5_k": _GemvEntry(1024, _run_q5k),
-    "q6_k": _GemvEntry(256, _run_q6k),
+    "sym_int4": _entry(64, _run_sym_int4),
+    "asym_int4": _entry(64, _run_asym_int4),
+    "nf4": _entry(128, _run_codebook),
+    "fp4": _entry(128, _run_codebook),
+    "sym_int8": _entry(32, _run_int8),
+    "asym_int5": _entry(32, _run_asym_int5),
+    "fp8_e4m3": _entry(128, _run_fp8),
+    "fp8_e5m2": _entry(128, _run_fp8),
+    "sym_int5": _entry(1024, _run_planes),
+    "fp6": _entry(512, _run_planes),
+    "nf3": _entry(1024, _run_planes),
+    "q2_k": _entry(512, _run_q2k),
+    "q3_k": _entry(256, _run_q6k),
+    "q4_k": _entry(256, _run_q4k),
+    "q5_k": _entry(1024, _run_q5k),
+    "q6_k": _entry(256, _run_q6k),
 }
 
+for _name, _e in _QGEMV_QTYPES.items():
+    assert _e.gemm is not None or _e.gemm_exempt, (
+        f"{_name}: declare a fused GEMM kernel or an explicit gemm_exempt "
+        "reason (silent XLA-dequant fallback above _GEMV_MAX_ROWS is the "
+        "2.7x cliff class this registry exists to prevent)"
+    )
 
-def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
+
+def _fused_kernel(x: jax.Array, w: QTensor) -> Optional[Callable]:
+    """The fused kernel this (x, w) pair dispatches to, or None for the
+    XLA dequant path. Shape guards are shared by both shape classes."""
     from bigdl_tpu.ops.pallas import use_pallas
 
     entry = _QGEMV_QTYPES.get(w.qtype)
     if entry is None or w.data.ndim != 2:
-        return False
+        return None
     out, kw_ = w.data.shape
     if out % 128 != 0:
-        return False
+        return None
     # the kernels tile O at >= 128 rows (Mosaic lane rule forbids
     # smaller output tiles); if even a 128-row tile's persistent weight
     # block cannot fit the scoped-VMEM budget half, fall back to the
     # XLA dequant path rather than compile a kernel that overflows vmem
     row_bytes = kw_ * w.data.dtype.itemsize
     if 128 * row_bytes > 5 * 1024 * 1024:
-        return False
+        return None
     if w.shape[-1] % entry.k_multiple != 0:
-        return False
-    return _rows(x.shape) <= _GEMV_MAX_ROWS and use_pallas()
+        return None
+    if not use_pallas():
+        return None
+    if _rows(x.shape) <= _GEMV_MAX_ROWS:
+        return entry.run
+    return entry.gemm  # None for gemm_exempt formats
+
+
+def _use_qgemv(x: jax.Array, w: QTensor) -> bool:
+    """Decode-shaped dispatch to the fused GEMV contract."""
+    return (_rows(x.shape) <= _GEMV_MAX_ROWS
+            and _fused_kernel(x, w) is not None)
+
+
+def _use_qgemm(x: jax.Array, w: QTensor) -> bool:
+    """Prefill/batch/training dispatch to the fused tiled GEMM."""
+    return (_rows(x.shape) > _GEMV_MAX_ROWS
+            and _fused_kernel(x, w) is not None)
+
+
+def _zero_cotangent(w: QTensor) -> QTensor:
+    """Symbolic-zero cotangent for the frozen quantized weight: float
+    leaves get typed zeros, integer code/sub-scale leaves get float0
+    (the tangent type jax assigns non-differentiable dtypes)."""
+    def z(a):
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return jnp.zeros(a.shape, a.dtype)
+        return np.zeros(a.shape, jax.dtypes.float0)
+
+    return w.map_arrays(z)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _fused_matmul(x: jax.Array, w: QTensor, qtype: str, block_o: int):
+    entry = _QGEMV_QTYPES[qtype]
+    run = entry.run if _rows(x.shape) <= _GEMV_MAX_ROWS else entry.gemm
+    return run(x, w, block_o)
+
+
+def _fused_fwd(x, w, qtype, block_o):
+    return _fused_matmul(x, w, qtype, block_o), w
+
+
+def _fused_bwd(qtype, block_o, w, g):
+    # dx = g @ dequant(W): the backward stays on the in-graph dequant
+    # path (XLA rematerializes the dequant as a constant of the VJP —
+    # exactly what autodiff of the fallback einsum produced before the
+    # forward was fused); W itself is frozen, so its cotangent is a
+    # symbolic zero
+    wd = w.dequantize(g.dtype)
+    dx = jnp.einsum("...o,ok->...k", g, wd, preferred_element_type=g.dtype)
+    return dx, _zero_cotangent(w)
+
+
+_fused_matmul.defvjp(_fused_fwd, _fused_bwd)
 
 
 def linear(
@@ -181,16 +275,16 @@ def linear(
 ) -> jax.Array:
     """y = x @ W^T (+ bias). W has logical shape [out_features, in_features].
 
-    For QTensor weights the dequantization is expressed in-graph so XLA
-    fuses unpack+scale into the matmul's operand read; weights stay packed
-    in HBM.
+    QTensor weights route to the fused Pallas dequant kernels whenever
+    the shape is eligible (GEMV below `_GEMV_MAX_ROWS` rows, tiled GEMM
+    above); otherwise the dequantization is expressed in-graph so XLA
+    fuses unpack+scale into the matmul's operand read. Weights stay
+    packed in HBM either way.
     """
     if isinstance(w, QTensor):
-        if _use_qgemv(x, w):
+        if _fused_kernel(x, w) is not None:
             block_o = 256 if w.data.shape[0] % 256 == 0 else 128
-            y = _QGEMV_QTYPES[w.qtype].run(
-                x.astype(compute_dtype), w, block_o
-            )
+            y = _fused_matmul(x.astype(compute_dtype), w, w.qtype, block_o)
             if bias is not None:
                 y = y + bias.astype(compute_dtype)
             return y

@@ -46,14 +46,15 @@ from bigdl_tpu.ops.pallas.paged_attention import (  # noqa: E402
     paged_decode_attention,
 )
 from bigdl_tpu.ops.pallas.qmatmul import (  # noqa: E402
-    qmatmul_asym_int4, qmatmul_bytes, qmatmul_codebook, qmatmul_fp8,
-    qmatmul_int4, qmatmul_int8, qmatmul_planes, qmatmul_q2k, qmatmul_q4k,
-    qmatmul_q5k, qmatmul_q6k,
+    qmatmul, qmatmul_asym_int4, qmatmul_bytes, qmatmul_codebook,
+    qmatmul_fp8, qmatmul_int4, qmatmul_int8, qmatmul_planes, qmatmul_q2k,
+    qmatmul_q4k, qmatmul_q5k, qmatmul_q6k,
 )
 
 __all__ = ["use_pallas", "interpret_mode", "flash_attention",
            "flash_attention_trainable",
-           "paged_decode_attention", "qmatmul_int4", "qmatmul_codebook",
+           "paged_decode_attention", "qmatmul", "qmatmul_int4",
+           "qmatmul_codebook",
            "qmatmul_int8", "qmatmul_asym_int4", "qmatmul_q4k",
            "qmatmul_q6k", "qmatmul_bytes", "qmatmul_fp8",
            "qmatmul_planes", "qmatmul_q2k", "qmatmul_q5k"]

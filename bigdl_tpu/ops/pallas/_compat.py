@@ -1,10 +1,12 @@
 """Pallas API-spelling compat for the pinned jax.
 
 jax 0.4.37 spells it TPUCompilerParams; newer jax renamed it to
-CompilerParams. One alias here so every kernel module agrees
-(paged_attention / flash_backward still use the bare newer spelling
-deliberately — flipping them adds interpret-mode CPU cost against the
-tier-1 time budget; import from here when migrating them).
+CompilerParams. One alias here so every kernel module agrees. qmatmul
+and flash_backward import it (flash_backward since the tiled-GEMM PR:
+its 5 grad-parity tests now execute, ~11 s, and the trainable flash
+path works on the pinned jax). paged_attention still uses the bare
+newer spelling deliberately — see the comment there (tier-1 budget +
+an unresolved token-parity divergence).
 """
 
 from __future__ import annotations

@@ -1,0 +1,98 @@
+"""Tile / chunk policy for the fused dequant matmul family — pure
+Python, no jax use at module level.
+
+Shared by two consumers that must never disagree:
+
+* `ops/pallas/qmatmul.py` picks its real Pallas block shapes here;
+* `benchmark/roofline.py` evaluates the analytic bytes-moved / FLOPs
+  model **at the same block shapes** on any machine, no device (and no
+  jax) required — the first increment of the ROADMAP
+  "hardware-independent perf gate".
+
+The policy encodes the Mosaic rules the kernels were built around
+(module docstring of qmatmul.py): output tiles never below 128 lanes,
+full-lane operand blocks, and live VMEM bounded by an in-kernel
+statically-unrolled chunk loop over K.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.utils import round_up  # noqa: F401  (re-exported policy dep)
+
+VMEM_BUDGET = 10 * 1024 * 1024  # leave scoped-VMEM headroom under 16 MiB
+
+# x row-tile slab cap: the [block_m, K] activation block must leave room
+# for the weight tile + per-chunk dequant temporaries in the budget
+_X_SLAB_BYTES = 3 * 1024 * 1024 + 512 * 1024
+
+
+def finest_split(K: int, planes: tuple) -> int:
+    """Elements per split of the finest packed plane — the chunk-walk
+    period of the dequant kernels. Byte-per-element storage (planes=())
+    has a single 'split' covering all of K."""
+    if not planes:
+        return K
+    return K // max(8 // b for b in planes)
+
+
+def chunk_spans(total: int, target: int):
+    """Static chunk spans (start, size) covering [0, total); every
+    boundary is a multiple of 128 (x/w lane alignment) when total is,
+    and therefore aligned to the 16/32/64-element scale blocks.
+    256-element SUPER-block boundaries are NOT respected (128-multiples
+    can start mid-super-block, e.g. c0=6144 in kh=7168) — super-scale
+    expansion must use the offset form of `qdecode.expand_super`."""
+    spans = []
+    c0 = 0
+    while c0 < total:
+        ck = min(target, total - c0)
+        spans.append((c0, ck))
+        c0 += ck
+    return spans
+
+
+def pick_block_o(O: int, persist_per_row: int, cap: int = 256) -> int:
+    """Largest lane-legal O tile: a multiple of 128 dividing O (256
+    preferred, 128 if the per-row persistent footprint is large or the
+    caller caps it), else the full dim (always legal — Mosaic pads)."""
+    for bo in (256, 128):
+        if bo <= cap and O % bo == 0 and (
+            bo * persist_per_row <= VMEM_BUDGET // 2
+        ):
+            return bo
+    if O % 128 == 0:
+        return 128
+    return O
+
+
+def pick_block_m(M: int, K: int, x_bpe: int = 2) -> int:
+    """Row tile for the M grid dimension.
+
+    Decode shapes (M <= ~32) keep the established GEMV contract: the
+    whole padded-M extent as ONE block (grid_m == 1), identical to the
+    silicon-validated 1-D-grid kernels. Above that, the largest
+    MXU-friendly power-of-two tile whose [block_m, K] x-slab fits the
+    VMEM allowance — weights are re-fetched once per M tile, so bigger
+    tiles amortize packed-weight HBM traffic."""
+    mp8 = round_up(max(M, 1), 8)
+    if mp8 <= 256 and mp8 * K * x_bpe <= _X_SLAB_BYTES:
+        return mp8
+    for bm in (256, 128, 64, 32, 16):
+        if bm < mp8 and bm * K * x_bpe <= _X_SLAB_BYTES:
+            return bm
+    return 8
+
+
+def chunk_target(block_o: int, persist_bytes: int, kh: int,
+                 temp_bpe: int = 12) -> int:
+    """Largest chunk whose per-chunk temporaries (temp_bpe B/element of
+    dequant intermediates — decoded codes + expanded scales in f32 plus
+    the bf16 weight tile — plus the one-hot sel) fit beside the
+    persistent blocks in the scoped-VMEM budget."""
+    for ck in (2048, 1024, 512, 256, 128):
+        if ck > kh:
+            continue
+        temp = block_o * ck * temp_bpe + (ck // 16) * ck * 4
+        if persist_bytes + temp <= VMEM_BUDGET:
+            return ck
+    return 128

@@ -1,0 +1,58 @@
+"""jax API-spelling compat for the pinned jax (same role as
+ops/pallas/_compat.py, for the sharding layer).
+
+Newer jax promoted `shard_map` to `jax.shard_map` and renamed its
+kwargs (`check_rep` -> `check_vma`, manual axes declared via
+`axis_names`); jax 0.4.37 ships it at
+`jax.experimental.shard_map.shard_map` with the old spelling. The
+pipeline-parallel forward, ring attention, and the QLoRA ring-mesh
+train path were all failing with AttributeError on the pinned jax (11
+tier-1 tests). One translating wrapper here so every call site can use
+the NEW spelling and keep working when jax is upgraded.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "set_mesh"):
+    set_mesh = jax.set_mesh
+else:
+    def set_mesh(mesh):
+        """0.4.37 spelling of the ambient-mesh context: `jax.sharding.
+        Mesh` IS a context manager (`with mesh:`), which is what resolves
+        bare PartitionSpecs in with_sharding_constraint / shard_map on
+        the pinned jax. Returning the mesh keeps `with set_mesh(m):`
+        call sites working under both spellings."""
+        return mesh
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None):
+        """New-API facade over the 0.4.37 experimental shard_map:
+        check_vma -> check_rep; axis_names (manual axes) -> auto (the
+        complement over the mesh). Usable positionally or as a
+        functools.partial-style decorator, like the new jax.shard_map."""
+        if f is None:
+            return lambda g: shard_map(
+                g, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma, axis_names=axis_names,
+            )
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+        if axis_names is not None:
+            # only axes with real extent go to `auto`: treating size-1
+            # axes as (trivially) manual is semantically identical and
+            # keeps the common pp-only mesh on the plain shard_map path —
+            # 0.4.37's auto-mode lowers axis_index to a PartitionId
+            # instruction its SPMD partitioner then rejects
+            auto = frozenset(n for n in mesh.axis_names
+                             if n not in axis_names and mesh.shape[n] > 1)
+            if auto:
+                kw["auto"] = auto
+        return _shard_map(f, **kw)

@@ -25,6 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from bigdl_tpu.parallel._compat import shard_map as _shard_map
 
 _NEG_INF = -1e30
 
@@ -119,7 +120,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp", causal: bool = True):
     seq_spec = P(None, axis_name, None, None)
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),
         out_specs=seq_spec,

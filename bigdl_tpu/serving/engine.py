@@ -387,7 +387,9 @@ class InferenceEngine:
                 # stay valid across _reset_state (same shapes).
                 import contextlib
 
-                ctx = (jax.set_mesh(self._mesh) if self._mesh is not None
+                from bigdl_tpu.parallel._compat import set_mesh
+
+                ctx = (set_mesh(self._mesh) if self._mesh is not None
                        else contextlib.nullcontext())
                 args = (self.model.params, self._draft_params, self.cur,
                         self.cache, self.dcache, jax.random.PRNGKey(0),
@@ -520,7 +522,9 @@ class InferenceEngine:
             return fn
 
         def wrapped(*a, **k):
-            with jax.set_mesh(self._mesh):
+            from bigdl_tpu.parallel._compat import set_mesh
+
+            with set_mesh(self._mesh):
                 return fn(*a, **k)
 
         return wrapped

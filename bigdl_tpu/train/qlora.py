@@ -10,6 +10,15 @@ reference's backward (dequantized W^T participates in the VJP as a
 constant; XLA rematerializes the dequant, no custom autograd class
 needed). One jitted train step covers forward, backward, and the optax
 update, sharded over the same (dp, sp, tp) mesh as inference.
+
+The frozen-base matmul in the FORWARD runs on the fused tiled
+dequant-GEMM (ops/linear.py routes training shapes — rows >
+`_GEMV_MAX_ROWS` — to the Pallas kernel under a custom_vjp): base
+weights stay packed in HBM and dequantize tile-by-tile in VMEM instead
+of materializing a bf16 copy per step. The backward's dx = g @ dq(W)
+stays on the XLA rematerialized-dequant path, numerically identical to
+the pre-fused behavior (parity: tests/test_qgemm.py). A fused low-bit
+backward is the ROADMAP follow-up (arxiv 2306.11987).
 """
 
 from __future__ import annotations
@@ -190,13 +199,14 @@ def make_train_step(
     ring_mesh: pass the Mesh to replace those all-gathers with ring
     attention (parallel/ring.py) — each device keeps 1/sp of the KV and
     shards rotate over ICI, making attention memory O(T/sp) for
-    long-context training. Requires an enclosing `jax.set_mesh` and
+    long-context training. Requires an enclosing mesh context (parallel._compat.set_mesh) and
     sliding_window/softcap-free attention (llama-family default).
     """
     attention_override = None
     if ring_mesh is not None:
         from jax.sharding import PartitionSpec as P
 
+        from bigdl_tpu.parallel._compat import shard_map as _shard_map
         from bigdl_tpu.parallel.ring import ring_attention
 
         # features the ring path does not implement — fail loudly instead
@@ -222,7 +232,7 @@ def make_train_step(
                 scale=config.attn_scale, start=start,
             )
 
-        attention_override = jax.shard_map(
+        attention_override = _shard_map(
             _local,
             mesh=ring_mesh,
             in_specs=(qspec, qspec, qspec, P(batch_axis)),

@@ -188,7 +188,9 @@ def main(argv=None) -> int:
         # advances per step and rides the checkpoint so a resumed run
         # continues the same stream if a stochastic recipe is swapped in
         rng, _ = jax.random.split(rng)
-        with jax.set_mesh(mesh):
+        from bigdl_tpu.parallel._compat import set_mesh
+
+        with set_mesh(mesh):
             lora, opt_state, loss = step_j(params, lora, opt_state,
                                            tokens, mask)
         if pid == 0 and (step % 10 == 0 or step == args.steps - 1):

@@ -26,6 +26,9 @@ fi
 if [[ "${1:-}" == "--core" ]]; then
   echo "== core gate (< 5 min): quant/native/model/engine basics +"
   echo "   fused-GEMV kernel parity for every qtype (test_pallas -m core) +"
+  echo "   tiled dequant-GEMM dispatch coverage + parity matrix straddling"
+  echo "   _GEMV_MAX_ROWS and the QLoRA fused-base train-step parity"
+  echo "   (test_qgemm -m core) +"
   echo "   fault-injection chaos suite (CPU-only; slow storm variants excluded) +"
   echo "   storage-corruption matrix (test_durability: injected bit_flip/"
   echo "   truncate/torn_rename/drop_file x checkpoint/train/journal)"

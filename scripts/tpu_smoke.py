@@ -1,7 +1,7 @@
 """Direct silicon smoke for every Pallas kernel: compile + numerics vs
 the XLA dequant fallback, per-kernel wall time. Run on a live TPU:
 
-    python scripts/tpu_smoke.py [gemv|attn|all] [--k K1,K2,...]
+    python scripts/tpu_smoke.py [gemv|gemm|attn|all] [--k K1,K2,...]
 
 Synthesizes QTensor fields from random packed codes host-side (no
 quantize() pass — the k-quant host quantizer at real shapes costs
@@ -121,6 +121,51 @@ def smoke_gemv(k_list, qtypes=None, O=4096, bench_best=False):
     return results
 
 
+def smoke_gemm(k_list, qtypes=None, O=4096, m_list=(128, 512, 2048)):
+    """Tiled dequant-GEMM (rows > _GEMV_MAX_ROWS): Mosaic compile +
+    numerics vs the XLA dequant path at prefill shapes, with the
+    analytic roofline prediction logged per entry. Measured fused-vs-XLA
+    *latency* on silicon comes from bench.py's gemm_vs_xla kernel-matrix
+    entry (marginal-cost timed), not from this smoke."""
+    from bigdl_tpu.benchmark.roofline import qmatmul_cost
+    from bigdl_tpu.ops.linear import _use_qgemm, linear
+
+    qtypes = qtypes or ("sym_int4", "q4_k", "fp8_e5m2")
+    rng = np.random.default_rng(0)
+    results = {}
+    for K in k_list:
+        for M in m_list:
+            x = jax.device_put(np.ones((M, K), np.float32) * 0.01).astype(
+                jnp.bfloat16)
+            for qtype in qtypes:
+                name = f"gemm_{qtype}_m{M}_k{K}"
+                try:
+                    qt = jax.device_put(synth_qtensor(qtype, O, K, rng))
+                    assert _use_qgemm(x, qt), f"{name} not GEMM-eligible"
+                    t0 = time.time()
+                    f = jax.jit(lambda a, b: linear(a, b, None, jnp.bfloat16))
+                    y = np.asarray(jax.device_get(f(x, qt)))
+                    t_compile = time.time() - t0
+                    assert y.shape == (M, O) and np.isfinite(y).all()
+                    ref = np.asarray(jax.device_get(jax.jit(
+                        lambda a, b: (a @ b.dequantize(jnp.bfloat16).T)
+                    )(x, qt)))
+                    err = float(np.max(np.abs(y - ref)) /
+                                (np.max(np.abs(ref)) + 1e-9))
+                    cost = qmatmul_cost(qtype, M, K, O)
+                    results[name] = dict(
+                        ok=True, compile_s=round(t_compile, 1),
+                        rel_err=round(err, 4),
+                        analytic_bytes_ratio=cost["bytes_ratio_vs_xla"])
+                    log(f"{name}: OK compile={t_compile:.1f}s "
+                        f"rel_err={err:.4f} analytic "
+                        f"{cost['bytes_ratio_vs_xla']}x bytes vs xla")
+                except Exception as e:
+                    results[name] = dict(ok=False, error=repr(e)[:300])
+                    log(f"{name}: FAIL {repr(e)[:200]}")
+    return results
+
+
 def smoke_attn():
     results = {}
     # flash attention, llama3-8b GQA shape
@@ -212,6 +257,8 @@ if __name__ == "__main__":
     out = {}
     if mode in ("gemv", "all"):
         out.update(smoke_gemv(ks))
+    if mode in ("gemm", "all"):
+        out.update(smoke_gemm([ks[0]]))
     if mode in ("attn", "all"):
         out.update(smoke_attn())
     n_ok = sum(1 for v in out.values() if v.get("ok"))

@@ -95,6 +95,39 @@ def test_all_children_dead_emits_bench_failed(bench, capsys):
     assert payload["metric"] == "bench_failed"
 
 
+_ANALYTIC = {
+    "metric": "fused_gemm_analytic_bytes_ratio_m512", "value": 3.12,
+    "unit": "x_vs_xla_dequant", "vs_baseline": 0, "shape": "m512xk4096xo4096",
+    "analytic": {"sym_int4_m512": {"bytes_ratio_vs_xla": 3.12},
+                 "sym_int4_m1": {"bytes_ratio_vs_xla": 1.9}},
+}
+
+
+def test_analytic_attaches_compact_summary(bench, capsys):
+    """The no-device roofline stage banks first and its M=512 summary
+    rides the decoded headline (full sweep stays in the child line)."""
+    results = {
+        "analytic": _ANALYTIC,
+        "decode": lambda preset: {
+            "metric": f"{preset}_decode", "value": 15.0,
+            "unit": "ms/token", "vs_baseline": 1.33},
+    }
+    payload, calls, code = run_main(bench, results, capsys)
+    assert code == 0
+    assert calls[0] == ("analytic", "-")  # before any device candidate
+    assert payload["metric"].endswith("_decode")
+    assert payload["gemm_analytic_m512"] == {"sym_int4": 3.12}
+
+
+def test_analytic_alone_still_banks(bench, capsys):
+    """Dead-tunnel day: every device child fails but the analytic line
+    is the emitted result — perf PRs always land with a number."""
+    payload, _, code = run_main(bench, {"analytic": _ANALYTIC}, capsys)
+    assert code == 0
+    assert payload["metric"] == "fused_gemm_analytic_bytes_ratio_m512"
+    assert payload["value"] == 3.12
+
+
 def test_kernel_matrix_alone_still_banks(bench, capsys):
     results = {
         "kernels": {"metric": "pallas_kernel_matrix", "value": 3,
