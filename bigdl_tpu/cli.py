@@ -11,6 +11,7 @@ binary; here every path is the same XLA program) plus `llm_convert`
     python -m bigdl_tpu.cli bench    <model_dir>
     python -m bigdl_tpu.cli chat     <model_dir>
     python -m bigdl_tpu.cli verify   <ckpt_dir | ckpt.npz>
+    python -m bigdl_tpu.cli train-status <ckpt_dir>
 """
 
 from __future__ import annotations
@@ -252,12 +253,15 @@ def cmd_serve(args):
         logprobs_top_k=args.logprobs_top_k,
     )
     server.start()
+    server.install_signal_handlers()  # SIGTERM -> drain, flush, exit 0
     print(f"bigdl-tpu serving {args.model} on {args.host}:{server.port}")
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
-        server.shutdown()
+        # ^C gets the same drain as SIGTERM: in-flight requests finish
+        # (bounded by request_timeout_s), journal flushed + compacted
+        server.shutdown(graceful=True)
 
 
 def cmd_fastchat_worker(args):
@@ -357,6 +361,63 @@ def cmd_verify(args):
     if not ok:
         raise SystemExit(1)
     print("OK")
+
+
+def cmd_train_status(args):
+    """Operator view of a training run's checkpoint dir (pairs with
+    `bigdl-tpu verify`, which does the full per-tensor audit): rotation
+    inventory with fast integrity verdicts, the last-good (newest
+    loadable) step a restart would resume from, and the tail of the
+    supervisor's structured event log. Exit 1 when checkpoints exist
+    but NONE is loadable — a restart would silently start from step 0."""
+    import glob as _glob
+
+    from bigdl_tpu.train.checkpoint import (
+        inspect_train_checkpoints_dir, list_train_checkpoints,
+    )
+    from bigdl_tpu.train.supervisor import EventLog
+
+    d = args.ckpt_dir
+    if not os.path.isdir(d):
+        raise SystemExit(f"{d}: not a checkpoint directory")
+    infos = inspect_train_checkpoints_dir(d)
+    if not infos:
+        print(f"{d}: no rotated checkpoints (ckpt-*.npz)")
+    else:
+        print(f"{d}: {len(infos)} rotated checkpoint(s), newest first")
+        for info in infos:
+            status = "ok" if info["ok"] else f"CORRUPT ({info['detail']})"
+            size = info["size"]
+            mtime = (time.strftime("%Y-%m-%d %H:%M:%S",
+                                   time.localtime(info["mtime"]))
+                     if info["mtime"] else "?")
+            print(f"  {os.path.basename(info['path'])}  "
+                  f"step={info['step']}  {size or '?'}B  {mtime}  {status}")
+        good = [i for i in infos if i["ok"]]
+        if good:
+            print(f"last-good step: {good[0]['step']} "
+                  f"({os.path.basename(good[0]['path'])})")
+        else:
+            print("last-good step: NONE — every candidate is corrupt; "
+                  "a restart would begin from scratch")
+    legacy = os.path.join(d, "train_state.npz")
+    if os.path.exists(legacy):
+        print(f"legacy single-file checkpoint present: {legacy}")
+    events = sorted(_glob.glob(os.path.join(d, "supervisor_events*.jsonl")))
+    for ev_path in events:
+        tail = EventLog.tail(ev_path, n=args.events)
+        print(f"\n{os.path.basename(ev_path)} (last {len(tail)} events):")
+        for e in tail:
+            ts = time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0)))
+            extra = {k: v for k, v in e.items()
+                     if k not in ("ts", "step", "kind")}
+            print(f"  {ts}  step {e.get('step'):>8}  {e.get('kind'):<16}"
+                  + (f" {extra}" if extra else ""))
+    if not events:
+        print("no supervisor event log (pre-supervisor run, or the "
+              "trainer was driven without TrainSupervisor)")
+    if infos and not any(i["ok"] for i in infos):
+        raise SystemExit(1)
 
 
 def cmd_bench(args):
@@ -502,6 +563,17 @@ def main(argv=None):
     v.add_argument("path", help="save_low_bit dir, train .npz, or a "
                                 "rotation dir of ckpt-*.npz")
     v.set_defaults(fn=cmd_verify)
+
+    ts = sub.add_parser(
+        "train-status",
+        help="training-run health: last-good step, checkpoint rotation "
+             "inventory, supervisor event-log tail (exit 1 when no "
+             "checkpoint is loadable)",
+    )
+    ts.add_argument("ckpt_dir", help="the trainer's --ckpt-dir")
+    ts.add_argument("--events", type=int, default=15,
+                    help="event-log tail length")
+    ts.set_defaults(fn=cmd_train_status)
 
     b = sub.add_parser("bench", help="quick decode-latency check", parents=[qp])
     b.add_argument("model")

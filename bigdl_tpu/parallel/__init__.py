@@ -15,6 +15,13 @@ Axes:
     sp — sequence parallel (activation sequence dim; ring attention later)
 """
 
+from bigdl_tpu.parallel.health import (
+    HealthMonitor,
+    RankDropError,
+    anomaly_consensus,
+    consensus_any,
+    init_multihost_with_retry,
+)
 from bigdl_tpu.parallel.mesh import make_mesh, mesh_shape_for
 from bigdl_tpu.parallel.multihost import host_aware_mesh, init_multihost
 from bigdl_tpu.parallel.sharding import (
@@ -25,8 +32,13 @@ from bigdl_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "HealthMonitor",
+    "RankDropError",
+    "anomaly_consensus",
+    "consensus_any",
     "host_aware_mesh",
     "init_multihost",
+    "init_multihost_with_retry",
     "make_mesh",
     "mesh_shape_for",
     "param_specs",

@@ -1,0 +1,218 @@
+"""Multi-host health layer: init retry, heartbeats, anomaly consensus.
+
+`multihost.init_multihost` makes joining a pod job one call, but a
+production JobSet adds three failure modes the bare call ignores
+(EQuARX, arxiv 2506.17615, catalogs the collective-path partial
+failures; the reference's MPI jobs simply hang on all of them):
+
+1. **Flaky coordinator at pod start** — the process-0 coordinator pod
+   may come up seconds after its peers; a one-shot
+   `jax.distributed.initialize` on a peer then dies and the whole
+   JobSet crash-loops. :func:`init_multihost_with_retry` wraps the join
+   in bounded exponential backoff.
+2. **A lagging or desynced peer mid-run** — :class:`HealthMonitor`
+   heartbeats (rank, step, timestamp) across hosts and raises a
+   structured :class:`RankDropError` naming the stale peer
+   (`max_step_lag`) or, under the injected ``rank_drop`` fault, the
+   missing one. Honest limit: a peer that is fully DEAD wedges the
+   heartbeat allgather exactly like any other collective, so the
+   *detection* of that case stays with `train/watchdog.py`'s timeout
+   (exit 42) — this layer diagnoses the partial-failure modes a
+   collective can actually survive, and gives tests an injectable
+   seam for the rest.
+3. **Rank-local anomaly decisions desyncing SPMD** — if rank 3 skips an
+   optimizer update that rank 5 applies, every later collective runs on
+   diverged state (silent corruption, not a crash).
+   :func:`anomaly_consensus` reduces the skip/continue flag across
+   processes so all ranks take the same branch by construction.
+
+Everything degrades to a no-op-ish identity on a single process, so the
+training supervisor (`train/supervisor.py`) calls these unconditionally
+and the whole layer is CPU-testable: each function takes an injectable
+`allgather` so tests simulate N hosts (and dropped ranks) in-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+import warnings
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.parallel.multihost import init_multihost
+
+
+def _default_allgather(row: np.ndarray) -> np.ndarray:
+    """Gather one fixed-shape float row per process -> [nproc, ...].
+    Single-process: identity (no collective, no device traffic)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(row)[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(row)))
+
+
+def init_multihost_with_retry(
+    attempts: int = 5,
+    backoff_s: float = 1.0,
+    max_backoff_s: float = 30.0,
+    init_fn: Optional[Callable] = None,
+    **kwargs,
+) -> int:
+    """`init_multihost` under bounded exponential backoff — the
+    coordinator pod of a fresh JobSet routinely comes up after its
+    peers, and the bare `jax.distributed.initialize` fails fast on a
+    connection refusal. Returns the number of attempts used; re-raises
+    the last error once `attempts` are exhausted (a partial-config
+    ValueError is NOT retried: a wrong process identity never becomes
+    right by waiting)."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    fn = init_fn or init_multihost
+    delay = backoff_s
+    for attempt in range(1, attempts + 1):
+        try:
+            fn(**kwargs)
+            return attempt
+        except ValueError:
+            raise  # config error, not a flaky coordinator
+        except Exception as e:  # noqa: BLE001 - RuntimeError/XlaRuntimeError
+            if attempt == attempts:
+                raise
+            print(
+                f"[bigdl-tpu health] distributed init attempt "
+                f"{attempt}/{attempts} failed ({type(e).__name__}: {e}); "
+                f"retrying in {delay:.1f}s",
+                file=sys.stderr, flush=True,
+            )
+            time.sleep(delay)
+            delay = min(delay * 2, max_backoff_s)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+@dataclasses.dataclass
+class RankStatus:
+    rank: int
+    step: int
+    ts: float  # sender's wall clock at heartbeat
+
+
+class RankDropError(RuntimeError):
+    """A heartbeat round is missing (or has stale entries for) one or
+    more ranks. Structured so the supervisor's abort diagnostic can
+    name the peer instead of 'collective hung'."""
+
+    def __init__(self, missing: Sequence[int], present: Sequence[int],
+                 step: int, detail: str = ""):
+        self.missing = sorted(missing)
+        self.present = sorted(present)
+        self.step = step
+        self.detail = detail
+        super().__init__(
+            f"rank(s) {self.missing} missing from the step-{step} "
+            f"heartbeat (present: {self.present})"
+            + (f" — {detail}" if detail else "")
+        )
+
+
+class HealthMonitor:
+    """Cross-host heartbeat: every process contributes
+    (rank, step, timestamp); :meth:`check` raises :class:`RankDropError`
+    when a rank is absent or its step lags by more than `max_step_lag`.
+
+    `allgather` is injectable for CPU tests (simulate N hosts from one
+    process); `faults` threads a TrainFaultInjector — an armed
+    ``rank_drop`` point deletes the victim rank's row from the gathered
+    heartbeat, driving the exact code path a dead peer would."""
+
+    def __init__(
+        self,
+        *,
+        num_processes: Optional[int] = None,
+        process_index: Optional[int] = None,
+        max_step_lag: Optional[int] = None,
+        allgather: Optional[Callable] = None,
+        faults=None,
+    ):
+        import jax
+
+        self.num_processes = (num_processes if num_processes is not None
+                              else jax.process_count())
+        self.process_index = (process_index if process_index is not None
+                              else jax.process_index())
+        self.max_step_lag = max_step_lag
+        self._allgather = allgather or _default_allgather
+        self._faults = faults
+
+    def snapshot(self, step: int) -> list:
+        """One heartbeat round -> [RankStatus] actually heard from."""
+        row = np.asarray(
+            [float(self.process_index), float(step), time.time()],
+            np.float64,
+        )
+        gathered = np.atleast_2d(np.asarray(self._allgather(row)))
+        statuses = [
+            RankStatus(rank=int(r[0]), step=int(r[1]), ts=float(r[2]))
+            for r in gathered
+        ]
+        if self._faults is not None:
+            f = self._faults.fire("rank_drop")
+            if f is not None:
+                victim = int(f.get("rank", self.num_processes - 1))
+                statuses = [s for s in statuses if s.rank != victim]
+        return statuses
+
+    def check(self, step: int) -> list:
+        """Heartbeat + verdict: returns the statuses when every rank is
+        present and fresh, raises :class:`RankDropError` otherwise."""
+        statuses = self.snapshot(step)
+        seen = {s.rank for s in statuses}
+        missing = set(range(self.num_processes)) - seen
+        if missing:
+            raise RankDropError(missing, seen, step)
+        if self.max_step_lag is not None:
+            stale = [s for s in statuses
+                     if step - s.step > self.max_step_lag]
+            if stale:
+                raise RankDropError(
+                    [s.rank for s in stale], seen, step,
+                    detail=f"stale: {[(s.rank, s.step) for s in stale]} "
+                           f"lag > {self.max_step_lag} steps",
+                )
+        return statuses
+
+
+def consensus_any(flags: Sequence[bool],
+                  allgather: Optional[Callable] = None) -> list:
+    """Element-wise all-ranks OR of a vector of rank-local boolean
+    verdicts in ONE collective. Every rank MUST call this at the same
+    step boundary; all ranks then act on identical verdicts, so a
+    rank-local decision (NaN skip, preemption exit) can never fork the
+    SPMD program state. Single process: identity."""
+    gather = allgather or _default_allgather
+    row = np.asarray([1.0 if f else 0.0 for f in flags], np.float32)
+    return [bool(v) for v in np.asarray(gather(row)).max(axis=0) > 0]
+
+
+def anomaly_consensus(local_flag: bool,
+                      allgather: Optional[Callable] = None) -> bool:
+    """All-ranks OR of a rank-local anomaly verdict (one-flag
+    :func:`consensus_any`)."""
+    return consensus_any([local_flag], allgather=allgather)[0]
+
+
+def warn_if_unhealthy(monitor: HealthMonitor, step: int) -> Optional[str]:
+    """Non-fatal heartbeat probe: returns (and warns with) the
+    diagnostic instead of raising — for callers that want visibility
+    without an abort (e.g. the final pre-shutdown beat)."""
+    try:
+        monitor.check(step)
+        return None
+    except RankDropError as e:
+        warnings.warn(str(e))
+        return str(e)

@@ -772,6 +772,58 @@ class ApiServer:
         threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
         return self
 
-    def shutdown(self):
+    def shutdown(self, graceful: bool = False,
+                 drain_timeout_s: Optional[float] = None) -> bool:
+        """Stop the server. graceful=True first drains: admissions shed
+        with 503 + Retry-After while the engine thread finishes every
+        in-flight and queued request, bounded by `drain_timeout_s`
+        (default: request_timeout_s — no client is waiting longer than
+        that anyway). Either way the journal is then flushed + compacted
+        (engine.close), so a clean drain leaves nothing to replay and a
+        kill mid-batch still only relies on replay for the unfinished
+        tail. Returns True when the drain completed (vacuously for
+        graceful=False)."""
+        drained = True
+        if graceful:
+            self.engine.begin_drain()
+            timeout = (self.request_timeout_s if drain_timeout_s is None
+                       else drain_timeout_s)
+            deadline = time.monotonic() + timeout
+            while not self.engine.idle():
+                if time.monotonic() > deadline:
+                    drained = False
+                    break
+                time.sleep(0.01)
         self.worker.stop_flag.set()
+        if self.worker.is_alive():
+            # the engine thread must be parked before close(): the
+            # journal handle closes and compaction renames the file —
+            # doing either under a live writer turns the next
+            # record_done into an I/O error that kills the thread
+            self.worker.join(timeout=10.0)
+        if not self.worker.is_alive():
+            self.engine.close()
+        # else: a wedged step outlived the join budget — leave the
+        # journal attached (the process is exiting anyway) and let the
+        # next start's replay cover the unfinished tail
         self.httpd.shutdown()
+        return drained
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM -> graceful drain + exit 0 (k8s preStop/termination
+        path: deploy/k8s/serve-v5e-8.yaml's grace period must exceed
+        request_timeout_s for the drain to finish). Main-thread only;
+        cmd_serve calls this — embedded/test servers manage their own
+        lifecycle."""
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _handler(signum, frame):
+            # restore first: a second SIGTERM mid-drain kills for real
+            signal.signal(signum, signal.SIG_DFL)
+            self.shutdown(graceful=True)
+            raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, _handler)

@@ -432,6 +432,17 @@ class InferenceEngine:
         self.preemption = preemption
         self.preemption_policy = preemption_policy
         self._faults = faults if faults is not None else NULL_INJECTOR
+        # graceful-shutdown latch (begin_drain): new submits shed with
+        # kind "draining" (503 + Retry-After) while in-flight work runs
+        # to completion. Plain bool store/read across threads — a submit
+        # racing the latch lands at most one extra request in the drain.
+        self._draining = False
+        # accepted-but-unfinished request count (under _stat_lock): the
+        # drain's completion signal. Structural emptiness (queue/slots/
+        # parked) is NOT a substitute — a request mid-admission sits in
+        # none of those containers for a moment, and a drain poll in
+        # that window would declare an idle engine with work in hand.
+        self._inflight = 0
         # True while fail_all tears down after an (injected) crash:
         # crash points must not re-fire inside the cleanup's _finish
         # calls or the cleanup itself dies and the engine thread hangs
@@ -890,11 +901,23 @@ class InferenceEngine:
             if stream is not None:
                 stream.put(None)
             return req
+        if self._draining:
+            # graceful shutdown in progress: reject BEFORE the journal
+            # append (a drained request was never accepted, and its
+            # entry would resurrect it at the next start as work the
+            # client already re-sent elsewhere)
+            self._shed_request(req, "draining", (
+                "server is draining for shutdown; retry against a "
+                "fresh instance"
+            ), journaled=False)
+            return req
         if self.max_queue is None:
             # unbounded admission needs no check-then-put atomicity:
             # don't serialize every handler thread's submit (journal
             # append + flush included) behind one lock for a bound that
             # can never reject
+            with self._stat_lock:
+                self._inflight += 1
             if self._journal is not None:
                 self._journal.record_submit(req)
             self._queue.put(req)
@@ -911,6 +934,8 @@ class InferenceEngine:
                     f"max_queue {self.max_queue}; retry later"
                 ), journaled=False)
                 return req
+            with self._stat_lock:
+                self._inflight += 1
             if self._journal is not None:
                 self._journal.record_submit(req)
             self._queue.put(req)
@@ -1376,7 +1401,11 @@ class InferenceEngine:
         """Terminal state for a request NOT currently in a slot (queued /
         parked): mirrors _finish's journal + stream discipline.
         journaled=False is for requests that were never accepted (shed at
-        submit) — they have no journal entry to tombstone."""
+        submit) — they have no journal entry to tombstone and no
+        in-flight charge to release."""
+        if journaled:
+            with self._stat_lock:
+                self._inflight -= 1
         if error is not None:
             req.error = error
         req.finish_reason = reason
@@ -1545,6 +1574,11 @@ class InferenceEngine:
         s = self._slots[slot]
         s.req.finish_reason = reason
         s.req.done = True
+        # before the injected crash point: a crash inside _finish leaves
+        # the request terminal (fail_all preserves it), so its in-flight
+        # charge must already be released
+        with self._stat_lock:
+            self._inflight -= 1
         if counted and reason in ("stop", "length"):
             # genuine completions only: cancelled/timed-out requests also
             # land here as "stop" but must not inflate the throughput
@@ -1962,3 +1996,52 @@ class InferenceEngine:
         for _ in range(max_steps):
             if not self.step():
                 return
+
+    # ---- graceful shutdown (docs/serving.md) -------------------------------
+
+    def begin_drain(self) -> None:
+        """Stop admitting (new submits shed as "draining" -> 503 +
+        Retry-After) while in-flight and queued work keeps stepping.
+        Thread-safe; whoever steps the engine keeps stepping it."""
+        self._draining = True
+
+    def idle(self) -> bool:
+        """No accepted-but-unfinished work remains. Based on the
+        in-flight charge counter, not container emptiness — a request
+        mid-admission is momentarily in no container but still holds
+        its charge, so a concurrent drain poll cannot miss it."""
+        with self._stat_lock:
+            return self._inflight == 0
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """begin_drain + step to completion from the CALLING thread —
+        for engines driven without an _EngineThread (the ApiServer
+        instead begin_drain()s and lets its worker thread finish the
+        work). Returns True when fully drained; False on timeout, with
+        the unfinished requests left pending (journaled engines replay
+        them at the next start — the crash-recovery path is the
+        fallback, not the plan)."""
+        self.begin_drain()
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        while not self.idle():
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            self.step()
+        return True
+
+    def close(self) -> None:
+        """Flush, COMPACT, and detach the journal. Call only after the
+        stepping thread has stopped: compaction os.replace()s the file
+        under any live append handle. After a clean drain the rewrite
+        holds zero entries — the next start replays nothing; after a
+        timed-out drain it holds exactly the unfinished tail.
+        Idempotent."""
+        if self._journal is None:
+            return
+        from bigdl_tpu.serving.journal import RequestJournal
+
+        path = self._journal.path
+        self._journal.close()
+        self._journal = None
+        RequestJournal.compact(path)

@@ -47,12 +47,29 @@ from typing import Optional
 _CRC_RE = re.compile(r"^[0-9a-f]{8}$")
 
 
+def crc_line(body: str) -> str:
+    """`<body>\\t<crc32 hex>` — the journal's wire discipline, shared
+    with the training supervisor's event log (train/supervisor.py) so
+    the two line formats cannot drift."""
+    return f"{body}\t{_crc_of(body)}"
+
+
+def split_crc_line(line: str):
+    """Inverse of :func:`crc_line`: (body, verdict) where verdict is
+    True (crc present and matches), False (present, mismatch — bit
+    rot), or None (no crc suffix: a legacy or torn line; the body is
+    the whole line)."""
+    body, sep, tail = line.rpartition("\t")
+    if sep and _CRC_RE.fullmatch(tail):
+        return body, _crc_of(body) == tail
+    return line, None
+
+
 def _crc_of(body: str) -> str:
     return f"{zlib.crc32(body.encode('utf-8')) & 0xFFFFFFFF:08x}"
 
 
-def _crc_line(body: str) -> str:
-    return f"{body}\t{_crc_of(body)}"
+_crc_line = crc_line
 
 # sampling/stop/deadline fields that survive a restart (stream
 # deliberately not). Deadlines are measured from the REPLAYED submit's
@@ -140,21 +157,21 @@ class RequestJournal:
                     )
                     torn = None
                 # crc-suffixed line (compact JSON never holds a raw tab,
-                # so rpartition is unambiguous). A torn tail can never
+                # so the split is unambiguous). A torn tail can never
                 # masquerade here: truncation eats the crc digits first,
                 # so a full 8-hex suffix means the line was written
                 # whole — a mismatch is bit rot, torn-position or not.
-                body, sep, tail = line.rpartition("\t")
-                if sep and _CRC_RE.fullmatch(tail):
-                    if _crc_of(body) != tail:
-                        corrupt()
-                        warnings.warn(
-                            f"{path}: skipping journal line {i + 1} with "
-                            f"crc32 mismatch (interior corruption): "
-                            f"{body[:60]!r}",
-                            stacklevel=2,
-                        )
-                        continue
+                body, ok = split_crc_line(line)
+                if ok is False:
+                    corrupt()
+                    warnings.warn(
+                        f"{path}: skipping journal line {i + 1} with "
+                        f"crc32 mismatch (interior corruption): "
+                        f"{body[:60]!r}",
+                        stacklevel=2,
+                    )
+                    continue
+                if ok:
                     line = body
                 try:
                     obj = json.loads(line)

@@ -16,6 +16,11 @@ from collections import defaultdict
 # request latency histogram bucket upper bounds (seconds)
 _BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# training steps run minutes on big jobs: the request buckets would pile
+# everything into +Inf
+_STEP_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                 120.0, 300.0, 600.0)
+
 
 def _verify_failures() -> int:
     """Process-wide checkpoint verification failure count (lazy import:
@@ -23,6 +28,20 @@ def _verify_failures() -> int:
     from bigdl_tpu.utils.durability import VERIFY_FAILURES
 
     return VERIFY_FAILURES.value
+
+
+class Counter:
+    """Process-wide thread-safe counter for the module-level registry
+    (same shape as durability.VERIFY_FAILURES, kept local so this
+    module stays stdlib-only)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
 
 
 class Histogram:
@@ -63,6 +82,50 @@ class Histogram:
     def render(self, name: str, help_text: str) -> list:
         return [f"# HELP {name} {help_text}",
                 f"# TYPE {name} histogram"] + self.render_series(name)
+
+
+# ---------------------------------------------------------------------------
+# training-supervisor registry (train/supervisor.py bumps these; the
+# registry is process-wide like VERIFY_FAILURES, so a serving process
+# that also runs finetuning — or a scrape of the trainer's own metrics
+# endpoint — sees the training health without a second registry)
+# ---------------------------------------------------------------------------
+
+TRAIN_ANOMALIES = Counter()             # guarded steps found anomalous
+TRAIN_STEPS_SKIPPED = Counter()         # updates discarded (state kept)
+TRAIN_ROLLBACKS = Counter()             # restores from last-good ckpt
+TRAIN_EMERGENCY_CHECKPOINTS = Counter()  # SIGTERM-boundary saves
+TRAIN_WATCHDOG_ABORTS = Counter()       # hung-step exits
+TRAIN_STEP_SECONDS = Histogram(buckets=_STEP_BUCKETS)
+
+_TRAIN_COUNTER_SERIES = (
+    ("bigdl_tpu_train_anomalies_total",
+     "training steps flagged anomalous (NaN/inf loss or grad-norm, "
+     "EMA loss spike)", TRAIN_ANOMALIES),
+    ("bigdl_tpu_train_steps_skipped_total",
+     "anomalous steps skipped with optimizer state untouched",
+     TRAIN_STEPS_SKIPPED),
+    ("bigdl_tpu_train_rollbacks_total",
+     "rollbacks to the last good checkpoint after consecutive "
+     "anomalies", TRAIN_ROLLBACKS),
+    ("bigdl_tpu_train_emergency_checkpoints_total",
+     "preemption-signal emergency checkpoints", TRAIN_EMERGENCY_CHECKPOINTS),
+    ("bigdl_tpu_train_watchdog_aborts_total",
+     "hung-step watchdog aborts", TRAIN_WATCHDOG_ABORTS),
+)
+
+
+def render_train_series() -> list:
+    lines = []
+    for name, help_text, c in _TRAIN_COUNTER_SERIES:
+        lines += [f"# HELP {name} {help_text}",
+                  f"# TYPE {name} counter",
+                  f"{name} {c.value}"]
+    lines += TRAIN_STEP_SECONDS.render(
+        "bigdl_tpu_train_step_seconds",
+        "supervised training step wall-clock (incl. host loss fetch)",
+    )
+    return lines
 
 
 class Metrics:
@@ -120,6 +183,9 @@ class Metrics:
                 "# TYPE bigdl_tpu_checkpoint_verify_failures_total counter",
                 f"bigdl_tpu_checkpoint_verify_failures_total "
                 f"{_verify_failures()}",
+            ]
+            lines += render_train_series()
+            lines += [
                 "# HELP bigdl_tpu_request_seconds request latency",
                 "# TYPE bigdl_tpu_request_seconds histogram",
             ]

@@ -16,11 +16,19 @@ from bigdl_tpu.train.recipes import (
     sample_lisa_mask,
 )
 from bigdl_tpu.train.checkpoint import (
+    inspect_train_checkpoint,
+    inspect_train_checkpoints_dir,
     list_train_checkpoints,
     load_latest_train_state,
     load_train_state,
     save_train_state,
     save_train_state_rotating,
+)
+from bigdl_tpu.train.supervisor import (
+    SupervisorAbort,
+    SupervisorConfig,
+    TrainFaultInjector,
+    TrainSupervisor,
 )
 from bigdl_tpu.train.dpo import dpo_loss, make_dpo_step, sequence_logprob
 from bigdl_tpu.train.galore import GaLoreState, galore
@@ -46,4 +54,10 @@ __all__ = [
     "save_train_state_rotating",
     "load_latest_train_state",
     "list_train_checkpoints",
+    "inspect_train_checkpoint",
+    "inspect_train_checkpoints_dir",
+    "TrainSupervisor",
+    "SupervisorConfig",
+    "SupervisorAbort",
+    "TrainFaultInjector",
 ]

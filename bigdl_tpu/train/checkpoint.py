@@ -188,7 +188,16 @@ def load_train_state(
                          f"{', '.join(missing_keys)})",
         )
     if meta["format_version"] != 2:
-        raise ValueError(f"unsupported format_version {meta['format_version']}")
+        # there is exactly one train-ckpt format version, so any other
+        # value in a parsed meta is bit rot in that field, not a legacy
+        # file — raise the SKIPPABLE (and counted) IntegrityError so one
+        # rotted meta costs the rotation scan one candidate, not the
+        # whole resume
+        durability.VERIFY_FAILURES.inc()
+        raise IntegrityError(
+            path, detail=f"unsupported format_version "
+                         f"{meta['format_version']!r} (rotted meta?)",
+        )
     arrays = _verify_leaves(path, meta, verify)
     like = {
         "lora": like_lora, "opt_state": like_opt_state,
@@ -306,6 +315,12 @@ def load_latest_train_state(
                 like_params=like_params, verify=verify,
             )
         except (IntegrityError, FileNotFoundError) as e:
+            # every IntegrityError raise site already bumped
+            # durability.VERIFY_FAILURES (so skipped-at-resume corruption
+            # shows on bigdl_tpu_checkpoint_verify_failures_total exactly
+            # like a direct verify= load; regression-tested in
+            # tests/test_train_supervisor.py). FileNotFoundError is a
+            # prune race, not corruption — not counted.
             warnings.warn(
                 f"skipping corrupt train checkpoint {path}: {e}"
             )
@@ -313,6 +328,54 @@ def load_latest_train_state(
         state["path"] = path
         return state
     return None
+
+
+def inspect_train_checkpoint(path: str) -> dict:
+    """Template-free fast-mode inspection for `bigdl-tpu train-status`:
+    {path, step, ok, detail, n_leaves, size, mtime}. Unlike
+    `load_train_state` this needs no like_* trees (nothing is decoded
+    into a pytree) — it answers "would the rotation scan accept this
+    candidate?" cheaply. Verification failures are reported in-band
+    (ok=False + detail), and still bump the process-wide counter via
+    the shared verify path."""
+    out = {
+        "path": path, "step": None, "ok": False, "detail": "",
+        "n_leaves": None, "size": None, "mtime": None,
+    }
+    try:
+        st = os.stat(path)
+        out["size"], out["mtime"] = st.st_size, st.st_mtime
+    except OSError as e:
+        out["detail"] = f"{type(e).__name__}: {e}"
+        return out
+    try:
+        npz = np.load(path, allow_pickle=False)
+        meta = json.loads(str(npz["meta"]))
+    except Exception as e:
+        durability.VERIFY_FAILURES.inc()
+        out["detail"] = f"unreadable checkpoint: {type(e).__name__}: {e}"
+        return out
+    out["step"] = meta.get("step")
+    out["n_leaves"] = meta.get("n_leaves")
+    if meta.get("format_version") != 2:
+        durability.VERIFY_FAILURES.inc()
+        out["detail"] = (f"unsupported format_version "
+                         f"{meta.get('format_version')!r}")
+        return out
+    try:
+        _verify_leaves(path, meta, "fast")
+    except IntegrityError as e:
+        out["detail"] = str(e)
+        return out
+    out["ok"] = True
+    return out
+
+
+def inspect_train_checkpoints_dir(ckpt_dir: str) -> list:
+    """Inspection rows for every rotated candidate, newest first (the
+    order the resume scan tries them)."""
+    return [inspect_train_checkpoint(p)
+            for p in list_train_checkpoints(ckpt_dir)]
 
 
 def verify_train_checkpoint(path: str) -> "durability.VerifyReport":

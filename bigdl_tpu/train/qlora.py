@@ -180,11 +180,19 @@ def make_train_step(
     ring_axis: str = "sp",
     batch_axis: str = "dp",
     remat: bool = False,
+    return_grad_norm: bool = False,
 ):
     """Returns jittable step(params, lora, opt_state, tokens, loss_mask) ->
     (lora, opt_state, loss). Only lora['layers'] is trained (the alpha/rank
     scale stays fixed); init opt_state with optimizer.init(lora['layers']).
-    Donate lora/opt_state at the jit call site.
+    Donate lora/opt_state at the jit call site — UNLESS the step runs
+    under the training supervisor, whose anomaly-skip path must keep
+    the previous buffers alive for one step (train/supervisor.py).
+
+    return_grad_norm=True appends optax.global_norm(grads) to the
+    outputs — the supervisor's overflow guard (quantized-grad NaN/inf
+    shows up in the norm a step before it reaches the loss; arxiv
+    2306.11987) — at the cost of one extra reduction per step.
 
     seq_spec: optional PartitionSpec (e.g. P('dp', 'sp')) constraining the
     input token grid — sequence-parallel training: embedding/norm/MLP run
@@ -261,6 +269,9 @@ def make_train_step(
         )(lora["layers"])
         updates, opt_state = optimizer.update(grads, opt_state, lora["layers"])
         layers = optax.apply_updates(lora["layers"], updates)
-        return {"layers": layers, "scale": scale}, opt_state, loss
+        new_lora = {"layers": layers, "scale": scale}
+        if return_grad_norm:
+            return new_lora, opt_state, loss, optax.global_norm(grads)
+        return new_lora, opt_state, loss
 
     return step

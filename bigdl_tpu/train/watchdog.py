@@ -90,11 +90,12 @@ class StepWatchdog:
         os._exit(self.EXIT_CODE)  # collectives never return; exit hard
 
 
-def from_env() -> StepWatchdog | None:
-    """BIGDL_TPU_WATCHDOG_S=<seconds> enables the watchdog (the deploy/
-    job specs set it alongside the restart policy). "0", negative, or
-    malformed values DISABLE it with a warning — a config typo must not
-    crash-loop a 16-host job at startup."""
+def timeout_from_env() -> float | None:
+    """The BIGDL_TPU_WATCHDOG_S timeout, or None when unset/disabled.
+    "0", negative, or malformed values DISABLE with a warning — a
+    config typo must not crash-loop a 16-host job at startup. Callers
+    that own their own watchdog (train/supervisor.py) read this instead
+    of from_env() so no throwaway check thread is ever started."""
     v = os.environ.get("BIGDL_TPU_WATCHDOG_S")
     if not v:
         return None
@@ -106,4 +107,11 @@ def from_env() -> StepWatchdog | None:
         print(f"[bigdl-tpu watchdog] BIGDL_TPU_WATCHDOG_S={v!r} is not a "
               "positive number; watchdog disabled", file=sys.stderr)
         return None
-    return StepWatchdog(timeout)
+    return timeout
+
+
+def from_env() -> StepWatchdog | None:
+    """BIGDL_TPU_WATCHDOG_S=<seconds> enables the watchdog (the deploy/
+    job specs set it alongside the restart policy)."""
+    timeout = timeout_from_env()
+    return None if timeout is None else StepWatchdog(timeout)

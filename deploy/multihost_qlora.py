@@ -5,20 +5,38 @@ TPU-native replacement for the reference's MPI launcher+worker pair
 (/root/reference/docker/llm/finetune/lora/cpu/kubernetes/templates/
 ipex-llm-lora-finetuning-job.yaml:7-54 + the oneCCL/ssh bootstrap in its
 entrypoint): every process runs THIS script unchanged; the only
-distributed step is `init_multihost()` (jax.distributed.initialize),
-after which the dp×tp train step is a single jitted SPMD program —
-gradient psums over dp ride DCN once per step, tp psums stay on ICI
-(parallel/multihost.host_aware_mesh).
+distributed step is the coordinator join (retried with backoff —
+parallel/health.init_multihost_with_retry — because the process-0 pod
+routinely comes up after its peers), after which the dp×tp train step
+is a single jitted SPMD program — gradient psums over dp ride DCN once
+per step, tp psums stay on ICI (parallel/multihost.host_aware_mesh).
 
 Data: a .jsonl with {"tokens": [int, ...]} rows (pre-tokenized), or
 {"text": ...} rows if a tokenizer can be loaded from the model dir.
 Every host reads the SAME file and takes its dp-rank's strided rows —
 no shared filesystem coordination beyond the read-only mounts.
 
-Checkpoint/resume: the process-0 host writes the atomic train state
-(train/checkpoint.py) every --save-every steps; on restart (pod
-preemption, maintenance) every host reloads the same state and training
-resumes at the saved step with the saved PRNG key.
+Resilience (train/supervisor.py — the whole loop runs supervised):
+
+- rotating checkpoints `ckpt-<step>.npz` every --save-every steps with
+  keep-last-k retention, and **unconditional auto-resume**: a restarted
+  pod adopts the newest loadable checkpoint (corrupt candidates are
+  skipped, counted, and warned about) and continues bit-exactly. A
+  legacy single-file `train_state.npz` from a pre-supervisor run is
+  adopted once and migrated into the rotation.
+- NaN/inf loss + grad-norm guards and an EMA loss-spike detector:
+  anomalous steps are skipped with the optimizer state untouched (the
+  skip verdict is cross-host reduced, so SPMD state can never fork);
+  K consecutive anomalies roll back to the last good checkpoint.
+- SIGTERM/SIGINT (k8s preemption) takes an emergency checkpoint at the
+  next step boundary and exits 43; the restarted pod resumes.
+- a hung step (wedged DCN collective) exits 42 with a diagnostic
+  (BIGDL_TPU_WATCHDOG_S, set in the k8s job spec).
+
+Exit codes: 0 done · 42 watchdog (hung step) · 43 preempted with
+emergency checkpoint. The job spec's restartPolicy treats 42/43 as
+restart-and-resume. `bigdl-tpu train-status <ckpt-dir>` shows the
+rotation inventory and the supervisor's event log.
 """
 
 from __future__ import annotations
@@ -48,6 +66,12 @@ def parse_args(argv=None):
                    help="tensor-parallel width (must divide one host's "
                         "chip count; dp spans the rest of the pod)")
     p.add_argument("--save-every", type=int, default=100)
+    p.add_argument("--keep-last", type=int, default=3,
+                   help="checkpoint rotation retention")
+    p.add_argument("--spike-factor", type=float, default=10.0,
+                   help="loss > factor x EMA counts as an anomaly")
+    p.add_argument("--max-anomalies", type=int, default=3,
+                   help="consecutive anomalous steps before rollback")
     return p.parse_args(argv)
 
 
@@ -86,9 +110,12 @@ def main(argv=None) -> int:
         # the virtual CPU mesh; TPU VMs leave it unset -> default tpu)
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    from bigdl_tpu.parallel.multihost import host_aware_mesh, init_multihost
+    from bigdl_tpu.parallel.health import init_multihost_with_retry
+    from bigdl_tpu.parallel.multihost import host_aware_mesh
 
-    init_multihost()  # no-op on a single host, auto-joins a pod job
+    # no-op on a single host; on a pod, joins the coordinator under
+    # bounded backoff (the process-0 pod may still be scheduling)
+    init_multihost_with_retry()
 
     import jax.numpy as jnp
     import optax
@@ -99,8 +126,14 @@ def main(argv=None) -> int:
     from bigdl_tpu.parallel.sharding import (
         expand_specs_for_params, lora_specs, param_specs, shard_params,
     )
-    from bigdl_tpu.train import init_lora, make_train_step, watchdog
-    from bigdl_tpu.train.checkpoint import load_train_state, save_train_state
+    from bigdl_tpu.train import init_lora, make_train_step
+    from bigdl_tpu.train.checkpoint import (
+        list_train_checkpoints, load_train_state,
+    )
+    from bigdl_tpu.train.supervisor import (
+        SupervisorConfig, TrainSupervisor,
+    )
+    from bigdl_tpu.train.watchdog import timeout_from_env
 
     pid, nproc = jax.process_index(), jax.process_count()
     n_dev = len(jax.devices())
@@ -133,20 +166,51 @@ def main(argv=None) -> int:
 
     optimizer = optax.adamw(args.lr)
     opt_state = optimizer.init(lora["layers"])
-    step_fn = make_train_step(config, llama.forward, optimizer)
-    step_j = jax.jit(step_fn, donate_argnames=("lora", "opt_state"))
+    step_fn = make_train_step(config, llama.forward, optimizer,
+                              return_grad_norm=True)
+    # NO donation: the supervisor's anomaly-skip path keeps the previous
+    # lora/opt_state alive for one step (adapter state is small — the
+    # price of an untouched optimizer after a NaN)
+    step_j = jax.jit(step_fn)
 
-    rng = jax.random.PRNGKey(42)
-    start_step = 0
-    ckpt_path = os.path.join(args.ckpt_dir, "train_state.npz")
-    if os.path.exists(ckpt_path):
+    from bigdl_tpu.parallel._compat import set_mesh
+
+    def supervised_step(lora_t, opt_t, tokens, mask):
+        with set_mesh(mesh):
+            return step_j(params, lora_t, opt_t, tokens, mask)
+
+    # hung-step detection rides the supervisor's watchdog: a lost peer
+    # blocks every other host inside a collective with no exception —
+    # the per-step host loss fetch is the beat, and silence past
+    # BIGDL_TPU_WATCHDOG_S becomes exit 42 + restart + auto-resume
+    sup = TrainSupervisor(
+        supervised_step,
+        ckpt_dir=args.ckpt_dir,
+        lora=lora, opt_state=opt_state, rng=jax.random.PRNGKey(42),
+        config=SupervisorConfig(
+            save_every=args.save_every or args.steps,
+            keep_last=args.keep_last,
+            spike_factor=args.spike_factor,
+            max_consecutive_anomalies=args.max_anomalies,
+            step_timeout_s=timeout_from_env(),
+        ),
+        is_chief=(pid == 0), process_index=pid,
+    )
+    sup.install_signal_handlers()
+
+    # unconditional auto-resume: newest loadable rotated checkpoint, or
+    # (once) a legacy pre-supervisor train_state.npz — seeded BEFORE
+    # resume() so the baseline save migrates it into the rotation
+    legacy = os.path.join(args.ckpt_dir, "train_state.npz")
+    if not list_train_checkpoints(args.ckpt_dir) and os.path.exists(legacy):
         state = load_train_state(
-            ckpt_path, like_lora=lora, like_opt_state=opt_state
+            legacy, like_lora=lora, like_opt_state=opt_state,
         )
-        lora, opt_state = state["lora"], state["opt_state"]
-        rng, start_step = state["rng"], state["step"]
-        if pid == 0:
-            print(f"[qlora] resumed at step {start_step}", flush=True)
+        sup.lora, sup.opt_state = state["lora"], state["opt_state"]
+        sup.rng, sup.step = state["rng"], state["step"]
+    start_step = sup.resume()
+    if start_step and pid == 0:
+        print(f"[qlora] resumed at step {start_step}", flush=True)
 
     # dp-rank-strided data: host p consumes rows [p*B, (p+1)*B) of each
     # global batch of nproc*B rows, then skips the other hosts' rows —
@@ -163,56 +227,38 @@ def main(argv=None) -> int:
     for _ in range(pid * B):  # stagger host offsets
         next(rows)
 
-    def next_local_batch():
+    data_sharding = NamedSharding(mesh, P("dp", None))
+
+    def batch_fn(step):
+        # a data STREAM (ignores `step`): a rollback replays the model
+        # state exactly but continues on fresh batches, which is the
+        # right call for epoch-looped jsonl data
         batch = [next(rows) for _ in range(B)]
         for _ in range((nproc - 1) * B):  # the other hosts' rows
             next(rows)
-        return np.stack(batch).astype(np.int32)
-
-    data_sharding = NamedSharding(mesh, P("dp", None))
-
-    t0 = time.time()
-    # hung-step detection: a lost peer blocks every other host inside a
-    # collective with no exception; the watchdog converts that into
-    # exit 42 so the job restarts and resumes from the atomic
-    # checkpoint (BIGDL_TPU_WATCHDOG_S, set in the k8s job spec)
-    wd = watchdog.from_env()
-    for step in range(start_step, args.steps):
-        batch = next_local_batch()
+        batch = np.stack(batch).astype(np.int32)
         tokens = jax.make_array_from_process_local_data(
             data_sharding, batch,
             global_shape=(B * nproc, args.seq_len + 1),
         ) if nproc > 1 else jax.device_put(jnp.asarray(batch), data_sharding)
         mask = jnp.ones_like(tokens, jnp.float32)
-        # the QLoRA step is deterministic (no dropout), but the key
-        # advances per step and rides the checkpoint so a resumed run
-        # continues the same stream if a stochastic recipe is swapped in
-        rng, _ = jax.random.split(rng)
-        from bigdl_tpu.parallel._compat import set_mesh
+        return tokens, mask
 
-        with set_mesh(mesh):
-            lora, opt_state, loss = step_j(params, lora, opt_state,
-                                           tokens, mask)
-        if pid == 0 and (step % 10 == 0 or step == args.steps - 1):
+    t0 = time.time()
+
+    def on_step(report):
+        if pid == 0 and report.skipped:
+            print(f"[qlora] step {report.step}: SKIPPED "
+                  f"({','.join(report.reasons)}; loss {report.loss:.4g})",
+                  flush=True)
+        elif pid == 0 and (report.step % 10 == 0
+                           or report.step == args.steps - 1):
             dt = time.time() - t0
-            print(f"[qlora] step {step}: loss {float(loss):.4f} "
+            print(f"[qlora] step {report.step}: loss {report.loss:.4f} "
                   f"({dt:.1f}s)", flush=True)
-        if wd is not None:
-            # beat every step: dispatch is async, but the in-flight
-            # program queue is shallow, so a hung collective stalls the
-            # step call itself within a few iterations; sync only every
-            # 10th beat to keep per-step overhead off the hot path
-            if step % 10 == 0:
-                jax.block_until_ready(loss)
-            wd.beat(step)
-        if pid == 0 and args.save_every and (step + 1) % args.save_every == 0:
-            save_train_state(ckpt_path, lora=lora, opt_state=opt_state,
-                             step=step + 1, rng=rng)
-    if wd is not None:
-        wd.stop()  # the final save below must not race the timeout
+
+    sup.run(batch_fn, args.steps, on_step=on_step)
     if pid == 0:
-        save_train_state(ckpt_path, lora=lora, opt_state=opt_state,
-                         step=args.steps, rng=rng)
         print("[qlora] done", flush=True)
     return 0
 

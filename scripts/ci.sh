@@ -31,7 +31,11 @@ if [[ "${1:-}" == "--core" ]]; then
   echo "   (test_qgemm -m core) +"
   echo "   fault-injection chaos suite (CPU-only; slow storm variants excluded) +"
   echo "   storage-corruption matrix (test_durability: injected bit_flip/"
-  echo "   truncate/torn_rename/drop_file x checkpoint/train/journal)"
+  echo "   truncate/torn_rename/drop_file x checkpoint/train/journal) +"
+  echo "   training-supervisor chaos matrix (test_train_supervisor: nan/spike"
+  echo "   skip parity, rollback, preempt+resume, watchdog, rank-drop) +"
+  echo "   graceful serving drain (SIGTERM: shed new, finish in-flight,"
+  echo "   compact journal)"
   python -m pytest tests/ -q "${XDIST[@]}" -m "core or (chaos and not slow)"
   echo "CORE OK"
   exit 0

@@ -67,3 +67,52 @@ def test_cli_chat_scripted(saved_model, capsys, monkeypatch):
     cli.main(["chat", saved_model, "-n", "6", "-t", "0"])
     out = capsys.readouterr().out
     assert "bot> [" in out
+
+
+@pytest.mark.core
+def test_cli_train_status(tmp_path, capsys):
+    """train-status: rotation inventory with verdicts, last-good step,
+    event-log tail; exit 1 when NO candidate is loadable."""
+    import zipfile
+
+    import jax.numpy as jnp
+    import optax
+
+    from bigdl_tpu.train.checkpoint import save_train_state_rotating
+    from bigdl_tpu.train.supervisor import EventLog
+
+    lora = {"layers": {"w": jnp.zeros((4,), jnp.float32)},
+            "scale": jnp.asarray(1.0, jnp.float32)}
+    opt = optax.sgd(0.1).init(lora["layers"])
+    d = tmp_path / "ckpt"
+    save_train_state_rotating(str(d), step=2, lora=lora, opt_state=opt,
+                              rng=jax.random.PRNGKey(0))
+    newest = save_train_state_rotating(str(d), step=4, lora=lora,
+                                       opt_state=opt,
+                                       rng=jax.random.PRNGKey(0))
+    ev = EventLog(str(d / "supervisor_events.jsonl"))
+    ev.emit("anomaly", 3, reasons=["nan_loss"])
+    ev.emit("checkpoint", 4, ckpt_kind="periodic")
+    ev.close()
+
+    cli.main(["train-status", str(d)])
+    out = capsys.readouterr().out
+    assert "last-good step: 4" in out
+    assert "ckpt-00000004.npz" in out and "ckpt-00000002.npz" in out
+    assert "anomaly" in out and "nan_loss" in out
+
+    # corrupt the newest: last-good falls back to the older step
+    with zipfile.ZipFile(newest) as zf:
+        info = zf.getinfo("leaf_00000.npy")
+    with open(newest, "r+b") as f:
+        f.seek(info.header_offset + 30 + len("leaf_00000.npy") + 16)
+        f.write(b"\xff\x00\xff\x00")
+    cli.main(["train-status", str(d)])
+    out = capsys.readouterr().out
+    assert "last-good step: 2" in out and "CORRUPT" in out
+
+    # an empty dir is not an error; a dir of ONLY corrupt ckpts is
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    cli.main(["train-status", str(empty)])
+    assert "no rotated checkpoints" in capsys.readouterr().out

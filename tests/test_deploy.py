@@ -95,8 +95,14 @@ def test_multihost_qlora_runs_and_resumes(tmp_path):
     r = run(2)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "loss" in r.stdout
-    assert (ckpt / "train_state.npz").exists()
+    # supervised loop (train/supervisor.py): rotating checkpoints +
+    # structured event log instead of the old single train_state.npz
+    assert sorted(p.name for p in ckpt.glob("ckpt-*.npz")) == [
+        "ckpt-00000000.npz", "ckpt-00000002.npz",
+    ]
+    assert (ckpt / "supervisor_events.jsonl").exists()
 
     r2 = run(4)  # resumes at step 2, trains 2 more
     assert r2.returncode == 0, r2.stderr[-2000:]
     assert "resumed at step 2" in r2.stdout
+    assert (ckpt / "ckpt-00000004.npz").exists()

@@ -382,3 +382,77 @@ def test_chaos_sweep_survives_every_fault_class(model, tmp_path):
     tail = eng.submit([5, 6], max_new_tokens=4)
     eng.run_until_idle()
     assert tail.done and not tail.error
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown: drain in-flight work, shed new, compact the journal
+# ---------------------------------------------------------------------------
+
+@pytest.mark.core
+@pytest.mark.chaos
+def test_graceful_drain_finishes_inflight_sheds_new_compacts_journal(
+        model, tmp_path):
+    """SIGTERM semantics at the engine level: begin_drain sheds NEW
+    submits (503-mapped "draining", never journaled) while accepted
+    work runs to completion; close() then flushes + compacts the
+    journal so a clean shutdown leaves NOTHING to replay."""
+    jpath = str(tmp_path / "drain.jsonl")
+    eng = InferenceEngine(model, n_slots=2, max_len=64, journal=jpath)
+    inflight = [eng.submit([2 + i, 7], max_new_tokens=5)
+                for i in range(3)]
+    eng.step()  # some admitted, one still queued
+    assert eng.drain(timeout_s=30.0)
+    late = eng.submit([9, 9], max_new_tokens=3)
+    assert late.done and late.finish_reason == "shed"
+    assert late.shed_kind == "draining"
+    for r in inflight:  # accepted work was never cut short
+        assert r.done and not r.error and len(r.out_tokens) == 5
+    eng.close()
+    eng.close()  # idempotent
+    # compacted to the pending tail — which a clean drain makes empty
+    from bigdl_tpu.serving.journal import RequestJournal
+
+    assert RequestJournal.pending(jpath) == []
+    eng2 = InferenceEngine(model, n_slots=2, max_len=64, journal=jpath)
+    assert eng2.recovered_requests == []
+
+
+@pytest.mark.chaos
+def test_graceful_server_shutdown_drains_via_worker_thread(model, tmp_path):
+    """ApiServer.shutdown(graceful=True): the engine thread finishes
+    in-flight requests before the journal is closed and compacted —
+    a clean SIGTERM relies on replay for nothing."""
+    from bigdl_tpu.serving.api_server import ApiServer
+
+    jpath = str(tmp_path / "server.jsonl")
+    srv = ApiServer(model, port=0, n_slots=2, max_len=64,
+                    journal=jpath).start()
+    try:
+        reqs = [srv.engine.submit([3 + i, 1], max_new_tokens=4)
+                for i in range(3)]
+        assert srv.shutdown(graceful=True) is True
+        assert all(r.done and not r.error for r in reqs)
+        assert srv.engine._journal is None  # closed
+        from bigdl_tpu.serving.journal import RequestJournal
+
+        assert RequestJournal.pending(jpath) == []
+    finally:
+        srv.worker.stop_flag.set()
+        srv.httpd.shutdown()
+
+
+@pytest.mark.chaos
+def test_drain_timeout_leaves_unfinished_tail_for_replay(model, tmp_path):
+    """A drain that cannot finish in its budget gives up WITHOUT losing
+    work: the unfinished requests stay pending in the compacted journal
+    and replay at the next start (the crash path as fallback)."""
+    jpath = str(tmp_path / "stuck.jsonl")
+    inj = FaultInjector(seed=0).arm("slow_step", times=-1, seconds=0.2)
+    eng = InferenceEngine(model, n_slots=1, max_len=64, journal=jpath,
+                          faults=inj)
+    req = eng.submit([3, 1, 4], max_new_tokens=50)
+    assert eng.drain(timeout_s=0.3) is False
+    assert not req.done  # not cut short, just not finished
+    eng.close()
+    eng2 = InferenceEngine(model, n_slots=1, max_len=64, journal=jpath)
+    assert [e.prompt for e in eng2.recovered_requests] == [[3, 1, 4]]
