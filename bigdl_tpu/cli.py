@@ -251,6 +251,8 @@ def cmd_serve(args):
         draft_k=args.draft_k, adaptive_draft=args.adaptive_draft,
         embedder=embedder, truncate_prompts=args.truncate_prompts,
         logprobs_top_k=args.logprobs_top_k,
+        tracing=args.trace, trace_capacity=args.trace_capacity,
+        request_log=args.request_log,
     )
     server.start()
     server.install_signal_handlers()  # SIGTERM -> drain, flush, exit 0
@@ -420,6 +422,76 @@ def cmd_train_status(args):
         raise SystemExit(1)
 
 
+def cmd_trace(args):
+    """Observability toolbox against a live server or a dumped trace
+    (docs/observability.md):
+
+        bigdl-tpu trace dump http://127.0.0.1:8000 -o trace.json
+        bigdl-tpu trace summarize trace.json
+        bigdl-tpu trace profile-start http://127.0.0.1:8000 --logdir /tmp/prof
+        bigdl-tpu trace profile-stop  http://127.0.0.1:8000
+
+    `dump` fetches the server's span ring buffer as Chrome trace-event
+    JSON (loads directly in Perfetto); `summarize` reduces a trace file
+    to a per-phase latency table; `profile-start`/`profile-stop` drive
+    the server's guarded jax.profiler window."""
+    if args.action == "summarize":
+        from bigdl_tpu.obs.tracing import format_summary, summarize_trace
+
+        with open(args.target, encoding="utf-8") as f:
+            trace = json.load(f)
+        print(format_summary(summarize_trace(trace)))
+        return
+    import urllib.error
+    import urllib.request
+
+    base = args.target.rstrip("/")
+
+    def fetch(req_or_path):
+        req = req_or_path if not isinstance(req_or_path, str) \
+            else base + req_or_path
+        path = req if isinstance(req, str) else req.full_url
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            raise SystemExit(f"{path} -> HTTP {e.code}: {body}")
+        except urllib.error.URLError as e:
+            raise SystemExit(f"cannot reach {path}: {e.reason}")
+
+    def post(path, payload):
+        return json.loads(fetch(urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )))
+
+    if args.action == "dump":
+        data = fetch("/debug/trace")
+        try:
+            n = len(json.loads(data).get("traceEvents", []))
+        except json.JSONDecodeError:
+            raise SystemExit(
+                f"{base}/debug/trace returned non-JSON — is this a "
+                "bigdl-tpu server?"
+            )
+        out = args.output
+        with open(out, "wb") as f:
+            f.write(data)
+        print(f"wrote {n} trace events to {out} — open in Perfetto "
+              "(https://ui.perfetto.dev) or chrome://tracing")
+    elif args.action == "profile-start":
+        if not args.logdir:
+            raise SystemExit("profile-start needs --logdir")
+        out = post("/debug/profiler", {"action": "start",
+                                       "logdir": args.logdir})
+        print(f"profiler window open -> {out['logdir']}")
+    elif args.action == "profile-stop":
+        out = post("/debug/profiler", {"action": "stop"})
+        print(f"profiler window closed after {out.get('seconds')}s; "
+              f"inspect {out['logdir']} with TensorBoard/XProf")
+
+
 def cmd_bench(args):
     model = _load(args.model, args.qtype)
     n_in, n_out = args.in_len, args.out_len
@@ -499,6 +571,16 @@ def main(argv=None):
                         "alternatives per token")
     s.add_argument("--paged", action="store_true",
                    help="paged KV pool + prefix caching")
+    s.add_argument("--trace", action="store_true",
+                   help="record request-lifecycle spans into a bounded "
+                        "ring buffer (dump: `bigdl-tpu trace dump`, or "
+                        "GET /debug/trace; docs/observability.md)")
+    s.add_argument("--trace-capacity", type=int, default=65536,
+                   help="span ring-buffer bound (newest kept)")
+    s.add_argument("--request-log", default=None,
+                   help="append one derived-timings JSONL record per "
+                        "finished request (queue wait, TTFT, "
+                        "time-per-output-token, preempted time)")
     s.set_defaults(fn=cmd_serve)
 
     fw = sub.add_parser("fastchat-worker",
@@ -574,6 +656,25 @@ def main(argv=None):
     ts.add_argument("--events", type=int, default=15,
                     help="event-log tail length")
     ts.set_defaults(fn=cmd_train_status)
+
+    tr = sub.add_parser(
+        "trace",
+        help="serving observability: dump a live server's span ring "
+             "buffer (Perfetto-loadable), summarize a trace file into "
+             "a latency table, or start/stop a jax.profiler window",
+    )
+    tr.add_argument("action",
+                    choices=("dump", "summarize", "profile-start",
+                             "profile-stop"))
+    tr.add_argument("target",
+                    help="server base URL (dump/profile-*) or a dumped "
+                         "trace .json file (summarize)")
+    tr.add_argument("-o", "--output", default="trace.json",
+                    help="dump: output file")
+    tr.add_argument("--logdir", default=None,
+                    help="profile-start: jax.profiler output directory "
+                         "on the SERVER's filesystem")
+    tr.set_defaults(fn=cmd_trace)
 
     b = sub.add_parser("bench", help="quick decode-latency check", parents=[qp])
     b.add_argument("model")

@@ -144,9 +144,19 @@ class ApiServer:
         deadline_s: Optional[float] = None,  # default total budget (504)
         preemption: bool = True,  # host-RAM KV swap under page pressure
         faults=None,  # FaultInjector for chaos testing (serving/faults.py)
+        tracing: bool = False,  # request-lifecycle span recording
+        # (obs/tracing.py); the ring always exists so POST /debug/trace
+        # can flip it on a live server — disabled it costs one attribute
+        # check per hook
+        trace_capacity: int = 65536,  # span ring-buffer bound
+        request_log: Optional[str] = None,  # per-request derived-timings
+        # JSONL (crc-suffixed; docs/observability.md)
     ):
+        from bigdl_tpu.obs.tracing import TraceRecorder
         from bigdl_tpu.serving.metrics import Metrics
 
+        self.tracer = TraceRecorder(capacity=trace_capacity,
+                                    enabled=tracing)
         self.engine = InferenceEngine(
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, page_size=page_size, n_pages=n_pages,
@@ -156,6 +166,7 @@ class ApiServer:
             logprobs_top_k=logprobs_top_k, journal=journal,
             max_queue=max_queue, queue_deadline_s=queue_deadline_s,
             deadline_s=deadline_s, preemption=preemption, faults=faults,
+            tracer=self.tracer, request_log=request_log,
         )
         self.request_timeout_s = request_timeout_s
         self._t_start = time.time()
@@ -232,12 +243,57 @@ class ApiServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return None
+                if self.path == "/debug/trace":
+                    # the ring buffer as Chrome trace-event JSON — saved
+                    # to a file it loads directly in Perfetto
+                    # (docs/observability.md; `bigdl-tpu trace dump`)
+                    return self._json(200, outer.tracer.export())
+                if self.path == "/debug/profiler":
+                    from bigdl_tpu.obs.profiler import PROFILER
+
+                    return self._json(200, PROFILER.status())
                 return self._json(404, {"error": "not found"})
+
+            def _debug_trace(self, payload):
+                """POST /debug/trace: toggle span recording / clear the
+                ring on a live server ({"enabled": bool?, "clear":
+                bool?}); responds with the recorder status."""
+                if "enabled" in payload:
+                    outer.tracer.enabled = bool(payload["enabled"])
+                if payload.get("clear"):
+                    outer.tracer.clear()
+                return self._json(200, outer.tracer.status())
+
+            def _debug_profiler(self, payload):
+                """POST /debug/profiler: {"action": "start", "logdir":
+                ...} opens a guarded jax.profiler window; {"action":
+                "stop"} closes it. Busy/idle misuse is 409, never a
+                wedged profiler."""
+                from bigdl_tpu.obs.profiler import (
+                    PROFILER, ProfilerBusy, ProfilerIdle,
+                )
+
+                action = payload.get("action")
+                try:
+                    if action == "start":
+                        logdir = payload.get("logdir")
+                        if not logdir:
+                            return self._json(
+                                400, {"error": "profiler start needs "
+                                      "a logdir"})
+                        return self._json(200, PROFILER.start(logdir))
+                    if action == "stop":
+                        return self._json(200, PROFILER.stop())
+                except (ProfilerBusy, ProfilerIdle) as e:
+                    return self._json(409, {"error": str(e)})
+                return self._json(
+                    400, {"error": f"unknown profiler action "
+                          f"{action!r}; use start|stop"})
 
             _KNOWN_POSTS = {
                 "/generate", "/generate_stream", "/v1/completions",
                 "/v1/chat/completions", "/v1/audio/transcriptions",
-                "/v1/embeddings",
+                "/v1/embeddings", "/debug/trace", "/debug/profiler",
             }
 
             def do_POST(self):
@@ -275,6 +331,10 @@ class ApiServer:
                 is_tgi = "parameters" in payload or (
                     "inputs" in payload and "prompt" not in payload
                 )
+                if self.path == "/debug/trace":
+                    return self._debug_trace(payload)
+                if self.path == "/debug/profiler":
+                    return self._debug_profiler(payload)
                 if self.path == "/v1/embeddings":
                     return self._embeddings(payload)
                 if self.path == "/generate":

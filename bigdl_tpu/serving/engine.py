@@ -83,8 +83,13 @@ class Request:
     queue_deadline_s: Optional[float] = None
     deadline_s: Optional[float] = None
     submit_ts: float = 0.0  # stamped by submit()
-    admit_ts: Optional[float] = None  # first slot admission
+    admit_ts: Optional[float] = None  # first admission (pre-prefill)
     preemptions: int = 0  # times this request was swapped to host RAM
+    # ---- lifecycle timing (obs/tracing.py; engine clock domain) ----
+    first_token_ts: Optional[float] = None
+    last_token_ts: Optional[float] = None
+    preempt_ts: Optional[float] = None  # set while parked in host RAM
+    preempted_s: float = 0.0  # total seconds spent parked (all swaps)
 
 
 @dataclasses.dataclass
@@ -97,6 +102,10 @@ class _Slot:
     # extend AND has emitted nothing since its resume proves the pool
     # cannot support it (self-preempting again would livelock).
     resumed_pos: int = -1
+    # decode-window trace state: tokens since the last emitted "decode"
+    # span and that window's start timestamp (obs/tracing.py)
+    t_win: float = 0.0
+    n_win: int = 0
 
 
 @dataclasses.dataclass
@@ -163,8 +172,31 @@ class InferenceEngine:
         # (least progress lost, default) or "oldest"
         faults: Optional[Any] = None,  # FaultInjector (serving/faults.py);
         # None = the shared inert injector (zero-cost hooks)
+        # ---- observability (docs/observability.md) ----
+        tracer: Optional[Any] = None,  # obs.tracing.TraceRecorder; spans
+        # recorded only while tracer.enabled (off = one attr check)
+        request_log: Optional[str] = None,  # JSONL path: one derived-
+        # timings record per finished request (crc-suffixed lines)
+        trace_decode_every: int = 8,  # decode tokens coalesced per span
+        clock: Callable[[], float] = time.time,  # every lifecycle
+        # timestamp (deadlines, spans, histograms) flows through this —
+        # the simulated-clock benchmark drives the engine with a fake one
     ):
         self.model = model
+        # clock + observability sinks FIRST: submit()/journal replay at
+        # the end of __init__ already stamp timestamps and record finishes
+        self._clock = clock
+        self.tracer = tracer
+        self.trace_decode_every = max(int(trace_decode_every), 1)
+        self._request_log = None
+        if request_log is not None:
+            from bigdl_tpu.obs.tracing import RequestLog
+
+            self._request_log = RequestLog(request_log)
+        self._t_start = clock()
+        # terminal finish_reason -> count (metrics.py renders the family)
+        self.finish_reasons: "collections.defaultdict[str, int]" = \
+            collections.defaultdict(int)
         self._journal = None  # attached at the END of __init__ (it
         # replays the previous process's unfinished tail, which needs
         # the queue and rid counter live)
@@ -277,7 +309,11 @@ class InferenceEngine:
         # the backing deque in place under .mutex
         self._queue: "queue.Queue[Request]" = queue.Queue()
         self._slots = [_Slot() for _ in range(n_slots)]
-        self._rid = itertools.count()
+        # rids start at 1: a request's trace track is tid=rid, and tid 0
+        # is the engine track (decode_step spans, batch counter) — a
+        # rid-0 request would interleave its lifecycle spans with the
+        # engine's and break per-track monotonic nesting
+        self._rid = itertools.count(1)
         # model sharded via TpuModel.to_mesh(): all jitted steps run SPMD
         # under the mesh, with the KV pool sharded over kv heads ('tp')
         self._mesh = getattr(model, "mesh", None)
@@ -472,6 +508,18 @@ class InferenceEngine:
         self.requests_completed = 0
         self.journal_corrupt_lines = 0  # set at journal attach below
         self.queue_wait = Histogram()
+        # phase-latency histograms (docs/observability.md): observed
+        # unconditionally — metrics are always on, tracing is opt-in
+        from bigdl_tpu.serving.metrics import FAST_BUCKETS
+
+        self.ttft = Histogram()  # submit -> first emitted token
+        self.itl = Histogram(buckets=FAST_BUCKETS)  # inter-token gap
+        self.prefill_seconds = Histogram(buckets=FAST_BUCKETS)
+        self.decode_step_seconds = Histogram(buckets=FAST_BUCKETS)
+        # satellite (ISSUE 11): resume requeue time is its OWN family —
+        # folding it into queue_wait would hide preemption stalls inside
+        # the admission-wait signal operators alert on
+        self.resume_wait = Histogram()
         # swap-in programs (swap-OUT is a plain device_get, no jit). The
         # donated cache makes the restore an in-place scatter. Family
         # caches (nested pools / property pos) have no row-swap story:
@@ -856,8 +904,13 @@ class InferenceEngine:
                               else self.queue_deadline_s),
             deadline_s=(deadline_s if deadline_s is not None
                         else self.deadline_s),
-            submit_ts=time.time(),
+            submit_ts=self._clock(),
         )
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.instant("submit", ts=req.submit_ts, tid=req.rid,
+                       cat="request", rid=req.rid,
+                       prompt_tokens=len(req.prompt))
         if req.queue_deadline_s is not None or req.deadline_s is not None:
             self._deadlines_seen = True  # benign handler-thread race: a
             # plain bool store, read by the engine thread next step
@@ -865,6 +918,7 @@ class InferenceEngine:
             req.error = "empty prompt — nothing to generate"
             req.finish_reason = "invalid"
             req.done = True
+            self._note_finish(req, req.submit_ts)
             if stream is not None:
                 stream.put(None)
             return req
@@ -880,6 +934,7 @@ class InferenceEngine:
             )
             req.finish_reason = "invalid"
             req.done = True
+            self._note_finish(req, req.submit_ts)
             if stream is not None:
                 stream.put(None)
             return req
@@ -898,6 +953,7 @@ class InferenceEngine:
             )
             req.finish_reason = "invalid"
             req.done = True
+            self._note_finish(req, req.submit_ts)
             if stream is not None:
                 stream.put(None)
             return req
@@ -1097,6 +1153,9 @@ class InferenceEngine:
                     self._page_ref[src_page] -= 1
                 return False
             fresh.append(pg)
+        # admission is committed from here on (every later path prefills
+        # and activates) — stamp it so queue_wait/queued exclude prefill
+        self._mark_admitted(req)
         if n_hit:
             self.prefix_hits += 1
             for key in (self._prompt_key(prompt[: (i + 1) * page])
@@ -1253,6 +1312,8 @@ class InferenceEngine:
         bytes and the resume restores cur/seen/sampling state untouched."""
         s = self._slots[slot]
         req = s.req
+        now = self._clock()
+        self._flush_decode_window(slot, now)
         if self.paged:
             pos = self._slot_pos[slot]
             n_keep = -(-pos // self.page_size)  # pages holding real KV
@@ -1282,6 +1343,12 @@ class InferenceEngine:
         )
         req.preemptions += 1
         self.preemptions += 1
+        req.preempt_ts = now  # the "preempted" span + resume_wait
+        # histogram close on this stamp at swap-in
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.instant("swap_out", ts=now, tid=req.rid, cat="request",
+                       rid=req.rid, pos=pos, pages=n_keep)
         self._preempted.append(entry)
         # free the slot WITHOUT _finish: the request is alive, just parked
         self._free_slot_state(slot)
@@ -1346,6 +1413,26 @@ class InferenceEngine:
             # row from the full context so acceptance rates stay healthy
             self._admit_draft(slot, req.prompt + req.out_tokens,
                               self.max_len - req.max_new_tokens)
+        now = self._clock()
+        if req.preempt_ts is not None:
+            parked = max(now - req.preempt_ts, 0.0)
+            # satellite (ISSUE 11): the requeue wait of a preempted-and-
+            # resumed request is its own histogram — it was previously
+            # invisible (admit_ts is already set, so queue_wait never
+            # fires again for a resume)
+            self.resume_wait.observe(parked)
+            req.preempted_s += parked
+            tr = self.tracer
+            if tr is not None and tr.enabled:
+                tr.complete("preempted", req.preempt_ts, parked,
+                            tid=req.rid, cat="request", rid=req.rid,
+                            pages=entry.n_pages)
+            req.preempt_ts = None
+        if req.last_token_ts is not None:
+            # rebase the inter-token clock past the parked stretch: the
+            # stall is accounted in resume_wait_seconds, and the next
+            # decode window must open after the "preempted" span closes
+            req.last_token_ts = now
         self.preemption_resumes += 1
         return True
 
@@ -1410,10 +1497,88 @@ class InferenceEngine:
             req.error = error
         req.finish_reason = reason
         req.done = True
+        self._note_finish(req, self._clock())
         if journaled and self._journal is not None:
             self._journal.record_done(req.rid)
         if req.stream is not None:
             req.stream.put(None)
+
+    def _note_finish(self, req: Request, now: float) -> None:
+        """Terminal-state accounting shared by every finish path (slot,
+        detached, submit-time rejection): per-reason counter, trace
+        events, and the derived-timings request-log record. Handler
+        threads reach this via shed/invalid, hence the lock on the
+        counter dict."""
+        reason = req.finish_reason or "?"
+        with self._stat_lock:
+            self.finish_reasons[reason] += 1
+        tr = self.tracer
+        if req.preempt_ts is not None:
+            # died while PARKED (deadline/cancel/fail_all before any
+            # resume): close the preempted stretch here or the record
+            # reports preempted_s=0 for a request that spent its whole
+            # life in host RAM, and the trace dangles a swap_out with
+            # no span. Engine-thread only: handler threads reach
+            # _note_finish solely for never-admitted requests.
+            parked = max(now - req.preempt_ts, 0.0)
+            req.preempted_s += parked
+            if tr is not None and tr.enabled:
+                tr.complete("preempted", req.preempt_ts, parked,
+                            tid=req.rid, cat="request", rid=req.rid,
+                            outcome=reason)
+            req.preempt_ts = None
+        if tr is not None and tr.enabled:
+            if req.admit_ts is None and reason != "invalid":
+                # died waiting (shed / queue timeout / cancelled while
+                # queued): close its queued span so the wait is visible
+                tr.complete("queued", req.submit_ts,
+                            now - req.submit_ts, tid=req.rid,
+                            cat="request", rid=req.rid, outcome=reason)
+            args = {"rid": req.rid, "finish_reason": reason,
+                    "tokens": len(req.out_tokens)}
+            if req.first_token_ts is not None:
+                args["ttft_s"] = round(
+                    req.first_token_ts - req.submit_ts, 6)
+            if req.admit_ts is not None:
+                args["queue_wait_s"] = round(
+                    req.admit_ts - req.submit_ts, 6)
+            if req.preempted_s:
+                args["preempted_s"] = round(req.preempted_s, 6)
+            tr.instant("finish", ts=now, tid=req.rid, cat="request",
+                       **args)
+        if self._request_log is not None:
+            self._request_log.write(self._request_record(req, now))
+
+    def _request_record(self, req: Request, now: float) -> dict:
+        """The structured per-request JSONL record: every timing the
+        TTFT/ITL/queue-wait dashboards derive, attached to one rid."""
+        rec = {
+            "ts": round(now, 6), "rid": req.rid,
+            "finish_reason": req.finish_reason,
+            "prompt_tokens": len(req.prompt),
+            "output_tokens": len(req.out_tokens),
+        }
+        if req.admit_ts is not None:
+            rec["queue_wait_s"] = round(req.admit_ts - req.submit_ts, 6)
+        if req.first_token_ts is not None:
+            rec["ttft_s"] = round(req.first_token_ts - req.submit_ts, 6)
+            n = len(req.out_tokens)
+            if n > 1 and req.last_token_ts is not None:
+                # time-per-output-token over the decode stretch. Parked
+                # time is SUBTRACTED (it is reported separately below) —
+                # first->last spans any host-RAM stretch even though the
+                # ITL clock rebases at resume
+                decoding = max(req.last_token_ts - req.first_token_ts
+                               - req.preempted_s, 0.0)
+                rec["tpot_s"] = round(decoding / (n - 1), 6)
+        if req.preemptions:
+            rec["preemptions"] = req.preemptions
+            rec["preempted_s"] = round(req.preempted_s, 6)
+        if req.shed_kind is not None:
+            rec["shed_kind"] = req.shed_kind
+        if req.error:
+            rec["error"] = req.error
+        return rec
 
     @staticmethod
     def _expired(req: Request, now: float) -> Optional[str]:
@@ -1425,6 +1590,22 @@ class InferenceEngine:
                 and now - req.submit_ts > req.queue_deadline_s):
             return "queue_deadline_s"
         return None
+
+    def _mark_admitted(self, req: Request) -> None:
+        """Stamp the request's (first) admission: the moment it left the
+        queue and prefill work began. queue_wait therefore measures pure
+        waiting — prefill time is its own phase (prefill_seconds and the
+        "prefill" span) — and the "queued" span ends exactly where the
+        prefill span starts."""
+        if req.admit_ts is not None:
+            return
+        req.admit_ts = self._clock()
+        self.queue_wait.observe(req.admit_ts - req.submit_ts)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.complete("queued", req.submit_ts,
+                        req.admit_ts - req.submit_ts, tid=req.rid,
+                        cat="request", rid=req.rid)
 
     def _activate(self, slot: int, req: Request, logits_last) -> None:
         """Shared post-prefill bookkeeping: sample the first token, arm
@@ -1462,9 +1643,6 @@ class InferenceEngine:
             req=req, remaining=req.max_new_tokens - 1, eos=eos,
             seq=next(self._seq),
         )
-        if req.admit_ts is None:
-            req.admit_ts = time.time()
-            self.queue_wait.observe(req.admit_ts - req.submit_ts)
         self._temp[slot], self._topk[slot] = temp, topk
         self._topp[slot], self._dosample[slot] = topp, dosample
         self._penalty[slot] = penalty
@@ -1479,9 +1657,22 @@ class InferenceEngine:
             tv, ti = jax.lax.top_k(row_lp, self.logprobs_top_k)
             first_top = {int(t): float(l)
                          for t, l in zip(np.asarray(ti), np.asarray(tv))}
+        # prefill phase closes HERE (the first-token sample above was a
+        # host sync, so the span covers real work), strictly before the
+        # first emit — the request track stays monotonically nested:
+        # queued | prefill | decode windows ...
+        now = self._clock()
+        if req.admit_ts is not None:
+            self.prefill_seconds.observe(now - req.admit_ts)
+            tr = self.tracer
+            if tr is not None and tr.enabled:
+                tr.complete("prefill", req.admit_ts, now - req.admit_ts,
+                            tid=req.rid, cat="request", rid=req.rid,
+                            prompt_tokens=len(req.prompt))
         self._emit(slot, first, first_lp, first_top)
 
     def _admit_dense(self, req: Request, slot: int) -> None:
+        self._mark_admitted(req)
         # decode writes land at [bucket, bucket + max_new_tokens): keep
         # that window inside the cache, tail-truncating over-long prompts
         limit = self.max_len - req.max_new_tokens
@@ -1538,7 +1729,7 @@ class InferenceEngine:
                 self._cancelled.pop(req.rid, None)
                 self._finish_detached(req, "stop")
                 continue
-            now = time.time()
+            now = self._clock()
             which = self._expired(req, now)
             if which is not None:
                 self._expire_queued(req, which, now)
@@ -1559,6 +1750,34 @@ class InferenceEngine:
             # the EOS id terminates the stream but is not generated text
             self._finish(slot, "stop")
             return
+        req = s.req
+        now = self._clock()
+        prev = req.last_token_ts
+        if req.first_token_ts is None:
+            req.first_token_ts = now
+            self.ttft.observe(now - req.submit_ts)
+            prev = now
+        else:
+            # wall-clock gap between consecutive emits as a streaming
+            # client sees them (a speculative burst yields ~0 gaps —
+            # that IS the client experience). Parked time is excluded:
+            # resume rebases last_token_ts, and the stall is accounted
+            # in resume_wait_seconds instead.
+            self.itl.observe(now - prev)
+        req.last_token_ts = now
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            # coalesce decode into one span per trace_decode_every
+            # tokens; each window opens where the previous span closed,
+            # keeping the request track monotonically nested
+            if s.n_win == 0:
+                s.t_win = prev
+            s.n_win += 1
+            if s.n_win >= self.trace_decode_every:
+                tr.complete("decode", s.t_win, now - s.t_win,
+                            tid=req.rid, cat="request", rid=req.rid,
+                            tokens=s.n_win)
+                s.n_win = 0
         s.req.out_tokens.append(token)
         if logprob is not None:
             s.req.out_logprobs.append(logprob)
@@ -1569,16 +1788,34 @@ class InferenceEngine:
         if s.remaining <= 0:
             self._finish(slot, "length")
 
+    def _flush_decode_window(self, slot: int, now: float) -> None:
+        """Emit the slot's partial decode-window span (finish/preempt
+        must not drop the tail tokens' span)."""
+        s = self._slots[slot]
+        tr = self.tracer
+        if (tr is not None and tr.enabled and s.n_win > 0
+                and s.req is not None):
+            tr.complete("decode", s.t_win, now - s.t_win, tid=s.req.rid,
+                        cat="request", rid=s.req.rid, tokens=s.n_win)
+        s.n_win = 0
+
     def _finish(self, slot: int, reason: str = "stop",
                 counted: bool = True) -> None:
         s = self._slots[slot]
+        now = self._clock()
+        self._flush_decode_window(slot, now)
         s.req.finish_reason = reason
         s.req.done = True
         # before the injected crash point: a crash inside _finish leaves
         # the request terminal (fail_all preserves it), so its in-flight
-        # charge must already be released
+        # charge must already be released. Same for the finish
+        # accounting below: the request IS terminal either way, and a
+        # replayed request counts again in the successor process (the
+        # request log is at-least-once across the crash window, like
+        # the journal).
         with self._stat_lock:
             self._inflight -= 1
+        self._note_finish(s.req, now)
         if counted and reason in ("stop", "length"):
             # genuine completions only: cancelled/timed-out requests also
             # land here as "stop" but must not inflate the throughput
@@ -1707,7 +1944,7 @@ class InferenceEngine:
         indefinitely behind it. Engine-thread only, like _preempted."""
         if not self._preempted:
             return
-        now = time.time()
+        now = self._clock()
         keep: "collections.deque[_Preempted]" = collections.deque()
         for entry in self._preempted:
             req = entry.req
@@ -1740,7 +1977,7 @@ class InferenceEngine:
         eventually frees."""
         if not self._deadlines_seen and not self._cancelled:
             return
-        now = time.time()
+        now = self._clock()
         # the paged OOM-retry slot waits like a queue entry and gets the
         # same dead-work treatment — _admit can return early (blocked
         # preemption resume) for many steps without ever popping it
@@ -1786,7 +2023,7 @@ class InferenceEngine:
     def _reap_deadlines(self) -> None:
         """Kill in-flight requests past their total wall-clock budget:
         partial output is delivered, finish_reason records 'timeout'."""
-        now = time.time()
+        now = self._clock()
         for i, s in enumerate(self._slots):
             if s.req is None or s.req.deadline_s is None:
                 continue
@@ -1837,6 +2074,7 @@ class InferenceEngine:
         self._rng, k = jax.random.split(self._rng)
         if self.speculative:
             return self._step_speculative(k)
+        t0 = self._clock()
         try:
             nxt, lps, top, self.cache, self.seen = self._decode(
                 self.model.params, self.cur, self.cache, k,
@@ -1856,6 +2094,9 @@ class InferenceEngine:
         tops_h = None
         if top is not None:
             tops_h = (np.asarray(top[0]), np.asarray(top[1]))
+        # the np.asarray fetches above are the host sync: the step's
+        # device work is really done here, so the duration is honest
+        self._note_decode_step(t0)
         for i in np.nonzero(self.active)[0]:
             i = int(i)
             s = self._slots[i]
@@ -1880,6 +2121,22 @@ class InferenceEngine:
             self._emit(i, int(toks[i]), float(lps_h[i]), alt)
         return True
 
+    def _note_decode_step(self, t0: float) -> None:
+        """Per-step phase accounting: duration histogram + the engine
+        track's span/occupancy counter (tid 0 — batch-level, not
+        per-request)."""
+        t1 = self._clock()
+        self.decode_step_seconds.observe(t1 - t0)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            busy = int(self.active.sum())
+            tr.complete("decode_step", t0, t1 - t0, tid=0, cat="engine",
+                        occupancy=busy, slots=self.n_slots,
+                        queue_depth=self._queue.qsize())
+            tr.counter("batch", ts=t1, occupancy=busy,
+                       queued=self._queue.qsize(),
+                       preempted=len(self._preempted))
+
     def _step_speculative(self, k) -> bool:
         """Draft-K-then-verify round: each live slot emits 1..draft_k
         tokens (its accepted prefix + the target's bonus token)."""
@@ -1887,6 +2144,7 @@ class InferenceEngine:
             fn = self._spec_exec[self._cur_k]
         else:
             fn = functools.partial(self._spec_decode, self._cur_k)
+        t0 = self._clock()
         try:
             (choice, lp_all, n_acc, cur2, self.cache, self.dcache,
              self.seen) = fn(
@@ -1904,6 +2162,7 @@ class InferenceEngine:
         choice_h = np.asarray(choice)
         lp_h = self._inject_nan(np.asarray(lp_all))
         n_acc_h = np.asarray(n_acc)
+        self._note_decode_step(t0)
         self.spec_rounds += 1
         if self.adaptive_draft:
             self._adapt_draft_k(n_acc_h[self.active])
@@ -2031,12 +2290,14 @@ class InferenceEngine:
         return True
 
     def close(self) -> None:
-        """Flush, COMPACT, and detach the journal. Call only after the
-        stepping thread has stopped: compaction os.replace()s the file
-        under any live append handle. After a clean drain the rewrite
-        holds zero entries — the next start replays nothing; after a
-        timed-out drain it holds exactly the unfinished tail.
-        Idempotent."""
+        """Flush, COMPACT, and detach the journal (and close the
+        request log). Call only after the stepping thread has stopped:
+        compaction os.replace()s the file under any live append handle.
+        After a clean drain the rewrite holds zero entries — the next
+        start replays nothing; after a timed-out drain it holds exactly
+        the unfinished tail. Idempotent."""
+        if self._request_log is not None:
+            self._request_log.close()
         if self._journal is None:
             return
         from bigdl_tpu.serving.journal import RequestJournal
@@ -2045,3 +2306,30 @@ class InferenceEngine:
         self._journal.close()
         self._journal = None
         RequestJournal.compact(path)
+
+    # ---- observability helpers (serving/metrics.py renders these) ----------
+
+    def uptime_seconds(self) -> float:
+        """Engine age in its own clock domain (simulated clocks report
+        simulated uptime — by design)."""
+        return max(self._clock() - self._t_start, 0.0)
+
+    def kv_utilization(self) -> float:
+        """Fraction of the KV pool holding live state: allocated pages
+        over the allocatable pool (paged; page 0 is scratch), or written
+        positions over total row capacity (dense). Family caches without
+        a standard pos vector report 0 rather than guessing."""
+        if self.paged:
+            cap = self.n_pages - 1
+            return (cap - len(self._free_pages)) / max(cap, 1)
+        # HOST-side estimate only: reading cache.pos here would race the
+        # decode jit's cache donation (the buffers are deleted for most
+        # of every step, and /metrics scrapes from a handler thread).
+        # Active slots' written content ≈ prompt + emitted tokens; freed
+        # slots count zero (their stale device pos is a ghost).
+        used = 0
+        for i, s in enumerate(self._slots):
+            if s.req is not None and self.active[i]:
+                used += min(len(s.req.prompt) + len(s.req.out_tokens),
+                            self.max_len)
+        return used / max(self.n_slots * self.max_len, 1)

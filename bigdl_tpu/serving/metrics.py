@@ -21,6 +21,16 @@ _BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 _STEP_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                  120.0, 300.0, 600.0)
 
+# per-token / per-step phase latencies live in milliseconds: the request
+# buckets would flatten every inter-token-latency distribution into the
+# bottom bucket (docs/observability.md)
+FAST_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0)
+
+# finish reasons ALWAYS rendered (zero-valued series keep dashboards and
+# the drift check stable); reasons outside this set render as seen
+FINISH_REASONS = ("stop", "length", "error", "shed", "timeout", "invalid")
+
 
 def _verify_failures() -> int:
     """Process-wide checkpoint verification failure count (lazy import:
@@ -128,6 +138,33 @@ def render_train_series() -> list:
     return lines
 
 
+def render_build_info() -> list:
+    """`bigdl_tpu_build_info` gauge: constant 1 with the build identity
+    as labels — the standard Prometheus idiom for joining every other
+    series against a version during a rollout."""
+    from bigdl_tpu import __version__
+
+    try:
+        import jax
+
+        jaxv = jax.__version__
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        jaxv = "unknown"
+    try:
+        from bigdl_tpu.convert.low_bit import FORMAT_VERSION
+
+        fmt = str(FORMAT_VERSION)
+    except Exception:  # pragma: no cover - convert stack unavailable
+        fmt = "unknown"
+    return [
+        "# HELP bigdl_tpu_build_info build identity (constant 1; "
+        "version labels)",
+        "# TYPE bigdl_tpu_build_info gauge",
+        f'bigdl_tpu_build_info{{version="{__version__}",'
+        f'jax_version="{jaxv}",format_version="{fmt}"}} 1',
+    ]
+
+
 class Metrics:
     def __init__(self, engine=None):
         self._lock = threading.Lock()
@@ -184,6 +221,7 @@ class Metrics:
                 f"bigdl_tpu_checkpoint_verify_failures_total "
                 f"{_verify_failures()}",
             ]
+            lines += render_build_info()
             lines += render_train_series()
             lines += [
                 "# HELP bigdl_tpu_request_seconds request latency",
@@ -239,7 +277,63 @@ class Metrics:
             ]
             lines += self.engine.queue_wait.render(
                 "bigdl_tpu_queue_wait_seconds",
-                "submit-to-first-admission wait",
+                "submit-to-first-admission wait (prefill excluded)",
+            )
+            # ---- request-lifecycle latency + utilization families
+            # (docs/observability.md; ISSUE 11) ----
+            lines += [
+                "# HELP bigdl_tpu_uptime_seconds engine age (its own "
+                "clock domain)",
+                "# TYPE bigdl_tpu_uptime_seconds gauge",
+                f"bigdl_tpu_uptime_seconds "
+                f"{self.engine.uptime_seconds():.3f}",
+                "# HELP bigdl_tpu_batch_occupancy fraction of decode "
+                "slots in use",
+                "# TYPE bigdl_tpu_batch_occupancy gauge",
+                f"bigdl_tpu_batch_occupancy "
+                f"{busy / max(self.engine.n_slots, 1):.4f}",
+                "# HELP bigdl_tpu_kv_pool_utilization fraction of the "
+                "KV pool holding live state",
+                "# TYPE bigdl_tpu_kv_pool_utilization gauge",
+                f"bigdl_tpu_kv_pool_utilization "
+                f"{self.engine.kv_utilization():.4f}",
+                "# HELP bigdl_tpu_requests_finished_total requests "
+                "reaching a terminal state, by finish_reason",
+                "# TYPE bigdl_tpu_requests_finished_total counter",
+            ]
+            # snapshot under the writers' lock (handler threads insert
+            # first-seen reasons concurrently via _note_finish)
+            with self.engine._stat_lock:
+                fr = dict(self.engine.finish_reasons)
+            for reason in FINISH_REASONS + tuple(
+                sorted(set(fr) - set(FINISH_REASONS))
+            ):
+                lines.append(
+                    f'bigdl_tpu_requests_finished_total'
+                    f'{{reason="{reason}"}} {fr.get(reason, 0)}'
+                )
+            lines += self.engine.ttft.render(
+                "bigdl_tpu_ttft_seconds",
+                "time to first token (submit to first emit)",
+            )
+            lines += self.engine.itl.render(
+                "bigdl_tpu_inter_token_seconds",
+                "gap between consecutive emitted tokens (parked time "
+                "excluded — see resume_wait)",
+            )
+            lines += self.engine.prefill_seconds.render(
+                "bigdl_tpu_prefill_seconds",
+                "prefill phase per admission (admission to first-token "
+                "sample)",
+            )
+            lines += self.engine.decode_step_seconds.render(
+                "bigdl_tpu_decode_step_seconds",
+                "batched decode step wall-clock (host-sync honest)",
+            )
+            lines += self.engine.resume_wait.render(
+                "bigdl_tpu_resume_wait_seconds",
+                "preempted requests' host-RAM parked time until resume "
+                "(not folded into queue_wait)",
             )
             if self.engine.paged:
                 lines += [
@@ -276,3 +370,84 @@ class Metrics:
                     f"bigdl_tpu_spec_draft_k {self.engine._cur_k}",
                 ]
         return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# exposition-drift registry: the authoritative list of metric families a
+# render must contain. scripts/ci.sh --core fails when render() and this
+# registry disagree in EITHER direction — a family can neither silently
+# vanish from /metrics nor ship unregistered (docs/observability.md).
+# ---------------------------------------------------------------------------
+
+_PROCESS_FAMILIES = (
+    "bigdl_tpu_requests_total",
+    "bigdl_tpu_tokens_generated_total",
+    "bigdl_tpu_requests_failed_total",
+    "bigdl_tpu_checkpoint_verify_failures_total",
+    "bigdl_tpu_build_info",
+    "bigdl_tpu_train_anomalies_total",
+    "bigdl_tpu_train_steps_skipped_total",
+    "bigdl_tpu_train_rollbacks_total",
+    "bigdl_tpu_train_emergency_checkpoints_total",
+    "bigdl_tpu_train_watchdog_aborts_total",
+    "bigdl_tpu_train_step_seconds",
+    "bigdl_tpu_request_seconds",
+)
+
+_ENGINE_FAMILIES = (
+    "bigdl_tpu_busy_slots",
+    "bigdl_tpu_total_slots",
+    "bigdl_tpu_queue_depth",
+    "bigdl_tpu_preemptions_total",
+    "bigdl_tpu_preemption_resumes_total",
+    "bigdl_tpu_requests_shed_total",
+    "bigdl_tpu_request_timeouts_total",
+    "bigdl_tpu_preempted_waiting",
+    "bigdl_tpu_journal_corrupt_lines_total",
+    "bigdl_tpu_queue_wait_seconds",
+    "bigdl_tpu_uptime_seconds",
+    "bigdl_tpu_batch_occupancy",
+    "bigdl_tpu_kv_pool_utilization",
+    "bigdl_tpu_requests_finished_total",
+    "bigdl_tpu_ttft_seconds",
+    "bigdl_tpu_inter_token_seconds",
+    "bigdl_tpu_prefill_seconds",
+    "bigdl_tpu_decode_step_seconds",
+    "bigdl_tpu_resume_wait_seconds",
+)
+
+_PAGED_FAMILIES = (
+    "bigdl_tpu_free_pages",
+    "bigdl_tpu_prefix_hits_total",
+    "bigdl_tpu_prefix_partial_hits_total",
+    "bigdl_tpu_prefix_tokens_reused_total",
+)
+
+_SPEC_FAMILIES = (
+    "bigdl_tpu_spec_rounds_total",
+    "bigdl_tpu_spec_emitted_total",
+    "bigdl_tpu_spec_draft_k",
+)
+
+
+def expected_families(engine=None) -> list:
+    """Every metric family a `Metrics(engine).render()` must expose."""
+    names = list(_PROCESS_FAMILIES)
+    if engine is not None:
+        names += _ENGINE_FAMILIES
+        if getattr(engine, "paged", False):
+            names += _PAGED_FAMILIES
+        if getattr(engine, "speculative", False):
+            names += _SPEC_FAMILIES
+    return names
+
+
+def metric_drift(rendered: str, engine=None) -> tuple:
+    """(missing, unregistered): families the registry expects but the
+    exposition lacks, and families rendered but absent from the
+    registry. Both empty = no drift."""
+    import re
+
+    got = set(re.findall(r"^# TYPE (\S+) \S+", rendered, flags=re.M))
+    want = set(expected_families(engine))
+    return sorted(want - got), sorted(got - want)

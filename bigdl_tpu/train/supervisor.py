@@ -148,10 +148,16 @@ class EventLog:
     (serving/journal.crc_line — interior rot in a months-old log is
     detectable, and the two formats cannot drift). Losing events must
     never kill training: every write failure degrades to a
-    warning-free no-op."""
+    warning-free no-op.
 
-    def __init__(self, path: Optional[str]):
+    An optional `tracer` (obs/tracing.TraceRecorder) mirrors every
+    event as an instant on the trainer track — the same recorder and
+    trace format the serving engine uses, so a training run and a
+    serving run open identically in Perfetto (docs/observability.md)."""
+
+    def __init__(self, path: Optional[str], tracer: Optional[Any] = None):
         self.path = path
+        self.tracer = tracer
         self._f = None
         if path is not None:
             try:
@@ -162,13 +168,20 @@ class EventLog:
                 self._f = None
 
     def emit(self, kind: str, step: int, **detail: Any) -> None:
+        ts = round(time.time(), 3)
+        if self.tracer is not None and self.tracer.enabled:
+            # the mirrored instant is stamped in the TRACER's clock
+            # domain (the log line keeps wall time for operators): a
+            # simulated-clock tracer must not get wall-epoch instants
+            # billions of seconds away from its train.step spans
+            self.tracer.instant(kind, ts=self.tracer.now(), tid=0,
+                                cat="train", step=int(step), **detail)
         if self._f is None:
             return
         from bigdl_tpu.serving.journal import crc_line
 
         body = json.dumps(
-            {"ts": round(time.time(), 3), "step": int(step), "kind": kind,
-             **detail},
+            {"ts": ts, "step": int(step), "kind": kind, **detail},
             separators=(",", ":"),
         )
         try:
@@ -244,6 +257,9 @@ class TrainSupervisor:
         health=None,  # parallel/health.HealthMonitor (default-built)
         on_watchdog_timeout: Optional[Callable] = None,  # tests
         exit_fn: Optional[Callable] = None,  # tests: replace sys.exit
+        tracer=None,  # obs/tracing.TraceRecorder: per-step "train.step"
+        # spans + every EventLog event mirrored as trace instants, in
+        # the serving engine's exact trace format
     ):
         from bigdl_tpu.parallel.health import HealthMonitor
 
@@ -282,7 +298,9 @@ class TrainSupervisor:
         if not is_chief:
             root, ext = os.path.splitext(name)
             name = f"{root}.r{process_index}{ext or '.jsonl'}"
-        self.events = EventLog(os.path.join(ckpt_dir, name))
+        self.tracer = tracer
+        self.events = EventLog(os.path.join(ckpt_dir, name),
+                               tracer=tracer)
         self._wd: Optional[StepWatchdog] = None
         if self.config.step_timeout_s is not None:
             self._wd = StepWatchdog(
@@ -385,6 +403,8 @@ class TrainSupervisor:
         clean run minus exactly the skipped updates."""
         step = self.step
         t0 = time.monotonic()
+        tracing = self.tracer is not None and self.tracer.enabled
+        tw0 = self.tracer.now() if tracing else 0.0
         f = self._faults.fire("hang_step")
         if f is not None:
             # a wedged collective never returns; the injected stall is
@@ -436,6 +456,14 @@ class TrainSupervisor:
             if self.is_chief and self.step % self.config.save_every == 0:
                 self._save(kind="periodic")
             report = StepReport(step, loss_h, gnorm_h, False, (), dt)
+        if tracing:
+            # the same span vocabulary as serving's decode_step: one
+            # engine-track complete span per step, anomalies visible as
+            # skipped=True plus the EventLog-mirrored "anomaly" instant
+            self.tracer.complete(
+                "train.step", tw0, dt, tid=0, cat="train", step=step,
+                loss=report.loss, skipped=report.skipped,
+            )
         if (self.config.heartbeat_every
                 and self.step % self.config.heartbeat_every == 0):
             self._heartbeat(self.step)

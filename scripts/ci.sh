@@ -35,8 +35,17 @@ if [[ "${1:-}" == "--core" ]]; then
   echo "   training-supervisor chaos matrix (test_train_supervisor: nan/spike"
   echo "   skip parity, rollback, preempt+resume, watchdog, rank-drop) +"
   echo "   graceful serving drain (SIGTERM: shed new, finish in-flight,"
-  echo "   compact journal)"
+  echo "   compact journal) +"
+  echo "   observability layer (test_obs: trace-export golden + span"
+  echo "   nesting, TTFT/ITL under injected slow_step, tracing-off"
+  echo "   overhead guard, profiler-window guards, metrics drift)"
   python -m pytest tests/ -q "${XDIST[@]}" -m "core or (chaos and not slow)"
+  echo "== metrics exposition drift gate (registry <-> /metrics, both ways)"
+  python -c "
+from bigdl_tpu.serving.metrics import Metrics, metric_drift
+missing, unregistered = metric_drift(Metrics().render(), None)
+assert not missing and not unregistered, (missing, unregistered)
+print('metrics drift: clean')"
   echo "CORE OK"
   exit 0
 fi
