@@ -22,8 +22,6 @@ transformers/model.py:111):
 
 __version__ = "0.1.0"
 
-from bigdl_tpu.quant import QTensor, quantize, dequantize, qtype_registry
-
 __all__ = [
     "QTensor",
     "quantize",
@@ -35,20 +33,25 @@ __all__ = [
     "__version__",
 ]
 
+# every public name -> providing submodule; ALL resolved lazily (PEP 562).
+# `import bigdl_tpu` must stay jax-free: the quant exports drag jax in,
+# and jax-free importability is a hard contract of `bigdl-tpu lint` /
+# scripts/ci.sh --lint (the gate asserts jax never enters sys.modules).
+_LAZY = {
+    "QTensor": "bigdl_tpu.quant",
+    "quantize": "bigdl_tpu.quant",
+    "dequantize": "bigdl_tpu.quant",
+    "qtype_registry": "bigdl_tpu.quant",
+    "AutoModelForCausalLM": "bigdl_tpu.api",
+    "optimize_model": "bigdl_tpu.api",
+    "ChatSession": "bigdl_tpu.chat",
+}
+
 
 def __getattr__(name):
-    # Lazy imports keep `import bigdl_tpu` light (no transformers/safetensors
-    # unless the HF ingest path is actually used).
-    if name == "AutoModelForCausalLM":
-        from bigdl_tpu.api import AutoModelForCausalLM
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'bigdl_tpu' has no attribute {name!r}")
+    import importlib
 
-        return AutoModelForCausalLM
-    if name == "optimize_model":
-        from bigdl_tpu.api import optimize_model
-
-        return optimize_model
-    if name == "ChatSession":
-        from bigdl_tpu.chat import ChatSession
-
-        return ChatSession
-    raise AttributeError(f"module 'bigdl_tpu' has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,25 @@
+"""graftlint — AST-based invariant checks for the bigdl_tpu codebase.
+
+Entry points: ``bigdl-tpu lint`` (cli.py), ``scripts/ci.sh --lint``,
+and programmatically::
+
+    from bigdl_tpu.analysis import run
+    rc = run()          # 0 clean, 1 findings, 2 config error
+
+IMPORTANT: this package (and everything it imports) must never import
+jax — the lint gate runs on any machine in seconds and ci.sh --lint
+asserts jax stayed out of sys.modules. See docs/static-analysis.md.
+"""
+
+from bigdl_tpu.analysis.core import (  # noqa: F401
+    DEFAULT_BASELINE,
+    Check,
+    FileContext,
+    Finding,
+    apply_baseline,
+    lint_paths,
+    lint_text,
+    load_baseline,
+    run,
+    write_baseline,
+)

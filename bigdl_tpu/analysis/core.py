@@ -1,0 +1,364 @@
+"""graftlint core: a small AST-walking lint framework that machine-
+enforces this codebase's cross-cutting invariants.
+
+PRs 6-11 each earned a convention — every engine timestamp flows
+through an injectable ``clock=`` (PR 11), every on-disk artifact
+commits via ``durability.atomic_write`` (PR 7), every fault point is
+declared in its injector's ``points`` registry (PR 6/7/10),
+``_stat_lock``-guarded engine state is never read bare (PR 11's
+scrape-500 race), metric families cannot drift from
+``expected_families`` (PR 11), journal/event-log lines carry a crc
+suffix (PR 7/10) — but until this module each was enforced only by
+reviewer memory. The INT4 composability study (arxiv 2301.12017) shows
+the failure mode precisely: individually-correct changes composing
+into silent breakage. graftlint turns the conventions into CI-gated,
+file:line-reported checks (docs/static-analysis.md).
+
+Design constraints:
+
+- **No jax import, ever.** The lint gate runs per-PR on any machine in
+  seconds; ``scripts/ci.sh --lint`` asserts ``jax`` never entered
+  ``sys.modules``. Checks therefore work purely on ``ast`` trees and
+  source text.
+- **One parse per file.** Every check receives the same
+  :class:`FileContext`; a full-tree run stays well under the 10 s
+  budget.
+- **Suppressable, with receipts.** An inline
+  ``# graftlint: disable=RULE`` on the offending line (or the line
+  above it) silences a finding at the site, visible in review. The
+  checked-in baseline (``bigdl_tpu/analysis/baseline.json``) grandfathers
+  accepted findings — each entry carries a one-line justification —
+  so new violations fail CI while the baseline shrinks over time.
+
+Checks live in :mod:`bigdl_tpu.analysis.checks`; the CLI entry is
+``bigdl-tpu lint`` (cli.py) and the CI gate is ``scripts/ci.sh --lint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Optional, Sequence
+
+#: inline suppression: ``# graftlint: disable=WCT001`` or
+#: ``disable=WCT001,ATW001`` or ``disable=all`` — honored on the
+#: finding's own line and on the line immediately above it.
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,]+)")
+
+#: default scan root: the installed bigdl_tpu package directory
+PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: default baseline location (ships with the package, checked in)
+DEFAULT_BASELINE = os.path.join(
+    PACKAGE_DIR, "analysis", "baseline.json"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation, anchored to a file:line.
+
+    ``code`` (the stripped source line) is the line-number-insensitive
+    fingerprint component: baseline entries match on
+    ``(rule, path, code)`` so unrelated edits shifting line numbers
+    don't invalidate the baseline."""
+
+    rule: str
+    path: str  # scan-root-relative, '/'-separated (e.g. bigdl_tpu/serving/engine.py)
+    line: int
+    message: str
+    hint: str = ""
+    severity: str = "error"
+    code: str = ""
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.code)
+
+    def format(self) -> str:
+        s = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            s += f"\n    fix: {self.hint}"
+        return s
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a check needs about one file — parsed exactly once."""
+
+    path: str  # absolute
+    rel: str  # scan-root-relative, '/'-separated
+    src: str
+    lines: list  # src.splitlines()
+    tree: ast.Module
+    root: str  # absolute scan root (the bigdl_tpu package's parent)
+
+
+class Check:
+    """Protocol for a rule: subclass, set ``rule``/``description``,
+    implement :meth:`run` yielding findings (``line``/``message`` set;
+    the runner fills ``code`` and applies suppressions/baseline)."""
+
+    rule: str = "XXX000"
+    description: str = ""
+
+    def run(self, ctx: FileContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the checks
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``time.time`` / ``datetime.datetime.now`` / ``open`` for a Call's
+    func expression; None when the callee isn't a plain dotted name."""
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def docstring_nodes(tree: ast.Module) -> set:
+    """id()s of every docstring Constant (module/class/function) so
+    string-scanning checks can skip prose."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                out.add(id(body[0].value))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline
+# ---------------------------------------------------------------------------
+
+def _suppressed_rules(line_text: str) -> set:
+    m = _SUPPRESS_RE.search(line_text)
+    if not m:
+        return set()
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def is_suppressed(f: Finding, lines: Sequence[str]) -> bool:
+    """Inline suppression on the finding's line or the line above."""
+    for ln in (f.line, f.line - 1):
+        if 1 <= ln <= len(lines):
+            rules = _suppressed_rules(lines[ln - 1])
+            if "all" in rules or f.rule in rules:
+                return True
+    return False
+
+
+def load_baseline(path: str) -> list:
+    """Baseline entries: ``{rule, path, code, justification}`` dicts.
+    A missing file is an empty baseline (the desired end state)."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("findings", data) if isinstance(data, dict) else data
+    for e in entries:
+        if not e.get("justification"):
+            raise ValueError(
+                f"baseline entry {e.get('rule')}:{e.get('path')} lacks a "
+                "justification — every grandfathered finding must say why"
+            )
+    return list(entries)
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Sequence[dict]
+                   ) -> tuple:
+    """(new, grandfathered): findings not covered by the baseline, and
+    the ones it absorbs. Matching is on (rule, path, code) — immune to
+    line-number drift, invalidated the moment the offending line's text
+    changes."""
+    keys = {(e["rule"], e["path"], e["code"]) for e in baseline}
+    new, old = [], []
+    for f in findings:
+        (old if f.key() in keys else new).append(f)
+    return new, old
+
+
+def write_baseline(findings: Sequence[Finding], path: str,
+                   justification: str = "TODO: justify or fix",
+                   previous: Sequence[dict] = ()) -> None:
+    """Serialize current findings as the new baseline, carrying over
+    the justification of any entry that survives from `previous`.
+    Deliberately NOT atomic-write: this is a dev-workstation
+    convenience writing a file that git tracks, not a runtime
+    artifact."""
+    carried = {(e["rule"], e["path"], e["code"]): e.get("justification")
+               for e in previous}
+    entries = [
+        {"rule": f.rule, "path": f.path, "code": f.code,
+         "line": f.line,
+         "justification": carried.get(f.key()) or justification}
+        for f in findings
+    ]
+    with open(path, "w", encoding="utf-8") as fh:  # graftlint: disable=ATW001
+        json.dump({"findings": entries}, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def default_checks() -> list:
+    from bigdl_tpu.analysis.checks import ALL_CHECKS
+
+    return [c() for c in ALL_CHECKS]
+
+
+def iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d != "__pycache__" and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _rel(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    if not rel.startswith(".."):
+        return rel
+    # an explicit path argument outside the scan root: anchor at the
+    # deepest bigdl_tpu component so the path-scoped rules (WCT001,
+    # FLT001) still see "bigdl_tpu/serving/..." instead of "../.."
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    if "bigdl_tpu" in parts:
+        i = len(parts) - 1 - parts[::-1].index("bigdl_tpu")
+        return "/".join(parts[i:])
+    return rel
+
+
+def lint_text(src: str, rel: str, root: Optional[str] = None,
+              checks: Optional[Sequence[Check]] = None) -> list:
+    """Lint one in-memory source blob as if it lived at ``rel`` under
+    ``root`` — the fixture-test entry point. Suppressions apply;
+    baseline does not."""
+    root = root or os.path.dirname(PACKAGE_DIR)
+    checks = list(checks) if checks is not None else default_checks()
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("PARSE", rel, e.lineno or 1,
+                        f"syntax error: {e.msg}", severity="error")]
+    ctx = FileContext(path=os.path.join(root, rel), rel=rel, src=src,
+                      lines=lines, tree=tree, root=root)
+    out = []
+    for chk in checks:
+        for f in chk.run(ctx):
+            if not f.code and 1 <= f.line <= len(lines):
+                f.code = lines[f.line - 1].strip()
+            if not is_suppressed(f, lines):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def lint_paths(paths: Optional[Sequence[str]] = None,
+               root: Optional[str] = None,
+               checks: Optional[Sequence[Check]] = None) -> list:
+    """Lint files/directories (default: the whole bigdl_tpu package).
+    Returns all unsuppressed findings; baseline filtering is the
+    caller's second step (see :func:`apply_baseline`)."""
+    root = os.path.abspath(root) if root else os.path.dirname(PACKAGE_DIR)
+    checks = list(checks) if checks is not None else default_checks()
+    targets: list = []
+    if not paths:
+        targets = list(iter_py_files(PACKAGE_DIR))
+    else:
+        for p in paths:
+            p = os.path.abspath(p)
+            if os.path.isdir(p):
+                targets.extend(iter_py_files(p))
+            else:
+                targets.append(p)
+    findings: list = []
+    for path in targets:
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            findings.append(Finding("IO", _rel(path, root), 1, str(e)))
+            continue
+        findings.extend(lint_text(src, _rel(path, root), root=root,
+                                  checks=checks))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# CLI body (bigdl-tpu lint delegates here; returns the exit code)
+# ---------------------------------------------------------------------------
+
+def run(paths: Optional[Sequence[str]] = None,
+        baseline_path: Optional[str] = None,
+        rules: Optional[Sequence[str]] = None,
+        write_baseline_path: Optional[str] = None,
+        out=None) -> int:
+    """Full lint run: scan, subtract baseline, print, exit code.
+    0 = clean; 1 = non-baselined findings; 2 = usage/config error."""
+    import sys
+
+    out = out or sys.stdout
+    if write_baseline_path and (paths or rules):
+        # a filtered scan sees only a slice of the findings; writing it
+        # as THE baseline would silently drop every grandfathered entry
+        # outside the slice, and the next full run would fail on them
+        print("graftlint: --write-baseline requires a full, unfiltered "
+              "scan (no paths, no --rules)", file=out)
+        return 2
+    checks = default_checks()
+    if rules:
+        want = {r.strip().upper() for r in rules}
+        known = {c.rule for c in checks}
+        unknown = want - known
+        if unknown:
+            print(f"graftlint: unknown rule(s) {sorted(unknown)}; "
+                  f"known: {sorted(known)}", file=out)
+            return 2
+        checks = [c for c in checks if c.rule in want]
+    findings = lint_paths(paths, checks=checks)
+    bl_path = baseline_path or DEFAULT_BASELINE
+    try:
+        baseline = load_baseline(bl_path)
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"graftlint: bad baseline {bl_path}: {e}", file=out)
+        return 2
+    new, grandfathered = apply_baseline(findings, baseline)
+    if write_baseline_path:
+        write_baseline(findings, write_baseline_path, previous=baseline)
+        print(f"graftlint: wrote {len(findings)} finding(s) to "
+              f"{write_baseline_path}", file=out)
+        return 0
+    for f in new:
+        print(f.format(), file=out)
+    tail = (f"graftlint: {len(new)} finding(s)"
+            + (f" ({len(grandfathered)} baselined)" if grandfathered else "")
+            + f" across {len({f.path for f in new}) if new else 0} file(s)")
+    print(tail, file=out)
+    return 1 if new else 0

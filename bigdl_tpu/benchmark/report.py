@@ -94,8 +94,11 @@ def csv_to_html(
     if prev_csv:
         rows = diff_rows(rows, read_csv(prev_csv))
     doc = to_html(rows, title=csv_path, highlight_threshold=highlight_threshold)
-    with open(out_path, "w") as f:
-        f.write(doc)
+    # atomic commit (utils/durability, graftlint ATW001): a kill
+    # mid-render must leave the previous report intact, not a torn file
+    from bigdl_tpu.utils.durability import atomic_write
+
+    atomic_write(out_path, lambda f: f.write(doc.encode("utf-8")))
     return out_path
 
 

@@ -476,8 +476,9 @@ def cmd_trace(args):
                 "bigdl-tpu server?"
             )
         out = args.output
-        with open(out, "wb") as f:
-            f.write(data)
+        from bigdl_tpu.utils.durability import atomic_write
+
+        atomic_write(out, lambda f: f.write(data))
         print(f"wrote {n} trace events to {out} — open in Perfetto "
               "(https://ui.perfetto.dev) or chrome://tracing")
     elif args.action == "profile-start":
@@ -490,6 +491,34 @@ def cmd_trace(args):
         out = post("/debug/profiler", {"action": "stop"})
         print(f"profiler window closed after {out.get('seconds')}s; "
               f"inspect {out['logdir']} with TensorBoard/XProf")
+
+
+def cmd_lint(args):
+    """graftlint: the AST-based invariant gate (docs/static-analysis.md).
+
+        bigdl-tpu lint                     # whole bigdl_tpu package
+        bigdl-tpu lint bigdl_tpu/serving   # a subtree / single file
+        bigdl-tpu lint --rules WCT001,ATW001
+        bigdl-tpu lint --write-baseline    # grandfather current findings
+
+    Exit 0 = clean, 1 = non-baselined findings, 2 = config error.
+    Deliberately jax-free: scripts/ci.sh --lint asserts jax never
+    entered sys.modules during a run."""
+    from bigdl_tpu.analysis import core as lint_core
+
+    if args.list_rules:
+        for c in lint_core.default_checks():
+            print(f"{c.rule}  {c.description}")
+        raise SystemExit(0)
+    write_to = None
+    if args.write_baseline:
+        write_to = args.baseline or lint_core.DEFAULT_BASELINE
+    raise SystemExit(lint_core.run(
+        paths=args.paths or None,
+        baseline_path=args.baseline,
+        rules=args.rules.split(",") if args.rules else None,
+        write_baseline_path=write_to,
+    ))
 
 
 def cmd_bench(args):
@@ -675,6 +704,27 @@ def main(argv=None):
                     help="profile-start: jax.profiler output directory "
                          "on the SERVER's filesystem")
     tr.set_defaults(fn=cmd_trace)
+
+    ln = sub.add_parser(
+        "lint",
+        help="graftlint: AST invariant checks over bigdl_tpu/ (clock "
+             "injection, atomic writes, fault points, lock discipline, "
+             "metrics drift, donation, journal crc; exit 1 on any "
+             "non-baselined finding — docs/static-analysis.md)",
+    )
+    ln.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the installed "
+                         "bigdl_tpu package)")
+    ln.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: the checked-in "
+                         "bigdl_tpu/analysis/baseline.json)")
+    ln.add_argument("--rules", default=None,
+                    help="comma-separated rule subset, e.g. WCT001,ATW001")
+    ln.add_argument("--write-baseline", action="store_true",
+                    help="record current findings as the new baseline "
+                         "(each entry then needs a justification edit)")
+    ln.add_argument("--list-rules", action="store_true")
+    ln.set_defaults(fn=cmd_lint)
 
     b = sub.add_parser("bench", help="quick decode-latency check", parents=[qp])
     b.add_argument("model")

@@ -37,10 +37,12 @@ class ProfilerWindow:
     in); tests inject stubs."""
 
     def __init__(self, start_fn: Optional[Callable] = None,
-                 stop_fn: Optional[Callable] = None):
+                 stop_fn: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.time):
         self._lock = threading.Lock()
         self._start_fn = start_fn
         self._stop_fn = stop_fn
+        self._clock = clock  # window-age timestamps (WCT001: injectable)
         self.logdir: Optional[str] = None
         self.started_at: Optional[float] = None
 
@@ -63,7 +65,7 @@ class ProfilerWindow:
             start, _ = self._fns()
             start(logdir)  # raises before any state flips on failure
             self.logdir = logdir
-            self.started_at = time.time()
+            self.started_at = self._clock()
             return self.status()
 
     def stop(self) -> dict:
@@ -80,12 +82,12 @@ class ProfilerWindow:
                 self.logdir = None
                 self.started_at = None
             return {"active": False, "logdir": logdir,
-                    "seconds": round(time.time() - (t0 or 0.0), 3)}
+                    "seconds": round(self._clock() - (t0 or 0.0), 3)}
 
     def status(self) -> dict:
         out = {"active": self.logdir is not None, "logdir": self.logdir}
         if self.started_at is not None:
-            out["seconds"] = round(time.time() - self.started_at, 3)
+            out["seconds"] = round(self._clock() - self.started_at, 3)
         return out
 
 

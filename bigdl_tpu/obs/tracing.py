@@ -173,15 +173,20 @@ class TraceRecorder:
         """The Chrome trace-event object (``{"traceEvents": [...]}``),
         optionally written to ``path`` — the file loads as-is in
         Perfetto / ``chrome://tracing``. Non-finite arg values (NaN
-        losses, ...) are exported as null to keep the JSON standard."""
+        losses, ...) are exported as null to keep the JSON standard.
+        The file commits through the atomic tmp+fsync+rename protocol:
+        a SIGTERM mid-dump must leave either the previous export or the
+        complete new one, never a torn, Perfetto-unloadable JSON."""
         obj = {"traceEvents": [self._sanitize_args(e)
                                for e in self.events()],
                "displayTimeUnit": "ms",
                "otherData": {"dropped_events": self.dropped}}
         if path is not None:
-            with open(path, "w", encoding="utf-8") as f:
-                json.dump(obj, f, separators=(",", ":"),
-                          allow_nan=False)
+            from bigdl_tpu.utils.durability import atomic_write
+
+            data = json.dumps(obj, separators=(",", ":"),
+                              allow_nan=False).encode("utf-8")
+            atomic_write(path, lambda f: f.write(data))
         return obj
 
 

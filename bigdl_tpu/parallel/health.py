@@ -138,6 +138,9 @@ class HealthMonitor:
         max_step_lag: Optional[int] = None,
         allgather: Optional[Callable] = None,
         faults=None,
+        clock: Callable[[], float] = time.time,  # heartbeat timestamps
+        # (injectable so multi-host health tests run under the simulated
+        # clock like everything else; graftlint WCT001)
     ):
         import jax
 
@@ -148,11 +151,12 @@ class HealthMonitor:
         self.max_step_lag = max_step_lag
         self._allgather = allgather or _default_allgather
         self._faults = faults
+        self._clock = clock
 
     def snapshot(self, step: int) -> list:
         """One heartbeat round -> [RankStatus] actually heard from."""
         row = np.asarray(
-            [float(self.process_index), float(step), time.time()],
+            [float(self.process_index), float(step), self._clock()],
             np.float64,
         )
         gathered = np.atleast_2d(np.asarray(self._allgather(row)))

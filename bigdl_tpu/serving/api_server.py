@@ -30,7 +30,7 @@ import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from bigdl_tpu.serving.engine import InferenceEngine
 
@@ -151,12 +151,19 @@ class ApiServer:
         trace_capacity: int = 65536,  # span ring-buffer bound
         request_log: Optional[str] = None,  # per-request derived-timings
         # JSONL (crc-suffixed; docs/observability.md)
+        clock: Callable[[], float] = time.time,  # every server-side
+        # timestamp (uptime, `created`, Retry-After rate, wait/stream/
+        # drain deadlines) AND the engine + tracer it constructs flow
+        # through this one injectable clock, so the simulated-clock
+        # benchmark can drive the whole API layer (docs/observability.md;
+        # graftlint WCT001 enforces no bare wall-clock calls here)
     ):
         from bigdl_tpu.obs.tracing import TraceRecorder
         from bigdl_tpu.serving.metrics import Metrics
 
+        self._clock = clock
         self.tracer = TraceRecorder(capacity=trace_capacity,
-                                    enabled=tracing)
+                                    enabled=tracing, clock=clock)
         self.engine = InferenceEngine(
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, page_size=page_size, n_pages=n_pages,
@@ -166,10 +173,10 @@ class ApiServer:
             logprobs_top_k=logprobs_top_k, journal=journal,
             max_queue=max_queue, queue_deadline_s=queue_deadline_s,
             deadline_s=deadline_s, preemption=preemption, faults=faults,
-            tracer=self.tracer, request_log=request_log,
+            tracer=self.tracer, request_log=request_log, clock=clock,
         )
         self.request_timeout_s = request_timeout_s
-        self._t_start = time.time()
+        self._t_start = clock()
         self.tokenizer = tokenizer
         self.whisper = whisper
         self.whisper_tokenizer = whisper_tokenizer
@@ -657,7 +664,7 @@ class ApiServer:
                 return self._json(200, {
                     "id": f"cmpl-{uuid.uuid4().hex[:12]}",
                     "object": "text_completion",
-                    "created": int(time.time()),
+                    "created": int(outer._clock()),
                     "model": payload.get("model", "bigdl-tpu"),
                     "choices": [choice],
                     "usage": {
@@ -707,7 +714,7 @@ class ApiServer:
                 return self._json(200, {
                     "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
                     "object": "chat.completion",
-                    "created": int(time.time()),
+                    "created": int(outer._clock()),
                     "model": payload.get("model", "bigdl-tpu"),
                     "choices": [{
                         "index": 0,
@@ -760,7 +767,7 @@ class ApiServer:
         rate goes stale across idle stretches, so the advice is capped:
         a shed client should re-probe within minutes regardless."""
         eng = self.engine
-        rate = eng.requests_completed / max(time.time() - self._t_start,
+        rate = eng.requests_completed / max(self._clock() - self._t_start,
                                             1e-6)
         if rate <= 0:
             return 30
@@ -771,12 +778,22 @@ class ApiServer:
         """Yield tokens until the None sentinel. A stall past the timeout
         (dead engine, injected stuck step) ends the stream AND cancels
         the request in the engine — a stalled client stream must not keep
-        burning a decode slot."""
+        burning a decode slot.
+
+        The blocking q.get tick stays real time (a queue cannot sleep on
+        a simulated clock), but the stall *verdict* — has `timeout`
+        elapsed since the last token — is measured on the injected
+        clock, so the simulated-clock benchmark drives stream deadlines
+        exactly like every other deadline."""
         timeout = self.request_timeout_s if timeout is None else timeout
+        tick = min(timeout, 0.05)
+        last = self._clock()
         while True:
             try:
-                tok = q.get(timeout=timeout)
+                tok = q.get(timeout=tick)
             except queue.Empty:
+                if self._clock() - last < timeout:
+                    continue
                 if req is not None and not req.done:
                     self.engine.cancel(req)
                     # re-check AFTER the cancel, mirroring _wait: a
@@ -797,6 +814,7 @@ class ApiServer:
                 return
             if tok is None:
                 return
+            last = self._clock()
             self.metrics.count_tokens(1)
             yield tok
 
@@ -807,8 +825,8 @@ class ApiServer:
         done/'stop' must not turn a timeout into a 200 with silently
         truncated output."""
         timeout = self.request_timeout_s if timeout is None else timeout
-        t0 = time.time()
-        while not req.done and time.time() - t0 < timeout:
+        t0 = self._clock()
+        while not req.done and self._clock() - t0 < timeout:
             time.sleep(0.005)
         if not req.done:
             # engine-cancelling timeout: before this, a timed-out
@@ -848,9 +866,9 @@ class ApiServer:
             self.engine.begin_drain()
             timeout = (self.request_timeout_s if drain_timeout_s is None
                        else drain_timeout_s)
-            deadline = time.monotonic() + timeout
+            deadline = self._clock() + timeout
             while not self.engine.idle():
-                if time.monotonic() > deadline:
+                if self._clock() > deadline:
                     drained = False
                     break
                 time.sleep(0.01)

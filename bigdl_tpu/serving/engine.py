@@ -194,7 +194,10 @@ class InferenceEngine:
 
             self._request_log = RequestLog(request_log)
         self._t_start = clock()
-        # terminal finish_reason -> count (metrics.py renders the family)
+        # terminal finish_reason -> count (metrics.py renders the family;
+        # handler threads insert via _note_finish, the scrape thread
+        # snapshots under the same lock)
+        # guarded-by: _stat_lock
         self.finish_reasons: "collections.defaultdict[str, int]" = \
             collections.defaultdict(int)
         self._journal = None  # attached at the END of __init__ (it
@@ -478,7 +481,7 @@ class InferenceEngine:
         # parked) is NOT a substitute — a request mid-admission sits in
         # none of those containers for a moment, and a drain poll in
         # that window would declare an idle engine with work in hand.
-        self._inflight = 0
+        self._inflight = 0  # guarded-by: _stat_lock
         # True while fail_all tears down after an (injected) crash:
         # crash points must not re-fire inside the cleanup's _finish
         # calls or the cleanup itself dies and the engine thread hangs
@@ -503,8 +506,8 @@ class InferenceEngine:
         # observability (serving/metrics.py renders these)
         self.preemptions = 0
         self.preemption_resumes = 0
-        self.requests_shed = 0
-        self.request_timeouts = 0
+        self.requests_shed = 0  # guarded-by: _stat_lock
+        self.request_timeouts = 0  # guarded-by: _stat_lock
         self.requests_completed = 0
         self.journal_corrupt_lines = 0  # set at journal attach below
         self.queue_wait = Histogram()
@@ -2282,9 +2285,9 @@ class InferenceEngine:
         fallback, not the plan)."""
         self.begin_drain()
         deadline = (None if timeout_s is None
-                    else time.monotonic() + timeout_s)
+                    else self._clock() + timeout_s)
         while not self.idle():
-            if deadline is not None and time.monotonic() > deadline:
+            if deadline is not None and self._clock() > deadline:
                 return False
             self.step()
         return True

@@ -155,9 +155,14 @@ class EventLog:
     trace format the serving engine uses, so a training run and a
     serving run open identically in Perfetto (docs/observability.md)."""
 
-    def __init__(self, path: Optional[str], tracer: Optional[Any] = None):
+    def __init__(self, path: Optional[str], tracer: Optional[Any] = None,
+                 clock: Callable[[], float] = time.time):
         self.path = path
         self.tracer = tracer
+        # the log line's ts is OPERATOR time (wall by default, injectable
+        # for simulated runs; graftlint WCT001) — the mirrored trace
+        # instant below stays in the tracer's own clock domain
+        self._clock = clock
         self._f = None
         if path is not None:
             try:
@@ -168,7 +173,7 @@ class EventLog:
                 self._f = None
 
     def emit(self, kind: str, step: int, **detail: Any) -> None:
-        ts = round(time.time(), 3)
+        ts = round(self._clock(), 3)
         if self.tracer is not None and self.tracer.enabled:
             # the mirrored instant is stamped in the TRACER's clock
             # domain (the log line keeps wall time for operators): a
@@ -260,6 +265,12 @@ class TrainSupervisor:
         tracer=None,  # obs/tracing.TraceRecorder: per-step "train.step"
         # spans + every EventLog event mirrored as trace instants, in
         # the serving engine's exact trace format
+        clock: Callable[[], float] = time.monotonic,  # step-duration
+        # timing (watchdog beats, TRAIN_STEP_SECONDS); injectable like
+        # the serving engine's clock= (graftlint WCT001)
+        wall_clock: Callable[[], float] = time.time,  # epoch-domain ts
+        # for the EventLog lines (durations and epochs are different
+        # clock domains — a simulated run injects both)
     ):
         from bigdl_tpu.parallel.health import HealthMonitor
 
@@ -285,6 +296,7 @@ class TrainSupervisor:
             process_index=process_index, faults=self._faults,
         )
         self._exit = exit_fn or sys.exit
+        self._clock = clock
         self._on_watchdog_timeout = on_watchdog_timeout
         self._ema: Optional[float] = None
         self._applied_steps = 0       # spike-guard warmup counter
@@ -300,7 +312,7 @@ class TrainSupervisor:
             name = f"{root}.r{process_index}{ext or '.jsonl'}"
         self.tracer = tracer
         self.events = EventLog(os.path.join(ckpt_dir, name),
-                               tracer=tracer)
+                               tracer=tracer, clock=wall_clock)
         self._wd: Optional[StepWatchdog] = None
         if self.config.step_timeout_s is not None:
             self._wd = StepWatchdog(
@@ -402,7 +414,7 @@ class TrainSupervisor:
         skipped step consumes its batch, so a run with skips equals a
         clean run minus exactly the skipped updates."""
         step = self.step
-        t0 = time.monotonic()
+        t0 = self._clock()
         tracing = self.tracer is not None and self.tracer.enabled
         tw0 = self.tracer.now() if tracing else 0.0
         f = self._faults.fire("hang_step")
@@ -429,7 +441,7 @@ class TrainSupervisor:
             self._wd.beat(step)
         loss_h, gnorm_h = self._inject_anomalies(loss_h, gnorm_h)
         reasons = self._anomaly_reasons(loss_h, gnorm_h)
-        dt = time.monotonic() - t0
+        dt = self._clock() - t0
         TRAIN_STEP_SECONDS.observe(dt)
         anomaly, preempt = self._consensus(
             bool(reasons), self._preempt_flag.is_set())

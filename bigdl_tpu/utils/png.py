@@ -25,8 +25,15 @@ def write_png(path: str, image: np.ndarray) -> None:
     # each scanline prefixed with filter byte 0 (None)
     raw = b"".join(b"\x00" + img[y].tobytes() for y in range(h))
     ihdr = struct.pack(">IIBBBBB", w, h, 8, 2, 0, 0, 0)  # 8-bit RGB
-    with open(path, "wb") as f:
+
+    def _write(f):
         f.write(b"\x89PNG\r\n\x1a\n")
         f.write(_chunk(b"IHDR", ihdr))
         f.write(_chunk(b"IDAT", zlib.compress(raw, 6)))
         f.write(_chunk(b"IEND", b""))
+
+    # atomic commit (utils/durability, graftlint ATW001): a killed
+    # txt2img run must not leave a truncated, viewer-rejected PNG
+    from bigdl_tpu.utils.durability import atomic_write
+
+    atomic_write(path, _write)

@@ -23,7 +23,26 @@ else
   echo "note: pytest-xdist not installed; running single-process"
 fi
 
+run_lint() {
+  echo "== graftlint: AST invariant gate (docs/static-analysis.md;"
+  echo "   pure-CPU, < 10 s, asserts jax never imports)"
+  python - <<'PY'
+import sys
+from bigdl_tpu.analysis import run
+rc = run()
+assert "jax" not in sys.modules, "graftlint must never import jax"
+sys.exit(rc)
+PY
+}
+
+if [[ "${1:-}" == "--lint" ]]; then
+  run_lint
+  echo "LINT OK"
+  exit 0
+fi
+
 if [[ "${1:-}" == "--core" ]]; then
+  run_lint
   echo "== core gate (< 5 min): quant/native/model/engine basics +"
   echo "   fused-GEMV kernel parity for every qtype (test_pallas -m core) +"
   echo "   tiled dequant-GEMM dispatch coverage + parity matrix straddling"
@@ -49,6 +68,8 @@ print('metrics drift: clean')"
   echo "CORE OK"
   exit 0
 fi
+
+run_lint
 
 echo "== unit + distributed tests (8-device CPU mesh)"
 python -m pytest tests/ -q "${XDIST[@]}"

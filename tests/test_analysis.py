@@ -1,0 +1,478 @@
+"""graftlint (bigdl_tpu/analysis): fixture snippets per rule —
+positive, suppressed, baseline-filtered — plus the real-tree gate and
+the regression guard that the clock/atomic sites fixed in this PR stay
+clean. Deliberately jax-free (the lint contract) and fast."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from bigdl_tpu.analysis import core as lc
+from bigdl_tpu.analysis import checks as lck
+
+pytestmark = pytest.mark.core
+
+REPO = os.path.dirname(lc.PACKAGE_DIR)
+
+
+def lint(src: str, rel: str, rule=None):
+    out = lc.lint_text(textwrap.dedent(src), rel)
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# WCT001 — wall-clock ban
+# ---------------------------------------------------------------------------
+
+def test_wct001_fires_on_call_in_scope():
+    fs = lint("""
+        import time
+
+        def f():
+            return time.time()
+    """, "bigdl_tpu/serving/foo.py", "WCT001")
+    assert len(fs) == 1
+    assert "time.time" in fs[0].message
+    assert fs[0].line == 5
+
+
+def test_wct001_default_arg_reference_is_allowed():
+    # referencing the wall clock as a default *implementation* is the
+    # documented escape hatch; only calls are banned
+    fs = lint("""
+        import time
+
+        def f(clock=time.time):
+            return clock()
+    """, "bigdl_tpu/obs/foo.py", "WCT001")
+    assert fs == []
+
+
+def test_wct001_from_import_alias_is_caught():
+    fs = lint("""
+        from time import monotonic as mono
+
+        def f():
+            return mono()
+    """, "bigdl_tpu/serving/foo.py", "WCT001")
+    assert len(fs) == 1
+    fs = lint("""
+        from datetime import datetime as dt
+
+        def f():
+            return dt.now()
+    """, "bigdl_tpu/serving/foo.py", "WCT001")
+    assert len(fs) == 1
+
+
+def test_wct001_out_of_scope_file_ignored():
+    fs = lint("import time\nx = time.time()\n",
+              "bigdl_tpu/convert/foo.py", "WCT001")
+    assert fs == []
+
+
+def test_wct001_inline_suppression():
+    fs = lint("""
+        import time
+        t = time.monotonic()  # graftlint: disable=WCT001
+    """, "bigdl_tpu/serving/foo.py", "WCT001")
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# ATW001 — non-atomic writes
+# ---------------------------------------------------------------------------
+
+def test_atw001_fires_on_write_mode():
+    for mode in ("w", "wb", "w+"):
+        fs = lint(f"f = open(p, {mode!r})\n", "bigdl_tpu/x.py", "ATW001")
+        assert len(fs) == 1, mode
+
+
+def test_atw001_read_and_append_are_fine():
+    src = "a = open(p)\nb = open(p, 'rb')\nc = open(p, 'a')\n"
+    assert lint(src, "bigdl_tpu/x.py", "ATW001") == []
+
+
+def test_atw001_durability_is_the_exempt_protocol():
+    src = "f = open(p, 'wb')\n"
+    assert lint(src, "bigdl_tpu/utils/durability.py", "ATW001") == []
+    assert len(lint(src, "bigdl_tpu/utils/other.py", "ATW001")) == 1
+
+
+# ---------------------------------------------------------------------------
+# FLT001 — fault-point validity (registries parsed from the real tree)
+# ---------------------------------------------------------------------------
+
+def test_flt001_declared_point_ok_undeclared_fires():
+    ok = lint("x = self._faults.fire('alloc_page')\n",
+              "bigdl_tpu/serving/foo.py", "FLT001")
+    assert ok == []
+    bad = lint("x = self._faults.fire('totally_bogus')\n",
+               "bigdl_tpu/serving/foo.py", "FLT001")
+    assert len(bad) == 1
+    assert "totally_bogus" in bad[0].message
+
+
+def test_flt001_scoped_per_registry():
+    # rank_drop is a *train* point: valid in train/, a typo in serving/
+    src = "inj.arm('rank_drop')\n"
+    assert lint(src, "bigdl_tpu/train/foo.py", "FLT001") == []
+    assert len(lint(src, "bigdl_tpu/serving/foo.py", "FLT001")) == 1
+
+
+def test_flt001_dynamic_point_string_is_skipped():
+    assert lint("inj.fire(point)\n",
+                "bigdl_tpu/serving/foo.py", "FLT001") == []
+
+
+# ---------------------------------------------------------------------------
+# LCK001 — lock discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS = """
+    import threading
+
+    class Eng:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0  # guarded-by: _lock
+
+        def good(self):
+            with self._lock:
+                self.count += 1
+
+        def bad(self):
+            return self.count
+"""
+
+
+def test_lck001_fires_outside_with_block():
+    fs = lint(_LOCKED_CLASS, "bigdl_tpu/serving/foo.py", "LCK001")
+    assert len(fs) == 1
+    assert "self.count" in fs[0].message
+    assert "bad" not in fs[0].hint  # message names the attr, not the fn
+    assert fs[0].line == _LOCKED_CLASS.splitlines().index(
+        "            return self.count") + 1
+
+
+def test_lck001_constructor_is_exempt():
+    fs = lint("""
+        class Eng:
+            def __init__(self):
+                self.n = 0  # guarded-by: _lock
+                self.n += 1
+    """, "bigdl_tpu/serving/foo.py", "LCK001")
+    assert fs == []
+
+
+def test_lck001_comment_above_form_and_no_leak_to_next_line():
+    fs = lint("""
+        class Eng:
+            def __init__(self):
+                # guarded-by: _lock
+                self.a = 0
+                self.b = 0
+
+            def f(self):
+                return self.b  # unguarded attr: fine
+
+            def g(self):
+                return self.a  # violation
+    """, "bigdl_tpu/serving/foo.py", "LCK001")
+    assert len(fs) == 1 and "self.a" in fs[0].message
+
+
+def test_lck001_nested_function_holds_nothing():
+    # a closure defined under the lock may run after release
+    fs = lint("""
+        class Eng:
+            def __init__(self):
+                self.n = 0  # guarded-by: _lock
+
+            def f(self):
+                with self._lock:
+                    def cb():
+                        return self.n
+                    return cb
+    """, "bigdl_tpu/serving/foo.py", "LCK001")
+    assert len(fs) == 1
+
+
+# ---------------------------------------------------------------------------
+# MET001 — static metrics drift
+# ---------------------------------------------------------------------------
+
+def test_met001_real_metrics_module_is_reconciled():
+    path = os.path.join(lc.PACKAGE_DIR, "serving", "metrics.py")
+    with open(path, encoding="utf-8") as f:
+        fs = lint(f.read(), "bigdl_tpu/serving/metrics.py", "MET001")
+    assert fs == [], [f.format() for f in fs]
+
+
+def test_met001_synthetic_two_way_drift():
+    fs = lint("""
+        _PROCESS_FAMILIES = ("bigdl_tpu_registered_only_total",)
+
+        def render():
+            return "# TYPE bigdl_tpu_rendered_only_total counter"
+    """, "bigdl_tpu/serving/metrics.py", "MET001")
+    msgs = " | ".join(f.message for f in fs)
+    assert len(fs) == 2
+    assert "bigdl_tpu_rendered_only_total" in msgs  # unregistered
+    assert "bigdl_tpu_registered_only_total" in msgs  # never rendered
+
+
+def test_met001_only_applies_to_metrics_py():
+    fs = lint('x = "# TYPE bigdl_tpu_whatever_total counter"\n',
+              "bigdl_tpu/serving/other.py", "MET001")
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# DON001 — donation hazard
+# ---------------------------------------------------------------------------
+
+def test_don001_read_after_donation_fires():
+    fs = lint("""
+        import jax
+
+        def f(step, x):
+            g = jax.jit(step, donate_argnums=(0,))
+            y = g(x)
+            return x + y
+    """, "bigdl_tpu/ops/foo.py", "DON001")
+    assert len(fs) == 1
+    assert "'x'" in fs[0].message
+
+
+def test_don001_rebind_over_donated_name_is_clean():
+    fs = lint("""
+        import jax
+
+        def f(step, x):
+            g = jax.jit(step, donate_argnums=(0,))
+            x = g(x)
+            return x
+    """, "bigdl_tpu/ops/foo.py", "DON001")
+    assert fs == []
+
+
+def test_don001_donate_argnames_keyword_call():
+    fs = lint("""
+        import jax
+
+        def f(step, cache, tok):
+            g = jax.jit(step, donate_argnames=("cache",))
+            out = g(tok, cache=cache)
+            return cache.pos
+    """, "bigdl_tpu/ops/foo.py", "DON001")
+    assert len(fs) == 1 and "'cache'" in fs[0].message
+
+
+def test_don001_nested_function_scope_is_separate():
+    # a nested def's same-named parameter is a different variable; it
+    # must neither fire nor mask (review finding)
+    fs = lint("""
+        import jax
+
+        def f(step, x):
+            g = jax.jit(step, donate_argnums=(0,))
+            y = g(x)
+
+            def h(x):
+                return x + 1
+
+            return y
+    """, "bigdl_tpu/ops/foo.py", "DON001")
+    assert fs == []
+    # ...and a Store inside a nested def must not mask an outer read
+    fs = lint("""
+        import jax
+
+        def f(step, x):
+            g = jax.jit(step, donate_argnums=(0,))
+            y = g(x)
+
+            def h():
+                x = 0
+                return x
+
+            return x + y
+    """, "bigdl_tpu/ops/foo.py", "DON001")
+    assert len(fs) == 1
+
+
+def test_don001_non_donating_jit_ignored():
+    fs = lint("""
+        import jax
+
+        def f(step, x):
+            g = jax.jit(step)
+            y = g(x)
+            return x + y
+    """, "bigdl_tpu/ops/foo.py", "DON001")
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# CRC001 — journal-line discipline
+# ---------------------------------------------------------------------------
+
+def test_crc001_bare_jsonl_write_fires():
+    fs = lint("""
+        import json
+
+        def log(f, rec):
+            f.write(json.dumps(rec) + "\\n")
+    """, "bigdl_tpu/serving/foo.py", "CRC001")
+    assert len(fs) == 1
+
+
+def test_crc001_crc_line_wrapped_is_clean():
+    fs = lint("""
+        import json
+        from bigdl_tpu.serving.journal import crc_line
+
+        def log(f, rec):
+            f.write(crc_line(json.dumps(rec)) + "\\n")
+    """, "bigdl_tpu/serving/foo.py", "CRC001")
+    assert fs == []
+
+
+def test_crc001_wire_protocols_and_documents_exempt():
+    # SSE framing (\\n\\n), NUL-delimited streams, and whole-document
+    # JSON are different contracts, not journal lines
+    src = """
+        import json
+
+        def sse(w, evt):
+            w.write(f"data: {json.dumps(evt)}\\n\\n".encode())
+
+        def fastchat(w, chunk):
+            w.write(json.dumps(chunk).encode() + b"\\0")
+
+        def config(f, meta):
+            f.write(json.dumps(meta, indent=1).encode())
+    """
+    assert lint(src, "bigdl_tpu/serving/foo.py", "CRC001") == []
+
+
+# ---------------------------------------------------------------------------
+# suppression / baseline machinery
+# ---------------------------------------------------------------------------
+
+def test_suppression_on_line_above():
+    fs = lint("""
+        import time
+        # graftlint: disable=WCT001
+        t = time.time()
+    """, "bigdl_tpu/serving/foo.py", "WCT001")
+    assert fs == []
+
+
+def test_baseline_filters_on_rule_path_code(tmp_path):
+    findings = lint("import time\nt = time.time()\n",
+                    "bigdl_tpu/serving/foo.py", "WCT001")
+    assert len(findings) == 1
+    bl = [{"rule": "WCT001", "path": "bigdl_tpu/serving/foo.py",
+           "code": "t = time.time()", "justification": "fixture"}]
+    new, old = lc.apply_baseline(findings, bl)
+    assert new == [] and len(old) == 1
+    # a different offending line is NOT absorbed
+    other = lint("import time\nu = time.time()\n",
+                 "bigdl_tpu/serving/foo.py", "WCT001")
+    new2, _ = lc.apply_baseline(other, bl)
+    assert len(new2) == 1
+
+
+def test_baseline_entries_require_justification(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"findings": [
+        {"rule": "WCT001", "path": "x.py", "code": "t = time.time()"}
+    ]}))
+    with pytest.raises(ValueError, match="justification"):
+        lc.load_baseline(str(p))
+
+
+def test_write_baseline_refused_under_filters_and_keeps_justifications(
+        tmp_path):
+    # a filtered scan must never be written as THE baseline (it would
+    # drop every grandfathered entry outside the slice) ...
+    assert lc.run(paths=["bigdl_tpu/serving"], write_baseline_path="x",
+                  out=open(os.devnull, "w")) == 2
+    assert lc.run(rules=["WCT001"], write_baseline_path="x",
+                  out=open(os.devnull, "w")) == 2
+    # ... and a full rewrite carries surviving entries' justifications
+    f = lc.Finding("WCT001", "a.py", 3, "m", code="t = time.time()")
+    prev = [{"rule": "WCT001", "path": "a.py",
+             "code": "t = time.time()", "justification": "kept reason"}]
+    p = tmp_path / "bl.json"
+    lc.write_baseline([f], str(p), previous=prev)
+    assert lc.load_baseline(str(p))[0]["justification"] == "kept reason"
+
+
+def test_shipped_baseline_loads_and_is_empty_or_justified():
+    entries = lc.load_baseline(lc.DEFAULT_BASELINE)
+    for e in entries:  # load_baseline enforces justification; re-assert
+        assert e.get("justification")
+
+
+# ---------------------------------------------------------------------------
+# the real gate
+# ---------------------------------------------------------------------------
+
+def test_real_tree_has_zero_non_baselined_findings():
+    t0 = time.monotonic()
+    findings = lc.lint_paths()
+    new, _ = lc.apply_baseline(findings, lc.load_baseline(
+        lc.DEFAULT_BASELINE))
+    assert new == [], "\n".join(f.format() for f in new)
+    assert time.monotonic() - t0 < 10.0, "lint must stay under 10 s"
+
+
+def test_fixed_clock_and_atomic_sites_stay_clean():
+    """Regression guard for THIS PR's cleanup: the api_server/engine
+    wall-clock sites and the tracing/report bare writes must never
+    reappear (they are also covered by the tree-wide gate; this names
+    the exact files so a regression reads as what it is)."""
+    fixed = [
+        "bigdl_tpu/serving/api_server.py",
+        "bigdl_tpu/serving/engine.py",
+        "bigdl_tpu/obs/tracing.py",
+        "bigdl_tpu/obs/profiler.py",
+        "bigdl_tpu/benchmark/report.py",
+        "bigdl_tpu/parallel/health.py",
+        "bigdl_tpu/train/supervisor.py",
+    ]
+    paths = [os.path.join(REPO, p) for p in fixed]
+    findings = [f for f in lc.lint_paths(paths)
+                if f.rule in ("WCT001", "ATW001")]
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_lint_cli_runs_without_importing_jax():
+    """The ci.sh --lint contract, end to end: a fresh interpreter runs
+    the full gate and jax never enters sys.modules."""
+    code = (
+        "import sys\n"
+        "from bigdl_tpu.analysis import run\n"
+        "rc = run()\n"
+        "assert 'jax' not in sys.modules, 'graftlint imported jax'\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_parse_error_is_a_finding_not_a_crash():
+    fs = lc.lint_text("def broken(:\n", "bigdl_tpu/x.py")
+    assert len(fs) == 1 and fs[0].rule == "PARSE"

@@ -553,3 +553,45 @@ def test_engine_injectable_clock(model):
     # could race the decode jit's cache donation) and reports an idle
     # engine as empty, not the freed slots' ghost positions
     assert eng.kv_utilization() == 0.0
+
+
+def test_api_server_injectable_clock(model):
+    """ISSUE 12 satellite: the ApiServer's own timestamps (`created`,
+    uptime, Retry-After rate, wait deadlines) ride the same injectable
+    clock it threads into the engine and tracer — the simulated-clock
+    benchmark can drive the API layer, not just the engine under it
+    (graftlint WCT001 guards the implementation side)."""
+    import json as _json
+    import urllib.request
+
+    from bigdl_tpu.serving.api_server import ApiServer
+
+    sim = {"t": 50_000.0}
+
+    def fake_clock():
+        sim["t"] += 0.01
+        return sim["t"]
+
+    srv = ApiServer(model, host="127.0.0.1", port=0, n_slots=2,
+                    max_len=128, tracing=True, clock=fake_clock)
+    # one clock, threaded everywhere
+    assert srv.engine._clock is fake_clock
+    assert srv.tracer._clock is fake_clock
+    srv.start()
+    try:
+        body = _json.dumps({"prompt": [9, 9, 8, 2],
+                            "max_tokens": 3}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = _json.loads(r.read())
+        # `created` is stamped in the simulated epoch, not wall time
+        assert 50_000 <= out["created"] < 60_000
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        up = _metric_value(text, "bigdl_tpu_uptime_seconds")
+        assert 0 < up < 10_000  # simulated age, not the wall epoch
+    finally:
+        srv.shutdown()
