@@ -4,10 +4,13 @@ checks (rule table + rationale in docs/static-analysis.md).
 ==========  ===============================================================
 rule        invariant
 ==========  ===============================================================
-``WCT001``  no wall-clock *calls* in serving/, obs/, train/supervisor.py,
-            parallel/health.py — timestamps flow through the injectable
-            ``clock=`` (PR 11); referencing ``time.time`` as a default
-            clock implementation is fine, *calling* it is not
+``WCT001``  no wall-clock *calls* in serving/, obs/, sim/,
+            train/supervisor.py, parallel/health.py — timestamps flow
+            through the injectable ``clock=`` (PR 11; sim/ added by
+            ISSUE 13: the simulator must be wall-clock-free or its
+            reports stop being reproducible); referencing ``time.time``
+            as a default clock implementation is fine, *calling* it is
+            not
 ``ATW001``  no bare ``open(..., "w"/"wb")`` anywhere in bigdl_tpu/ —
             artifacts commit via ``utils/durability.atomic_write`` (PR 7);
             append-mode logs are exempt (append-only is its own protocol)
@@ -53,6 +56,8 @@ class WallClockBan(Check):
     SCOPES = (
         "bigdl_tpu/serving/",
         "bigdl_tpu/obs/",
+        "bigdl_tpu/sim/",  # the simulator IS the fake-clock domain: one
+        # wall-clock call would silently re-couple reports to the host
         "bigdl_tpu/train/supervisor.py",
         "bigdl_tpu/parallel/health.py",
     )
@@ -190,7 +195,11 @@ class FaultPointValidity(Check):
     def _scope(self, rel: str, regs: dict) -> tuple:
         """(scope label, allowed point set) for a file. parallel/ rides
         the train registry: health.py fires the supervisor's rank_drop."""
-        if rel.startswith("bigdl_tpu/serving/"):
+        if (rel.startswith("bigdl_tpu/serving/")
+                or rel.startswith("bigdl_tpu/sim/")):
+            # sim/ composes the SERVING injector (chaos traces arm
+            # slow_step/alloc_page against the simulated engine), so its
+            # fault points are checked against the serving registry
             return "serving", regs.get("serving", set())
         if (rel.startswith("bigdl_tpu/train/")
                 or rel.startswith("bigdl_tpu/parallel/")):

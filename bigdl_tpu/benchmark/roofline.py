@@ -24,7 +24,8 @@ bench.py's jax-free parent evaluates it in a CPU-pinned child.
 from __future__ import annotations
 
 from bigdl_tpu.ops.pallas.tiling import (
-    finest_split, pick_block_m, pick_block_o, round_up,
+    finest_split, flash_blocks, flash_live_blocks, pick_block_m,
+    pick_block_o, round_up,
 )
 from bigdl_tpu.quant.qtypes import resolve_qtype
 
@@ -93,6 +94,112 @@ def qmatmul_cost(qtype: str, M: int, K: int, O: int) -> dict:
         # means the fused kernel moves fewer HBM bytes for the same math
         "bytes_ratio_vs_xla": round(xla_bytes / fused_bytes, 2),
     }
+
+
+# ---------------------------------------------------------------------------
+# attention kernels (ISSUE 13 satellite): flash prefill +
+# paged/dense decode attention, fp8-KV variants. Block/tile policy is
+# imported from ops/pallas/tiling.py — the same module the kernels
+# resolve their shapes from — so the sim's cost model (sim/cost.py) and
+# the implementation cannot drift.
+# ---------------------------------------------------------------------------
+
+
+def flash_prefill_cost(T: int, S: int, Hq: int, Hkv: int, D: int,
+                       B: int = 1, layers: int = 1,
+                       quantize_kv: bool = False,
+                       q_offset: int = 0, window=None) -> dict:
+    """Analytic cost of the flash prefill kernel for a [T]-token chunk
+    attending an [S]-slot cache, at the REAL (block_q, block_k) the
+    kernel picks (tiling.flash_blocks) and with the kernel's own causal
+    block-skip predicate (tiling.flash_live_blocks).
+
+    Fetch pattern (flash_attention._flash BlockSpecs): the q block index
+    map ignores j, so a q tile is fetched once per (b, h, i); k/v tiles
+    are re-fetched per live (i, j) pair for every QUERY head (GQA
+    grouping shares the HBM page only within one h's sweep). fp8 KV
+    halves the k/v code bytes and adds f32 per-(slot, head) scales."""
+    block_q, block_k = flash_blocks(T, S)
+    live = flash_live_blocks(T, S, block_q, block_k,
+                             q_offset=q_offset, window=window)
+    Tp = round_up(T, block_q)
+    kv_bpe = 1 if quantize_kv else 2
+    q_bytes = B * Hq * Tp * D * _X_BPE
+    kv_tile = block_k * D * kv_bpe + (block_k * 4 if quantize_kv else 0)
+    kv_bytes = B * Hq * live * 2 * kv_tile  # k AND v
+    o_bytes = B * Hq * Tp * D * _OUT_BPE
+    # qk^T + av over the live blocks (the skipped blocks cost nothing —
+    # the kernel's pl.when elides the whole compute body)
+    flops = 4 * B * Hq * live * block_q * block_k * D
+    total = layers * (q_bytes + kv_bytes + o_bytes)
+    return {
+        "kernel": "flash_prefill", "shape": f"t{T}xs{S}",
+        "block_q": block_q, "block_k": block_k,
+        "live_blocks": live, "quantize_kv": quantize_kv,
+        "bytes": total, "flops": layers * flops,
+        "intensity": round(layers * flops / max(total, 1), 2),
+    }
+
+
+def decode_attention_cost(pos, page: int, Hq: int, Hkv: int, D: int,
+                          layers: int = 1, paged: bool = True,
+                          quantize_kv: bool = False,
+                          max_len: int = 0) -> dict:
+    """Analytic cost of one batched decode-attention step over the rows'
+    live KV. `pos` is the per-row written position (int or list of
+    ints — the engine's cache.pos for the active slots).
+
+    Paged (ops/pallas/paged_attention): grid (B, max_pages), one
+    (page, Hkv, D) k and v tile per live page — pages past
+    ceil(pos/page) all map to the scratch sink page 0, whose single tile
+    stays HBM-resident, so the traffic model counts live pages only.
+    Dense: each row streams its [max_len] cache rows (the dense decode
+    path has no page table to skip dead slots by block). fp8 KV halves
+    code bytes and adds the f32 per-(slot, head) scale planes."""
+    rows = [pos] if isinstance(pos, int) else list(pos)
+    kv_bpe = 1 if quantize_kv else 2
+    if paged:
+        pages = sum(-(-max(p, 1) // page) for p in rows)
+        slots = pages * page
+    else:
+        if not max_len:
+            raise ValueError("dense decode attention needs max_len")
+        slots = len(rows) * max_len
+        pages = 0
+    slot_bytes = Hkv * D * kv_bpe + (Hkv * 4 if quantize_kv else 0)
+    kv_bytes = 2 * slots * slot_bytes  # k AND v
+    q_bytes = len(rows) * Hq * D * 4  # the kernel lifts q to f32
+    o_bytes = len(rows) * Hq * D * _OUT_BPE
+    flops = 4 * sum(max(p, 1) for p in rows) * Hq * D
+    total = layers * (kv_bytes + q_bytes + o_bytes)
+    return {
+        "kernel": "paged_decode" if paged else "dense_decode",
+        "batch": len(rows), "page": page if paged else None,
+        "live_pages": pages, "kv_slots_touched": slots,
+        "quantize_kv": quantize_kv,
+        "bytes": total, "flops": layers * flops,
+        "intensity": round(layers * flops / max(total, 1), 4),
+    }
+
+
+def attention_matrix(Ts=(128, 512, 2048), S_extra: int = 0,
+                     Hq: int = 32, Hkv: int = 8, D: int = 128,
+                     page: int = 64) -> dict:
+    """bench.py's analytic attention sweep (child_analytic): flash
+    prefill chunks and batched paged decode at llama3-class GQA shapes,
+    bf16 and fp8 KV — pure host math, lands with the tunnel down."""
+    out = {}
+    for T in Ts:
+        for qkv in (False, True):
+            c = flash_prefill_cost(T, T + S_extra, Hq, Hkv, D,
+                                   quantize_kv=qkv)
+            out[f"flash_t{T}{'_fp8' if qkv else ''}"] = c
+    for B in (1, 8, 32):
+        for qkv in (False, True):
+            c = decode_attention_cost([1024] * B, page, Hq, Hkv, D,
+                                      quantize_kv=qkv)
+            out[f"decode_b{B}{'_fp8' if qkv else ''}"] = c
+    return out
 
 
 def gemm_matrix(qtypes, Ms=(1, 128, 512, 2048), K: int = 4096,

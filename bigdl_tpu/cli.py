@@ -493,6 +493,52 @@ def cmd_trace(args):
               f"inspect {out['logdir']} with TensorBoard/XProf")
 
 
+def cmd_simserve(args):
+    """Simulated-clock serving benchmark (docs/benchmarking.md): drive
+    the real engine with a seeded synthetic trace under a virtual clock
+    and a roofline cost model — engine-level throughput / TTFT / p99 /
+    preemption + shed numbers with ZERO devices.
+
+        bigdl-tpu simserve --trace poisson --seed 0
+        bigdl-tpu simserve --trace overload -o report.json
+        bigdl-tpu simserve --trace-file banked.jsonl
+
+    Prints exactly one JSON report line (sorted keys: two identical
+    invocations are byte-identical). `--save-trace` banks the generated
+    arrival trace as replayable crc'd JSONL."""
+    import jax
+
+    # zero-device contract: never claim the (serialized) TPU tunnel —
+    # jax.config, not env: the session sitecustomize overrides env vars
+    jax.config.update("jax_platforms", "cpu")
+    from bigdl_tpu.sim.engine_driver import (
+        SCENARIOS, SimDriver, default_cost_model, report_json,
+    )
+    from bigdl_tpu.sim.traces import Trace, named_trace
+
+    if args.trace_file:
+        trace = Trace.load(args.trace_file)
+        sim = SCENARIOS.get(trace.name) or SCENARIOS["poisson"]
+    else:
+        trace = named_trace(args.trace, seed=args.seed)
+        sim = SCENARIOS[args.trace]
+    if args.save_trace:
+        trace.save(args.save_trace)
+        print(f"saved {len(trace.arrivals)}-arrival trace to "
+              f"{args.save_trace}", file=sys.stderr)
+    driver = SimDriver(trace, sim=sim,
+                       cost=default_cost_model(hbm_gbps=args.hbm_gbps))
+    report = driver.run()
+    line = report_json(report)
+    if args.output:
+        from bigdl_tpu.utils.durability import atomic_write
+
+        atomic_write(args.output,
+                     lambda f: f.write((line + "\n").encode("utf-8")))
+        print(f"wrote report to {args.output}", file=sys.stderr)
+    print(line)
+
+
 def cmd_lint(args):
     """graftlint: the AST-based invariant gate (docs/static-analysis.md).
 
@@ -704,6 +750,36 @@ def main(argv=None):
                     help="profile-start: jax.profiler output directory "
                          "on the SERVER's filesystem")
     tr.set_defaults(fn=cmd_trace)
+
+    sv = sub.add_parser(
+        "simserve",
+        help="simulated-clock serving benchmark: real engine + virtual "
+             "clock + roofline cost model, zero devices (one JSON "
+             "report line; docs/benchmarking.md)",
+    )
+    sv.add_argument("--trace", default="poisson",
+                    # literal: keep CLI startup free of sim/jax imports
+                    # (must mirror sim/traces.TRACE_NAMES)
+                    choices=("poisson", "bursty", "prefix-heavy",
+                             "overload"),
+                    help="named trace mix (overload exercises "
+                         "preemption AND shed)")
+    sv.add_argument("--trace-file", default=None,
+                    help="replay a banked trace JSONL instead of "
+                         "generating one")
+    sv.add_argument("--seed", type=int, default=0,
+                    help="trace-generator seed (same seed = "
+                         "byte-identical trace and report)")
+    sv.add_argument("--hbm-gbps", type=float, default=None,
+                    help="cost-model calibration knob: achievable HBM "
+                         "GB/s of the modeled chip (default v5e-class)")
+    sv.add_argument("--save-trace", default=None,
+                    help="bank the generated arrival trace as crc'd "
+                         "JSONL")
+    sv.add_argument("-o", "--output", default=None,
+                    help="also write the report JSON to a file "
+                         "(atomic)")
+    sv.set_defaults(fn=cmd_simserve)
 
     ln = sub.add_parser(
         "lint",

@@ -32,10 +32,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bigdl_tpu.ops.pallas.tiling import (
+    FLASH_BLOCK_K, FLASH_BLOCK_Q, MOSAIC_LANES, flash_blocks,
+)
 from bigdl_tpu.utils import round_up
 
 _NEG_INF = -1e30
-_LANES = 128
+# lane width + block policy live in tiling.py (jax-free) so the
+# analytic attention roofline evaluates at the kernel's REAL tiles
+_LANES = MOSAIC_LANES
 
 from bigdl_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
 
@@ -210,8 +215,8 @@ def flash_attention(
     scale: Optional[float] = None,
     k_scale: Optional[jax.Array] = None,  # [B, S, Hkv] fp8 dequant scales
     v_scale: Optional[jax.Array] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = FLASH_BLOCK_Q,
+    block_k: int = FLASH_BLOCK_K,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Returns [B, T, Hq, D] in q.dtype. Pads T/S/D to tile multiples
@@ -237,8 +242,7 @@ def flash_attention(
         q_offset = jnp.zeros((), jnp.int32)
     assert causal, "non-causal path uses ops.attention (bidirectional encoders)"
 
-    block_q = min(block_q, round_up(T, 16))
-    block_k = min(block_k, round_up(S, 16))
+    block_q, block_k = flash_blocks(T, S, block_q, block_k)
     Tp, Sp, Dp = round_up(T, block_q), round_up(S, block_k), round_up(D, _LANES)
 
     qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, Hq, T, D]

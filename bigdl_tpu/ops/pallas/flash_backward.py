@@ -42,10 +42,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from bigdl_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
+from bigdl_tpu.ops.pallas.tiling import MOSAIC_LANES
 from bigdl_tpu.utils import round_up
 
 _NEG_INF = -1e30
-_LANES = 128
+# one source for the lane width (tiling.py), shared with the forward
+# kernel and the analytic roofline — the policies cannot drift
+_LANES = MOSAIC_LANES
 _LSE_LANES = 8  # full-dim lane block: satisfies the (sublane, 128) rule
 
 

@@ -83,6 +83,56 @@ def pick_block_m(M: int, K: int, x_bpe: int = 2) -> int:
     return 8
 
 
+# ---------------------------------------------------------------------------
+# attention tile policy — shared by ops/pallas/flash_attention.py (the
+# kernel's default block shapes) and benchmark/roofline.py's analytic
+# attention costs, so the sim's cost model and the implementation cannot
+# drift (the qmatmul/roofline contract, extended to attention; ISSUE 13)
+# ---------------------------------------------------------------------------
+
+#: Mosaic lane width: flash pads head_dim to a multiple of this, and no
+#: operand tile goes below it in the lane dimension
+MOSAIC_LANES = 128
+
+#: flash attention default q/k block edge (clamped to the padded
+#: sequence extents by `flash_blocks`)
+FLASH_BLOCK_Q = 128
+FLASH_BLOCK_K = 128
+
+
+def flash_blocks(T: int, S: int,
+                 block_q: int = FLASH_BLOCK_Q,
+                 block_k: int = FLASH_BLOCK_K) -> tuple:
+    """The (block_q, block_k) flash_attention actually runs at for a
+    [T] x [S] problem: the policy default clamped to the 16-padded
+    sequence extents (short prefills run one small block per axis)."""
+    return (min(block_q, round_up(T, 16)), min(block_k, round_up(S, 16)))
+
+
+def flash_live_blocks(T: int, S: int, block_q: int, block_k: int,
+                      q_offset: int = 0, causal: bool = True,
+                      window=None) -> int:
+    """Number of (i, j) grid blocks the flash kernel COMPUTES (the rest
+    are skipped via pl.when) — the same liveness predicate as
+    flash_attention._kernel, evaluated statically. q slot t attends kv
+    slot j iff j <= q_offset + t (causal) and j > q_offset + t - window.
+    Per-row `start` padding is ignored (it masks lanes, not blocks)."""
+    Tp, Sp = round_up(T, block_q), round_up(S, block_k)
+    n_q, n_k = Tp // block_q, Sp // block_k
+    live = 0
+    for i in range(n_q):
+        for j in range(n_k):
+            ok = True
+            if causal:
+                row_max = q_offset + (i + 1) * block_q - 1
+                ok = j * block_k <= row_max
+            if ok and window is not None:
+                row_min = q_offset + i * block_q
+                ok = (j + 1) * block_k - 1 > row_min - window
+            live += bool(ok)
+    return live
+
+
 def chunk_target(block_o: int, persist_bytes: int, kh: int,
                  temp_bpe: int = 12) -> int:
     """Largest chunk whose per-chunk temporaries (temp_bpe B/element of

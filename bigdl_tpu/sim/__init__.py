@@ -1,0 +1,25 @@
+"""Simulated-clock serving simulator — the zero-device perf gate
+(docs/benchmarking.md; ROADMAP "simulated-clock serving benchmark").
+
+Drives the REAL `serving/engine.py` — real scheduler, admission,
+deadlines, preemption, prefix cache, journal, metrics, tracing — under
+a virtual clock (`sim/clock.py`) and seeded synthetic arrival traces
+(`sim/traces.py`). Only two things are fake: time (every engine
+timestamp flows through the injectable ``clock=``, enforced statically
+by graftlint WCT001) and the per-step latency, which comes from
+`sim/cost.py`'s analytic roofline model instead of the host's wall
+clock. A dead-TPU-tunnel day still emits engine-level TTFT/p99/shed
+numbers: `bigdl-tpu simserve` / `bench.py --sim`.
+"""
+
+from bigdl_tpu.sim.clock import SimClock
+from bigdl_tpu.sim.cost import CostModel
+from bigdl_tpu.sim.traces import (
+    Arrival, Trace, bursty_trace, named_trace, poisson_trace,
+    prefix_heavy_trace,
+)
+
+__all__ = [
+    "Arrival", "CostModel", "SimClock", "Trace", "bursty_trace",
+    "named_trace", "poisson_trace", "prefix_heavy_trace",
+]

@@ -1,0 +1,48 @@
+"""Virtual clock for discrete-event serving simulation.
+
+A `SimClock` instance IS the engine's ``clock=`` callable: calling it
+reads the current simulated time, and only the simulator's event loop
+(`sim/engine_driver.py`) moves it — at arrivals, modeled step
+completions, and deadline boundaries. Nothing in this package may touch
+wall time (graftlint WCT001 covers bigdl_tpu/sim/), so two runs of the
+same seeded trace produce byte-identical reports on any machine.
+"""
+
+from __future__ import annotations
+
+
+class SimClock:
+    """Monotonic simulated time in seconds, starting at `start`.
+
+    The engine calls the instance (``clock()``); the driver advances it
+    with `advance` (relative, e.g. a modeled decode-step latency) or
+    `advance_to` (absolute, e.g. the next trace arrival). Backward
+    moves are rejected — a clock that rewinds would corrupt every
+    histogram and deadline downstream.
+    """
+
+    __slots__ = ("_now",)
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    @property
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"SimClock cannot move backward (dt={dt})")
+        self._now += float(dt)
+        return self._now
+
+    def advance_to(self, t: float) -> float:
+        """Jump to absolute time `t` (no-op when `t` is in the past —
+        the idle-until-next-arrival jump must not rewind past work the
+        engine already stamped)."""
+        if t > self._now:
+            self._now = float(t)
+        return self._now

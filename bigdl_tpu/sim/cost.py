@@ -1,0 +1,172 @@
+"""Per-step latency model for the serving simulator, fed by
+`benchmark/roofline.py`'s analytic bytes/FLOPs at the kernels' real
+tile shapes (docs/benchmarking.md).
+
+The modeled hardware/model pair is INDEPENDENT of the tiny model that
+produces token dynamics on CPU: the engine executes tiny-llama to keep
+every cache/scheduler path real, while each jitted call's duration is
+priced as if it were `config` (default llama2-7b) at `qtype` on an
+HBM with `hbm_gbps` — the calibration knob the next live-TPU window
+tunes against measured GB/s (BENCH_NOTES r03 discipline).
+
+Pricing follows the roofline: a phase costs
+``max(bytes / HBM_BW, flops / peak)`` plus a fixed per-dispatch host
+overhead. Decode is bytes-bound (weight streaming + KV touched ∝ batch
+occupancy and positions); prefill cost is ∝ chunk tokens through the
+same qmatmul model at M=chunk plus the flash-prefill attention cost at
+the kernel's real (block_q, block_k).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from bigdl_tpu.benchmark.roofline import (
+    decode_attention_cost, flash_prefill_cost, qmatmul_cost,
+)
+from bigdl_tpu.models.config import ModelConfig
+from bigdl_tpu.quant.qtypes import resolve_qtype
+
+
+@dataclasses.dataclass
+class CostModel:
+    config: ModelConfig
+    qtype: Optional[str] = "sym_int4"  # None = dense bf16 weights
+    #: the calibration knob (docs/benchmarking.md): achievable HBM GB/s
+    #: of the modeled chip; default is v5e-class. The next live-TPU
+    #: window sets this from measured kernel GB/s (bench.py gemv_timed).
+    hbm_gbps: float = 819.0
+    #: bf16 MXU peak — the compute-bound floor of every phase
+    peak_tflops: float = 197.0
+    #: host dispatch + engine bookkeeping per jitted call (the sim's
+    #: step() host work happens between modeled device calls)
+    step_overhead_s: float = 5e-4
+    #: host<->HBM link for preemption swap traffic (PCIe/ICI class)
+    swap_gbps: float = 32.0
+    #: modeled KV page (the engine's real page_size is passed per call;
+    #: this is only the default for standalone queries)
+    page_size: int = 64
+    quantize_kv: bool = False
+    label: str = ""
+
+    # -- pieces --------------------------------------------------------------
+
+    def _supported_qtype(self) -> Optional[str]:
+        """The matmul-model qtype, or None when the modeled config's
+        contractions don't align to the format's scale blocks (tiny
+        configs) — then weights price as dense bf16."""
+        if self.qtype is None:
+            return None
+        spec = resolve_qtype(self.qtype)
+        blk = spec.superblock or spec.block_size
+        cfg = self.config
+        for k in (cfg.hidden_size, cfg.q_dim, cfg.intermediate_size):
+            if k % blk:
+                return None
+        return self.qtype
+
+    def linear_cost(self, M: int) -> dict:
+        """bytes/flops of every projection of one full forward at M
+        rows: L x (merged qkv, o, gate_up, down) + the lm_head."""
+        cfg = self.config
+        shapes = [
+            (cfg.hidden_size, cfg.q_dim + 2 * cfg.kv_dim),  # qkv
+            (cfg.q_dim, cfg.hidden_size),                   # o
+            (cfg.hidden_size, 2 * cfg.intermediate_size),   # gate_up
+            (cfg.intermediate_size, cfg.hidden_size),       # down
+        ]
+        qt = self._supported_qtype()
+        total_b = total_f = 0
+        for K, O in shapes:
+            if qt is not None:
+                c = qmatmul_cost(qt, M, K, O)
+                total_b += c["fused_bytes"]
+                total_f += c["flops"]
+            else:
+                total_b += K * O * 2 + M * (K + O) * 2
+                total_f += 2 * M * K * O
+        total_b *= cfg.num_hidden_layers
+        total_f *= cfg.num_hidden_layers
+        # lm_head stays bf16 (the stack's convention: output head is
+        # not quantized)
+        K, O = cfg.hidden_size, cfg.vocab_size
+        total_b += K * O * 2 + M * (K + O) * 2
+        total_f += 2 * M * K * O
+        return {"bytes": total_b, "flops": total_f}
+
+    def _seconds(self, nbytes: float, flops: float) -> float:
+        bw = self.hbm_gbps * 1e9
+        peak = self.peak_tflops * 1e12
+        return max(nbytes / bw, flops / peak)
+
+    def kv_token_bytes(self) -> int:
+        """HBM bytes one token's K+V occupies across all layers."""
+        cfg = self.config
+        bpe = 1 if self.quantize_kv else 2
+        scale = 4 if self.quantize_kv else 0
+        return 2 * cfg.num_hidden_layers * cfg.num_key_value_heads * (
+            cfg.head_dim_ * bpe + scale
+        )
+
+    # -- phases (what the driver's wrappers charge) --------------------------
+
+    def decode_step_s(self, positions, page: int,
+                      paged: bool = True, max_len: int = 0) -> float:
+        """One batched decode step: M=occupancy through every
+        projection + the decode-attention KV sweep at the rows' actual
+        positions."""
+        rows = list(positions)
+        if not rows:
+            return self.step_overhead_s
+        cfg = self.config
+        lin = self.linear_cost(len(rows))
+        att = decode_attention_cost(
+            rows, page, cfg.num_attention_heads, cfg.num_key_value_heads,
+            cfg.head_dim_, layers=cfg.num_hidden_layers, paged=paged,
+            quantize_kv=self.quantize_kv, max_len=max_len,
+        )
+        return self._seconds(lin["bytes"] + att["bytes"],
+                             lin["flops"] + att["flops"]) \
+            + self.step_overhead_s
+
+    def prefill_s(self, chunk_tokens: int, prior_tokens: int = 0) -> float:
+        """A prefill chunk of `chunk_tokens` attending `prior_tokens`
+        of existing context (prefix-cache hits shrink the chunk, which
+        is exactly how the cache saves simulated time)."""
+        cfg = self.config
+        lin = self.linear_cost(chunk_tokens)
+        att = flash_prefill_cost(
+            chunk_tokens, prior_tokens + chunk_tokens,
+            cfg.num_attention_heads, cfg.num_key_value_heads,
+            cfg.head_dim_, layers=cfg.num_hidden_layers,
+            quantize_kv=self.quantize_kv, q_offset=prior_tokens,
+        )
+        return self._seconds(lin["bytes"] + att["bytes"],
+                             lin["flops"] + att["flops"]) \
+            + self.step_overhead_s
+
+    def kv_copy_s(self, tokens: int) -> float:
+        """HBM->HBM KV move (prefill-insert, sub-page prefix copy)."""
+        nbytes = 2 * tokens * self.kv_token_bytes()  # read + write
+        return nbytes / (self.hbm_gbps * 1e9)
+
+    def swap_s(self, tokens: int) -> float:
+        """Preemption swap round trip (out at preempt + in at resume,
+        charged together at resume) over the host link."""
+        nbytes = 2 * tokens * self.kv_token_bytes()
+        return nbytes / (self.swap_gbps * 1e9)
+
+    def describe(self) -> dict:
+        return {
+            "model": self.label or self.config.model_type,
+            "hidden": self.config.hidden_size,
+            "layers": self.config.num_hidden_layers,
+            "qtype": self.qtype,
+            "effective_qtype": self._supported_qtype(),
+            "quantize_kv": self.quantize_kv,
+            "hbm_gbps": self.hbm_gbps,
+            "peak_tflops": self.peak_tflops,
+            "step_overhead_s": self.step_overhead_s,
+            "swap_gbps": self.swap_gbps,
+        }

@@ -65,6 +65,32 @@ from bigdl_tpu.serving.metrics import Metrics, metric_drift
 missing, unregistered = metric_drift(Metrics().render(), None)
 assert not missing and not unregistered, (missing, unregistered)
 print('metrics drift: clean')"
+  echo "== simulated-clock serving smoke (< 60 s, zero devices:"
+  echo "   real engine + SimClock + roofline cost model — docs/benchmarking.md;"
+  echo "   prefix-heavy covers the Poisson-arrival path, overload the"
+  echo "   preempt+shed acceptance; the full 4-mix sweep lives in"
+  echo "   tests/test_sim.py and bench.py --sim)"
+  python - <<'PY'
+import math
+import jax; jax.config.update("jax_platforms", "cpu")
+from bigdl_tpu.sim.engine_driver import run_scenario, tiny_model
+m = tiny_model()
+pref = run_scenario("prefix-heavy", seed=0, model=m)
+over = run_scenario("overload", seed=0, model=m)
+for name, r in (("prefix-heavy", pref), ("overload", over)):
+    p99 = r["latency"]["ttft_s"]["p99"]
+    assert p99 and math.isfinite(p99), (name, "TTFT p99 not finite", p99)
+    assert r["kv"]["page_leak_at_drain"] == 0, (name, "page leak at drain")
+    assert sum(r["counters"]["finish_reasons"].values()) == r["trace"]["n_requests"]
+assert over["rates"]["shed_rate"] > 0, "overload trace must shed"
+assert over["counters"]["preemptions"] > 0, "overload trace must preempt"
+assert pref["kv"]["prefix_hits"] > 0, "prefix-heavy trace must hit the cache"
+print("sim smoke: prefix-heavy %.0f tok/s (%d cache hits), "
+      "overload shed_rate %.2f, preemptions %d" % (
+          pref["throughput"]["output_tokens_per_s"],
+          pref["kv"]["prefix_hits"],
+          over["rates"]["shed_rate"], over["counters"]["preemptions"]))
+PY
   echo "CORE OK"
   exit 0
 fi
