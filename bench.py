@@ -665,6 +665,11 @@ def child_sim() -> dict:
             "completed": r["counters"]["requests_completed"],
             "kv_util_peak": r["kv"]["utilization_peak"],
             "page_leak": r["kv"]["page_leak_at_drain"],
+            # radix prefix cache + chunked prefill (ISSUE 14)
+            "prefill_chunks": r["counters"]["prefill_chunks"],
+            "prefix_hits": r["kv"].get("prefix_hits", 0),
+            "prefix_tokens_reused": r["kv"].get("prefix_tokens_reused", 0),
+            "prefix_evictions": r["kv"].get("prefix_evictions", 0),
         }
         log(f"sim {name}: {sweep[name]['tok_s']} tok/s, "
             f"ttft p99 {sweep[name]['ttft_p99_s']}s, "

@@ -247,7 +247,9 @@ def cmd_serve(args):
     server = ApiServer(
         model, tokenizer=tok, host=args.host,
         port=args.port, n_slots=args.slots, max_len=args.max_len, gen=gen,
-        paged=args.paged, speculative=args.speculative,
+        paged=args.paged,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
+        speculative=args.speculative,
         draft_k=args.draft_k, adaptive_draft=args.adaptive_draft,
         embedder=embedder, truncate_prompts=args.truncate_prompts,
         logprobs_top_k=args.logprobs_top_k,
@@ -645,7 +647,12 @@ def main(argv=None):
                    help="serve OpenAI top_logprobs with up to N "
                         "alternatives per token")
     s.add_argument("--paged", action="store_true",
-                   help="paged KV pool + prefix caching")
+                   help="paged KV pool + radix prefix caching")
+    s.add_argument("--prefill-chunk-tokens", type=int, default=None,
+                   help="paged: interleave prompt prefill with decode "
+                        "in chunks of at most N tokens, bounding the "
+                        "running batch's stall to one chunk per step "
+                        "(docs/serving.md §6; default: monolithic)")
     s.add_argument("--trace", action="store_true",
                    help="record request-lifecycle spans into a bounded "
                         "ring buffer (dump: `bigdl-tpu trace dump`, or "

@@ -15,9 +15,10 @@ prefixes. Here KV lives in pages of `page_size` tokens:
   gather moves the same bytes attention reads — a dedicated Pallas
   paged-attention kernel that indexes pages in place is the follow-up).
 
-Pages are allocated on demand and refcounted, so identical prompt
-prefixes share both storage and prefill compute (serving/engine.py's
-prefix cache keys full pages by their cumulative token hash).
+Pages are allocated on demand and refcounted (`PagePool`), so identical
+prompt prefixes share both storage and prefill compute — the serving
+engine's radix-tree prefix cache (serving/radix.py) holds one reference
+per cached page and matches prompts at any token split point.
 
 The class mirrors the KVCache interface surface the model forward uses
 (pos/start/max_len/next_positions + update/read/advance dispatched via
@@ -95,6 +96,57 @@ def init_paged(
         pos=jnp.zeros((batch,), jnp.int32),
         start=jnp.zeros((batch,), jnp.int32),
     )
+
+
+# ---------------------------------------------------------------------------
+# Host-side page accounting (serving/engine.py + serving/radix.py)
+# ---------------------------------------------------------------------------
+
+
+class PagePool:
+    """Refcounted free-list accounting for the physical pages of a
+    PagedKVCache. Physical page 0 is the reserved scratch sink (idle
+    decode slots' masked garbage writes land there) and is never
+    allocatable.
+
+    Ownership discipline: every holder of a page carries exactly one
+    reference — each slot block-table entry is one hold, and the radix
+    prefix cache (serving/radix.py) takes its OWN hold per cached node.
+    A page returns to the free list exactly when its count reaches 0,
+    so there is no "cached but refcount 0" special case to reconcile at
+    release time (the flat prefix cache's `_page_key` membership test)."""
+
+    def __init__(self, n_pages: int):
+        self.n_pages = n_pages
+        self.free = list(range(1, n_pages))  # page 0 = scratch
+        self.ref = [0] * n_pages
+
+    def alloc(self) -> Optional[int]:
+        """A free page with its first reference, or None when dry (the
+        caller escalates: radix eviction, then preemption)."""
+        if not self.free:
+            return None
+        pg = self.free.pop()
+        self.ref[pg] = 1
+        return pg
+
+    def incref(self, pg: int) -> None:
+        self.ref[pg] += 1
+
+    def decref(self, pg: int) -> int:
+        """Drop one hold; a count reaching 0 returns the page to the
+        free list. Returns the new count (callers assert-friendly)."""
+        n = self.ref[pg] = self.ref[pg] - 1
+        if n < 0:  # a double-release corrupts the pool silently later;
+            # fail at the exact site instead
+            raise AssertionError(f"page {pg} refcount went negative")
+        if n == 0:
+            self.free.append(pg)
+        return n
+
+    @property
+    def n_free(self) -> int:
+        return len(self.free)
 
 
 # ---------------------------------------------------------------------------

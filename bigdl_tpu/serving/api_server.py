@@ -124,9 +124,13 @@ class ApiServer:
         whisper=None,  # (WhisperConfig, params) enables /v1/audio/*
         whisper_tokenizer=None,
         embedder=None,  # (BertConfig, params, tokenizer): /v1/embeddings
-        paged: bool = False,  # paged KV pool + prefix caching (kvpaged.py)
+        paged: bool = False,  # paged KV pool + radix prefix caching
+        # (kvpaged.py, serving/radix.py)
         page_size: int = 64,
         n_pages=None,
+        prefill_chunk_tokens=None,  # paged: bound the decode stall a
+        # long arriving prompt can inflict to one chunk of this many
+        # tokens (docs/serving.md §6); None = monolithic prefill
         speculative: bool = False,  # in-engine draft-K-then-verify
         draft_params=None,  # None = sym_int4 self-draft of the model
         draft_k: int = 4,
@@ -167,6 +171,7 @@ class ApiServer:
         self.engine = InferenceEngine(
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, page_size=page_size, n_pages=n_pages,
+            prefill_chunk_tokens=prefill_chunk_tokens,
             speculative=speculative, draft_params=draft_params,
             draft_k=draft_k, adaptive_draft=adaptive_draft,
             truncate_prompts=truncate_prompts,

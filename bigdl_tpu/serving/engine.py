@@ -131,6 +131,27 @@ class _Preempted:
     n_pages: int = 0  # paged: pages to reallocate on resume
 
 
+@dataclasses.dataclass
+class _PrefillState:
+    """A request mid-chunked-prefill: it owns its slot and its fully
+    allocated page table, but `active` stays False (no decode) and the
+    engine's block-table row stays pointed at the scratch page until
+    the last chunk lands — idle-slot garbage decode writes must never
+    reach the half-filled (possibly shared) real pages. Chunks write
+    through `row` directly (the jitted prefill takes its own block-
+    table argument)."""
+
+    req: Request
+    slot: int
+    row: "np.ndarray"  # the slot's REAL block-table row
+    written: int  # prompt tokens whose KV is in the pool (incl. cache
+    # hits + the sub-page copy)
+    path: list  # the matched radix nodes (root-first) — kept so the
+    # final chunk registers under them without re-walking the tree;
+    # they cannot be evicted meanwhile (the slot holds their pages)
+    chunk: int  # token budget per chunk
+
+
 class InferenceEngine:
     """model: a TpuModel (api.py). Sampling params (do_sample /
     temperature / top-k / top-p / eos) are PER REQUEST: they ride the
@@ -157,6 +178,12 @@ class InferenceEngine:
         # alternatives per emitted token (OpenAI top_logprobs); static
         # so the top-k pass compiles only into engines that opt in
         quantize_kv: bool = False,
+        prefill_chunk_tokens: Optional[int] = None,  # paged only: split
+        # prompt prefill into chunks of at most this many tokens and
+        # advance AT MOST ONE chunk of ONE prefilling request per
+        # step() — a 32k prompt arriving mid-decode then bounds the
+        # running batch's inter-token stall by one chunk instead of one
+        # prompt (docs/serving.md §6). None = monolithic prefill.
         journal: Optional[str] = None,
         # ---- overload protection (docs/serving.md) ----
         max_queue: Optional[int] = None,  # bound on waiting submits;
@@ -280,28 +307,65 @@ class InferenceEngine:
         # +1: physical page 0 is the reserved scratch sink, so the default
         # pool still covers every slot at full logical length
         self.n_pages = n_pages or n_slots * self.max_pages_per_row + 1
+        if prefill_chunk_tokens is not None:
+            if not paged:
+                raise ValueError(
+                    "prefill_chunk_tokens requires paged=True (chunks "
+                    "write straight into the shared page pool)"
+                )
+            if prefill_chunk_tokens < 1:
+                raise ValueError(
+                    f"prefill_chunk_tokens must be >= 1, got "
+                    f"{prefill_chunk_tokens}"
+                )
+            if speculative:
+                # the draft pool's _admit_draft prefill is monolithic
+                # (full prompt through the draft model at activation) —
+                # it would break the one-chunk stall bound this knob
+                # promises. Refuse honestly instead of jittering
+                # silently; chunking the draft admission is the
+                # follow-up.
+                raise NotImplementedError(
+                    "prefill_chunk_tokens is not wired through the "
+                    "speculative draft admission yet; use "
+                    "speculative=False or monolithic prefill"
+                )
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        # prefill invocations (each chunk is one; a monolithic prefill
+        # counts 1) — bigdl_tpu_prefill_chunks_total
+        self.prefill_chunks = 0
+        # the at-most-one request currently mid-chunked-prefill: it
+        # holds its slot and pages but is NOT decoded (active stays
+        # False) until its last chunk lands. Engine-thread only.
+        self._prefilling: Optional[_PrefillState] = None
         if paged:
             # physical page 0 is the scratch sink: idle slots still run
             # the decode step (static-shape price) and their masked
             # garbage writes go through their block tables — released
             # slots point every entry at page 0 so they can never corrupt
             # pages reallocated to live requests
-            self._free_pages = list(range(1, self.n_pages))
-            self._page_ref = [0] * self.n_pages
+            from bigdl_tpu import kvpaged
+            from bigdl_tpu.serving.radix import RadixPrefixCache
+
+            # refcounted page accounting: one hold per slot block-table
+            # entry + one per cached radix node (kvpaged.PagePool);
+            # _free_pages/_page_ref stay as live views of the pool's
+            # lists (metrics.py and the sim driver read them)
+            self._pool = kvpaged.PagePool(self.n_pages)
+            self._free_pages = self._pool.free
+            self._page_ref = self._pool.ref
+            # radix-tree prefix cache (serving/radix.py): full-page
+            # descent + mid-page divergence match + leaf-first LRU
+            # eviction; replaced the flat tuple(prefix)-hash cache
+            self.radix = RadixPrefixCache(page_size, self._pool)
             self._slot_pages: list[list[int]] = [[] for _ in range(n_slots)]
             self._slot_written: list[int] = [0] * n_slots  # logical slots covered
-            self._prefix_cache: dict[Any, int] = {}  # chunk key -> page
-            self._page_key: dict[int, Any] = {}  # reverse map for eviction
-            self._prefix_lru: list[Any] = []  # keys, oldest first
-            # parent prefix -> child keys one page deeper: the sub-page
-            # match scans only the shared run's direct children instead
-            # of the whole cache (O(children) per admission)
-            self._prefix_children: dict[Any, set] = {}
             self.prefix_hits = 0
             # sub-page sharing: cached-page KV copied instead of
             # re-prefilled when a prefix diverges mid-page
             self.prefix_partial_hits = 0
             self.prefix_tokens_reused = 0
+            self.prefix_evictions = 0  # radix leaves dropped for pages
             self._bt_host = np.zeros(
                 (n_slots, self.max_pages_per_row), np.int32
             )
@@ -1019,39 +1083,23 @@ class InferenceEngine:
 
     # ---- paged page management -------------------------------------------
 
-    def _prompt_key(self, prefix: list[int]):
-        # the tuple ITSELF, not its hash: dict equality then compares the
-        # actual tokens, so constructible hash collisions cannot alias two
-        # prompts onto one KV page (cross-request content leakage)
-        return tuple(prefix)
-
     def _alloc_page(self) -> Optional[int]:
-        """A free page, evicting the LRU unreferenced prefix-cache page
-        when the free list is dry."""
+        """A free page, evicting LRU radix leaves (serving/radix.py)
+        while the free list is dry. Eviction only ever drops nodes
+        whose page no slot holds, so it composes with preemption: the
+        escalation order is free list -> cache eviction -> host-RAM
+        swap-out (_alloc_page_preempting)."""
         if self._faults.fire("alloc_page") is not None:
             return None  # injected pool exhaustion (serving/faults.py)
-        if self._free_pages:
-            pg = self._free_pages.pop()
-            self._page_ref[pg] = 1
-            return pg
-        for key in list(self._prefix_lru):
-            pg = self._prefix_cache[key]
-            if self._page_ref[pg] == 0:
-                del self._prefix_cache[key]
-                self._prefix_lru.remove(key)
-                del self._page_key[pg]
-                kids = self._prefix_children.get(key[:-self.page_size])
-                if kids:
-                    kids.discard(key)
-                self._page_ref[pg] = 1
-                return pg
-        return None
+        pg = self._pool.alloc()
+        while pg is None and self.radix.evict_one():
+            self.prefix_evictions += 1
+            pg = self._pool.alloc()
+        return pg
 
     def _release_slot_pages(self, slot: int) -> None:
         for pg in self._slot_pages[slot]:
-            self._page_ref[pg] -= 1
-            if self._page_ref[pg] == 0 and pg not in self._page_key:
-                self._free_pages.append(pg)
+            self._pool.decref(pg)  # frees on 0; cached nodes keep theirs
         self._slot_pages[slot] = []
         self._slot_written[slot] = 0
         self._slot_pos[slot] = 0
@@ -1064,53 +1112,49 @@ class InferenceEngine:
         )
 
     def _admit_paged(self, req: Request, slot: int) -> bool:
-        """Tail-truncate, reuse cached full-page prompt prefixes (storage
-        AND prefill compute), allocate fresh pages for the tail, prefill
-        straight into the pool. False = not enough pages; retry later."""
+        """Tail-truncate, reuse the longest cached prompt prefix from
+        the radix tree (storage AND prefill compute, at any split
+        point: full pages by descent, a mid-page divergence via the
+        page-copy path), allocate fresh pages for the whole remainder,
+        then prefill — monolithically, or as a chunk plan the step loop
+        advances one chunk at a time (prefill_chunk_tokens). False =
+        not enough pages; retry later."""
         page = self.page_size
         limit = self.max_len - req.max_new_tokens
         if len(req.prompt) > limit:
             req.prompt = req.prompt[-limit:]
         prompt = req.prompt
 
-        # longest run of cached full pages, leaving >= 1 tail token
-        shared: list[int] = []
-        while (len(shared) + 1) * page <= len(prompt) - 1:
-            key = self._prompt_key(prompt[: (len(shared) + 1) * page])
-            pg = self._prefix_cache.get(key)
-            if pg is None:
-                break
-            shared.append(pg)
+        # longest cached full-page run (O(prompt) incremental keys;
+        # matched nodes are LRU-refreshed in O(1) each)
+        path = self.radix.match(prompt)
+        shared = [nd.page for nd in path]
         n_hit = len(shared)
         lp = n_hit * page
         tail = prompt[lp:]
+        head_node = path[-1] if path else self.radix.root
 
-        # sub-page sharing: a cached page one level deeper whose tokens
-        # agree with our tail for t_copy tokens lets us COPY those KV
-        # slots instead of re-prefilling them (prefixes shorter than a
-        # page previously recomputed from scratch). Capped at
-        # len(tail)-1 so the last real token always prefills (its
-        # logits seed generation).
-        t_copy, src_page = 0, None
+        # sub-page sharing: the deepest matched node's child whose page
+        # agrees with our tail for t_copy tokens lets us COPY those KV
+        # slots instead of re-prefilling them. Capped at len(tail)-1 so
+        # the last real token always prefills (its logits seed
+        # generation).
+        t_copy, src_node = 0, None
         if len(tail) > 1:
-            head = tuple(prompt[:lp])
-            for key in self._prefix_children.get(head, ()):
-                pg = self._prefix_cache.get(key)
-                if pg is None:
-                    continue
-                m = 0
-                for a, b in zip(key[lp:], tail):
-                    if a != b:
-                        break
-                    m += 1
-                if m > t_copy:
-                    t_copy, src_page = m, pg
-            t_copy = min(t_copy, len(tail) - 1)
-            if t_copy == 0:
-                src_page = None
+            m, child = self.radix.match_partial(head_node, tail)
+            t_copy = min(m, len(tail) - 1)
+            src_node = child if t_copy > 0 else None
+            if src_node is None:
+                t_copy = 0
+        src_page = src_node.page if src_node is not None else None
 
         def plan(cut):
-            b = min(round_up(max(len(prompt) - lp - cut, 16), 32),
+            # 16-token bucket quantum (was 32): post-hit tails are
+            # short, and halving the pad floor halves the wasted
+            # prefill width a mid-page split pays — this is what makes
+            # sub-page reuse actually engage (the copy is skipped
+            # unless it shrinks the plan)
+            b = min(round_up(max(len(prompt) - lp - cut, 16), 16),
                     self.max_len - lp - cut)
             return b, -(-(lp + cut + b) // page) - n_hit
 
@@ -1121,7 +1165,7 @@ class InferenceEngine:
             # that doesn't shrink either is pure added latency (the
             # page-copy dispatch + LRU bookkeeping) — skip it
             if bucket >= bucket0 and need >= need0:
-                t_copy, src_page = 0, None
+                t_copy, src_page, src_node = 0, None, None
                 bucket, need = bucket0, need0
         else:
             t_copy = 0
@@ -1136,24 +1180,23 @@ class InferenceEngine:
             ))
             return True  # consumed (failed), keep admitting others
         # incref shared pages (and the sub-page copy source) BEFORE
-        # allocating fresh ones — _alloc_page's LRU eviction must not
-        # evict a page out of this very request's prefix (refcount 0
-        # pages are fair eviction game)
+        # allocating fresh ones — _alloc_page's radix eviction must not
+        # evict a page out of this very request's prefix (cache-only
+        # holds are fair eviction game)
         for pg in shared:
-            self._page_ref[pg] += 1
+            self._pool.incref(pg)
         if src_page is not None:
-            self._page_ref[src_page] += 1
+            self._pool.incref(src_page)
         fresh: list[int] = []
         for _ in range(need):
             pg = self._alloc_page()
             if pg is None:  # out of pages: roll back, retry next step
                 for q in fresh:
-                    self._page_ref[q] = 0
-                    self._free_pages.append(q)
+                    self._pool.decref(q)
                 for q in shared:
-                    self._page_ref[q] -= 1
+                    self._pool.decref(q)
                 if src_page is not None:
-                    self._page_ref[src_page] -= 1
+                    self._pool.decref(src_page)
                 return False
             fresh.append(pg)
         # admission is committed from here on (every later path prefills
@@ -1161,11 +1204,6 @@ class InferenceEngine:
         self._mark_admitted(req)
         if n_hit:
             self.prefix_hits += 1
-            for key in (self._prompt_key(prompt[: (i + 1) * page])
-                        for i in range(n_hit)):
-                if key in self._prefix_lru:  # refresh LRU position
-                    self._prefix_lru.remove(key)
-                    self._prefix_lru.append(key)
 
         table = shared + fresh
         self._slot_pages[slot] = table
@@ -1174,8 +1212,6 @@ class InferenceEngine:
         self._slot_written[slot] = len(table) * page
         row = np.zeros((self.max_pages_per_row,), np.int32)
         row[: len(table)] = table
-        self._bt_host[slot] = row
-        self._bt_dirty = True
 
         if src_page is not None:
             # copy the WHOLE source page (one static-shape program;
@@ -1184,14 +1220,29 @@ class InferenceEngine:
             self.cache = self._copy_page(
                 self.cache, jnp.asarray(src_page), jnp.asarray(fresh[0])
             )
-            self._page_ref[src_page] -= 1
+            self._pool.decref(src_page)
             self.prefix_partial_hits += 1
             self.prefix_tokens_reused += t_copy
-            src_key = self._page_key.get(src_page)
-            if src_key in self._prefix_lru:  # refresh: it just proved hot
-                self._prefix_lru.remove(src_key)
-                self._prefix_lru.append(src_key)
+            self.radix.touch(src_node)  # it just proved hot
 
+        chunk = self.prefill_chunk_tokens
+        if chunk is not None and len(tail2) > chunk:
+            # chunk plan: the slot is HELD (req set, active False, its
+            # engine block-table row left at the scratch page) and
+            # step() advances one chunk per iteration via
+            # _advance_prefill — decode of the running batch proceeds
+            # between chunks, so this prompt cannot stall it by more
+            # than one chunk
+            self._slots[slot] = _Slot(req=req, seq=next(self._seq))
+            self._prefilling = _PrefillState(
+                req=req, slot=slot, row=row, written=lp_eff,
+                path=path, chunk=chunk,
+            )
+            return True
+
+        self._bt_host[slot] = row
+        self._bt_dirty = True
+        self.prefill_chunks += 1
         toks = np.full((1, bucket), self.gen.pad_token_id, np.int32)
         toks[0, : len(tail2)] = tail2  # RIGHT pad: writes past pos get
         # overwritten by decode and are masked meanwhile
@@ -1208,15 +1259,7 @@ class InferenceEngine:
         )
         self._slot_pos[slot] = len(prompt)
 
-        # register the prompt's fully-covered fresh pages for future reuse
-        for i in range(n_hit, (len(prompt)) // page):
-            key = self._prompt_key(prompt[: (i + 1) * page])
-            if key not in self._prefix_cache:
-                self._prefix_cache[key] = table[i]
-                self._page_key[table[i]] = key
-                self._prefix_lru.append(key)
-                self._prefix_children.setdefault(key[:i * page], set()
-                                                 ).add(key)
+        self._register_prefix(prompt, path, table)
 
         if self.speculative:
             # prefix-cache hits only save TARGET prefill; the draft
@@ -1225,6 +1268,63 @@ class InferenceEngine:
 
         self._activate(slot, req, logits_last[None])
         return True
+
+    def _register_prefix(self, prompt: list[int], path: list,
+                         table: list[int]) -> None:
+        """Register the prompt's fully-covered pages past the matched
+        run as radix nodes (the cache takes its own page reference).
+        An existing edge keeps its canonical page — our duplicate stays
+        slot-only and frees at release."""
+        page = self.page_size
+        node = path[-1] if path else self.radix.root
+        for i in range(len(path), len(prompt) // page):
+            key = tuple(prompt[i * page: (i + 1) * page])
+            nxt = node.children.get(key)
+            if nxt is None:
+                nxt = self.radix.insert(node, key, table[i])
+            node = nxt
+
+    def _advance_prefill(self) -> None:
+        """Run AT MOST ONE chunk of the at-most-one in-flight chunked
+        prefill: the per-step decode stall a new prompt can inflict is
+        bounded by one chunk. The final chunk installs the real block
+        table, registers radix nodes, and activates the slot (first
+        token emits — TTFT closes here)."""
+        st = self._prefilling
+        if st is None:
+            return
+        prompt = st.req.prompt
+        rem = len(prompt) - st.written
+        n = min(st.chunk, rem)
+        last = n == rem
+        bucket = min(round_up(max(n, 16), 16), self.max_len - st.written)
+        toks = np.full((1, bucket), self.gen.pad_token_id, np.int32)
+        toks[0, :n] = prompt[st.written: st.written + n]
+        self.prefill_chunks += 1
+        logits_last, k, v, ks, vs = self._paged_prefill(
+            self.model.params, self.cache.k, self.cache.v,
+            self.cache.k_scale, self.cache.v_scale,
+            jnp.asarray(st.row[None]), jnp.asarray([st.written], jnp.int32),
+            jnp.asarray(toks), jnp.asarray(n - 1),
+        )
+        self.cache = dataclasses.replace(
+            self.cache, k=k, v=v, k_scale=ks, v_scale=vs,
+        )
+        st.written += n
+        if not last:
+            return
+        slot = st.slot
+        self._prefilling = None
+        self._bt_host[slot] = st.row
+        self._bt_dirty = True
+        self.cache = dataclasses.replace(
+            self.cache,
+            pos=self.cache.pos.at[slot].set(len(prompt)),
+            start=self.cache.start.at[slot].set(0),
+        )
+        self._slot_pos[slot] = len(prompt)
+        self._register_prefix(prompt, st.path, self._slot_pages[slot])
+        self._activate(slot, st.req, logits_last[None])
 
     def _admit_draft(self, slot: int, prompt: list[int], limit: int) -> None:
         """Left-pad-prefill the speculative draft pool's row for a newly
@@ -1288,10 +1388,31 @@ class InferenceEngine:
             if victim is not None:
                 self._preempt_slot(victim)
                 continue
+            if self._abort_prefill_for_pages():
+                continue  # the chunk plan yielded its pages
             s = self._slots[slot]
             if s.resumed_pos < 0 or self._slot_pos[slot] > s.resumed_pos:
                 self._preempt_slot(slot)  # caller sees the slot inactive
             return None
+
+    def _abort_prefill_for_pages(self) -> bool:
+        """Yield a mid-chunked-prefill plan's pages to allocation
+        pressure: a decoding stream must not be truncated (nor a
+        parked request failed) while an inactive chunk plan sits on
+        the very pages it needs. The plan has no decode state yet, so
+        'preempting' it is simply releasing its slot and putting the
+        request back at the queue's FRONT (it was the most recent pop
+        — FIFO order is preserved); prefill restarts later from
+        whatever the cache still covers, and output is unaffected
+        because nothing was emitted. The re-wait is not re-counted in
+        queue_wait (admit_ts stays from the first admission)."""
+        st = self._prefilling
+        if st is None:
+            return False
+        self._free_slot_state(st.slot)  # releases pages + clears plan
+        with self._queue.mutex:  # raw deque surgery, _sweep_queue style
+            self._queue.queue.appendleft(st.req)
+        return True
 
     def _pick_victim(self, exclude: int) -> Optional[int]:
         """Victim slot per policy. youngest = most recently (re)admitted:
@@ -1300,7 +1421,9 @@ class InferenceEngine:
         never chosen while anyone else is active, so it always completes
         and frees its pages."""
         cands = [(s.seq, i) for i, s in enumerate(self._slots)
-                 if s.req is not None and i != exclude]
+                 if s.req is not None and i != exclude
+                 and self.active[i]]  # a mid-chunked-prefill slot has
+        # no resumable decode state to swap; it is never a victim
         if not cands:
             return None
         pick = max(cands) if self.preemption_policy == "youngest" \
@@ -1372,8 +1495,7 @@ class InferenceEngine:
                 pg = self._alloc_page()
                 if pg is None:  # roll back; retry when pages free up
                     for q in fresh:
-                        self._page_ref[q] = 0
-                        self._free_pages.append(q)
+                        self._pool.decref(q)
                     return False
                 fresh.append(pg)
             self._slot_pages[slot] = fresh
@@ -1462,7 +1584,11 @@ class InferenceEngine:
         # land in the fresh set for the next step
         pending, self._preempt_requested = self._preempt_requested, set()
         for i, s in enumerate(self._slots):
-            if s.req is not None and s.req.rid in pending:
+            if (s.req is not None and s.req.rid in pending
+                    and self.active[i]):
+                # mid-chunked-prefill slots are skipped like queued
+                # requests: no decode state exists to park yet (the
+                # marker drops; re-request once decoding)
                 self._preempt_slot(i)
 
     # ---- admission --------------------------------------------------------
@@ -1685,6 +1811,7 @@ class InferenceEngine:
         tokens = np.full((1, bucket), self.gen.pad_token_id, np.int32)
         tokens[0, bucket - len(req.prompt):] = req.prompt
         pad = bucket - len(req.prompt)
+        self.prefill_chunks += 1  # a monolithic prefill is one chunk
         logits_last, pcache = self._prefill(
             self.model.params, jnp.asarray(tokens),
             jnp.asarray([pad], jnp.int32), bucket=bucket,
@@ -1713,9 +1840,12 @@ class InferenceEngine:
                 if self._resume_preempted(entry, slot):
                     self._preempted.popleft()
                     continue
-                if not self.active.any():
+                if not self.active.any() and self._prefilling is None:
                     # nothing left to free pages: the pool cannot hold
-                    # the restore, ever — fail instead of hanging
+                    # the restore, ever — fail instead of hanging. A
+                    # live chunk plan is future page supply (its slot
+                    # activates, decodes, and frees), so the resume
+                    # waits it out rather than failing spuriously.
                     self._preempted.popleft()
                     self._fail_request(req, (
                         f"cannot resume preempted request: restoring "
@@ -1724,6 +1854,13 @@ class InferenceEngine:
                     ))
                     continue
                 return  # wait for pages before admitting anything newer
+            if self._prefilling is not None:
+                # at most ONE request prefills at a time: admitting
+                # another would either stack a second monolithic
+                # prefill into this step (the stall chunking bounds) or
+                # need a second chunk plan — queued work waits the few
+                # steps until the current plan lands
+                return
             req = self._pop_request()
             if req is None:
                 return
@@ -1842,6 +1979,13 @@ class InferenceEngine:
     def _free_slot_state(self, slot: int) -> None:
         """Release a slot's engine-side state (sampling rows, pages)
         without touching the request's terminal fields."""
+        if (self._prefilling is not None
+                and self._prefilling.slot == slot):
+            # the request died mid-chunked-prefill (cancel / deadline /
+            # fail_all): every finish path funnels through here, so
+            # clearing the plan here is what guarantees no orphaned
+            # chunk ever runs for a freed slot
+            self._prefilling = None
         self._slots[slot] = _Slot()
         self.active[slot] = False
         self._dosample[slot] = False  # idle rows decode deterministic garbage
@@ -1863,16 +2007,21 @@ class InferenceEngine:
         self._penalty[:] = 1.0
         self.active[:] = False
         self._preempted.clear()  # blobs reference the old pool's layout
+        self._prefilling = None  # a half-run chunk plan died with the pool
         if self.paged:
-            self._free_pages = list(range(1, self.n_pages))  # 0 = scratch
-            self._page_ref = [0] * self.n_pages
+            from bigdl_tpu import kvpaged
+            from bigdl_tpu.serving.radix import RadixPrefixCache
+
+            # rebuild pool + radix together (cached nodes reference the
+            # old pool's pages); hit/eviction counters survive — they
+            # are engine totals, not cache state
+            self._pool = kvpaged.PagePool(self.n_pages)
+            self._free_pages = self._pool.free
+            self._page_ref = self._pool.ref
+            self.radix = RadixPrefixCache(self.page_size, self._pool)
             self._slot_pages = [[] for _ in range(self.n_slots)]
             self._slot_written = [0] * self.n_slots
             self._slot_pos = [0] * self.n_slots
-            self._prefix_cache.clear()
-            self._page_key.clear()
-            self._prefix_lru.clear()
-            self._prefix_children.clear()
             self._bt_host[:] = 0
             self._bt_dirty = True
 
@@ -2059,6 +2208,7 @@ class InferenceEngine:
         self._sweep_preempted()
         self._sweep_queue()
         self._admit()
+        self._advance_prefill()  # at most one chunk per step
         if self.paged:
             # reserve for the CURRENT ladder K (== draft_k when not
             # adaptive): after a downshift the round writes at most
@@ -2073,7 +2223,8 @@ class InferenceEngine:
                 self._bt_dirty = False
         if not self.active.any():
             return (not self._queue.empty() or self._waiting is not None
-                    or bool(self._preempted))
+                    or bool(self._preempted)
+                    or self._prefilling is not None)
         self._rng, k = jax.random.split(self._rng)
         if self.speculative:
             return self._step_speculative(k)
@@ -2316,6 +2467,22 @@ class InferenceEngine:
         """Engine age in its own clock domain (simulated clocks report
         simulated uptime — by design)."""
         return max(self._clock() - self._t_start, 0.0)
+
+    def page_leaks(self) -> int:
+        """Pages whose refcount disagrees with their accounted holders
+        (slot block tables + radix cache nodes) plus any page neither
+        free nor held at all. 0 is the invariant; the sim report and
+        the chaos tests gate on it at drain."""
+        if not self.paged:
+            return 0
+        held = [0] * self.n_pages
+        for pages in self._slot_pages:
+            for pg in pages:
+                held[pg] += 1
+        for node in self.radix.nodes():
+            held[node.page] += 1
+        return sum(1 for pg in range(1, self.n_pages)
+                   if self._page_ref[pg] != held[pg])
 
     def kv_utilization(self) -> float:
         """Fraction of the KV pool holding live state: allocated pages

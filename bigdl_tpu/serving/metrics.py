@@ -335,6 +335,15 @@ class Metrics:
                 "preempted requests' host-RAM parked time until resume "
                 "(not folded into queue_wait)",
             )
+            lines += [
+                # chunked prefill (docs/serving.md §6): one count per
+                # prefill dispatch — a monolithic prefill is 1 chunk
+                "# HELP bigdl_tpu_prefill_chunks_total prefill chunks "
+                "dispatched (monolithic prefill counts 1)",
+                "# TYPE bigdl_tpu_prefill_chunks_total counter",
+                f"bigdl_tpu_prefill_chunks_total "
+                f"{self.engine.prefill_chunks}",
+            ]
             if self.engine.paged:
                 lines += [
                     "# HELP bigdl_tpu_free_pages allocatable KV pages",
@@ -354,6 +363,16 @@ class Metrics:
                     "# TYPE bigdl_tpu_prefix_tokens_reused_total counter",
                     f"bigdl_tpu_prefix_tokens_reused_total "
                     f"{self.engine.prefix_tokens_reused}",
+                    # radix prefix cache (serving/radix.py)
+                    "# HELP bigdl_tpu_prefix_evictions_total radix "
+                    "cache leaves evicted for page pressure",
+                    "# TYPE bigdl_tpu_prefix_evictions_total counter",
+                    f"bigdl_tpu_prefix_evictions_total "
+                    f"{self.engine.prefix_evictions}",
+                    "# HELP bigdl_tpu_radix_nodes cached prefix pages "
+                    "(radix tree nodes)",
+                    "# TYPE bigdl_tpu_radix_nodes gauge",
+                    f"bigdl_tpu_radix_nodes {self.engine.radix.n_nodes}",
                 ]
             if self.engine.speculative:
                 lines += [
@@ -414,6 +433,7 @@ _ENGINE_FAMILIES = (
     "bigdl_tpu_prefill_seconds",
     "bigdl_tpu_decode_step_seconds",
     "bigdl_tpu_resume_wait_seconds",
+    "bigdl_tpu_prefill_chunks_total",
 )
 
 _PAGED_FAMILIES = (
@@ -421,6 +441,8 @@ _PAGED_FAMILIES = (
     "bigdl_tpu_prefix_hits_total",
     "bigdl_tpu_prefix_partial_hits_total",
     "bigdl_tpu_prefix_tokens_reused_total",
+    "bigdl_tpu_prefix_evictions_total",
+    "bigdl_tpu_radix_nodes",
 )
 
 _SPEC_FAMILIES = (

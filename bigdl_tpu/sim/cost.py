@@ -146,6 +146,26 @@ class CostModel:
                              lin["flops"] + att["flops"]) \
             + self.step_overhead_s
 
+    def suggest_prefill_chunk(self, occupancy: int = 4,
+                              context_tokens: int = 1024,
+                              decode_steps: float = 4.0,
+                              page: Optional[int] = None) -> int:
+        """The roofline-derived `prefill_chunk_tokens` default
+        (docs/serving.md §6): the largest page-multiple chunk whose
+        prefill charge stays within ~`decode_steps` decode steps of a
+        batch at `occupancy` rows around `context_tokens` of context —
+        so an arriving long prompt stalls the running batch's streams
+        by a few tokens' worth of time per chunk, never by the whole
+        prompt."""
+        page = page or self.page_size
+        rows = [context_tokens] * max(occupancy, 1)
+        target = decode_steps * self.decode_step_s(rows, page)
+        chunk = page
+        while (self.prefill_s(chunk * 2, prior_tokens=context_tokens)
+               <= target):
+            chunk *= 2
+        return chunk
+
     def kv_copy_s(self, tokens: int) -> float:
         """HBM->HBM KV move (prefill-insert, sub-page prefix copy)."""
         nbytes = 2 * tokens * self.kv_token_bytes()  # read + write

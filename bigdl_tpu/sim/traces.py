@@ -237,7 +237,15 @@ def named_trace(name: str, seed: int = 0) -> Trace:
     if name == "bursty":
         return bursty_trace(rate_on_rps=20.0, n_requests=40, seed=seed)
     if name == "prefix-heavy":
-        return prefix_heavy_trace(rate_rps=8.0, n_requests=40, seed=seed)
+        # long shared system prompts (up to 6 pages at the sim's
+        # page_size 16) cut at page-aligned AND mid-page points: the
+        # radix workload — full-page descent, sub-page copy, and (with
+        # the scenario's bounded pool) leaf eviction all fire
+        return prefix_heavy_trace(
+            rate_rps=12.0, n_requests=40, seed=seed, n_prefixes=4,
+            split_points=(24, 48, 72, 96), tail_len=(4, 16),
+            out_tokens=(4, 16),
+        )
     if name == "overload":
         return poisson_trace(
             rate_rps=40.0, n_requests=48, seed=seed, name="overload",

@@ -80,16 +80,33 @@ over = run_scenario("overload", seed=0, model=m)
 for name, r in (("prefix-heavy", pref), ("overload", over)):
     p99 = r["latency"]["ttft_s"]["p99"]
     assert p99 and math.isfinite(p99), (name, "TTFT p99 not finite", p99)
+    itl99 = r["latency"]["itl_s"]["p99"]
+    assert itl99 and math.isfinite(itl99), (name, "ITL p99 not finite", itl99)
     assert r["kv"]["page_leak_at_drain"] == 0, (name, "page leak at drain")
     assert sum(r["counters"]["finish_reasons"].values()) == r["trace"]["n_requests"]
 assert over["rates"]["shed_rate"] > 0, "overload trace must shed"
 assert over["counters"]["preemptions"] > 0, "overload trace must preempt"
+# chunked prefill on in the overload mix: more chunk dispatches than
+# admissions proves chunks genuinely interleave (ISSUE 14)
+assert over["counters"]["prefill_chunks"] > over["trace"]["n_requests"] - \
+    over["counters"]["requests_shed"], "overload must chunk its prefills"
 assert pref["kv"]["prefix_hits"] > 0, "prefix-heavy trace must hit the cache"
-print("sim smoke: prefix-heavy %.0f tok/s (%d cache hits), "
-      "overload shed_rate %.2f, preemptions %d" % (
+# radix reuse above the flat full-page-cache baseline on this exact
+# trace+pool (banked pre-radix, PR 14: 30 hits / 16 tokens via copy) —
+# mid-page splits and leaf-first eviction must keep clearing it
+hit_rate = pref["kv"]["prefix_hits"] / pref["trace"]["n_requests"]
+assert hit_rate > 30 / 40, f"radix hit-rate {hit_rate} <= full-page baseline"
+assert pref["kv"]["prefix_tokens_reused"] > 16, \
+    "mid-page (sub-page) reuse regressed to the full-page baseline"
+print("sim smoke: prefix-heavy %.0f tok/s (%d hits, %d tokens reused, "
+      "%d evictions), overload shed_rate %.2f, preemptions %d, "
+      "prefill_chunks %d, itl p99 %.4fs" % (
           pref["throughput"]["output_tokens_per_s"],
-          pref["kv"]["prefix_hits"],
-          over["rates"]["shed_rate"], over["counters"]["preemptions"]))
+          pref["kv"]["prefix_hits"], pref["kv"]["prefix_tokens_reused"],
+          pref["kv"]["prefix_evictions"],
+          over["rates"]["shed_rate"], over["counters"]["preemptions"],
+          over["counters"]["prefill_chunks"],
+          over["latency"]["itl_s"]["p99"]))
 PY
   echo "CORE OK"
   exit 0

@@ -121,8 +121,9 @@ def test_pages_released_and_reused(model):
         assert len(out[0]) == 6
     # after the last finish, non-cached pages returned to the free list
     # (page 0 is the reserved scratch sink, so 5 allocatable)
-    in_cache = len(eng._page_key)
+    in_cache = eng.radix.n_nodes
     assert len(eng._free_pages) + in_cache == 5
+    assert eng.page_leaks() == 0
 
 
 def test_long_decode_grows_pages_without_drift(model):
@@ -277,10 +278,9 @@ def test_speculative_paged_page_accounting(model):
     for i in range(3):  # reuse the pool across rounds
         out = _run(eng, [[1 + i, 2, 3, 4, 5]], maxnt=10)
         assert len(out[0]) == 10
-    in_cache = len(eng._page_key)
+    in_cache = eng.radix.n_nodes
     assert len(eng._free_pages) + in_cache == 7  # page 0 = scratch
-    assert all(r == 0 for pg, r in enumerate(eng._page_ref)
-               if pg not in eng._page_key and pg != 0)
+    assert eng.page_leaks() == 0
 
 
 def test_speculative_paged_prefix_cache_composes(model):
@@ -456,5 +456,6 @@ def test_no_page_leak_under_cancel_rounds(model):
         for r in rs:
             eng.cancel(r)
         eng.run_until_idle()
-        assert len(eng._free_pages) + len(eng._page_key) == free0
+        assert len(eng._free_pages) + eng.radix.n_nodes == free0
+        assert eng.page_leaks() == 0
         assert not [r for r in eng._page_ref[1:] if r < 0]

@@ -123,7 +123,8 @@ def test_nan_logits_paged_releases_pages(model):
     inj.arm("nan_logits", times=1)
     eng.run_until_idle()
     assert r.done and r.finish_reason == "error"
-    assert len(eng._free_pages) + len(eng._page_key) == free0
+    assert len(eng._free_pages) + eng.radix.n_nodes == free0
+    assert eng.page_leaks() == 0
 
 
 # ---------------------------------------------------------------------------
@@ -376,7 +377,8 @@ def test_chaos_sweep_survives_every_fault_class(model, tmp_path):
             assert len(r.out_tokens) == 30, (
                 f"'{r.finish_reason}' after {len(r.out_tokens)} tokens"
             )
-    assert len(eng._free_pages) + len(eng._page_key) == free0
+    assert len(eng._free_pages) + eng.radix.n_nodes == free0
+    assert eng.page_leaks() == 0
     assert not eng._preempted and not eng.active.any()
     # still serving after the sweep
     tail = eng.submit([5, 6], max_new_tokens=4)

@@ -35,13 +35,11 @@ def model():
 
 
 def _pages_balanced(eng) -> bool:
-    """Every page is either free, prefix-cached, or the scratch page."""
-    ok = len(eng._free_pages) + len(eng._page_key) == eng.n_pages - 1
-    refs_ok = all(
-        r == 0 for pg, r in enumerate(eng._page_ref)
-        if pg != 0 and pg not in eng._page_key
-    )
-    return ok and refs_ok
+    """Every page is either free, radix-cached, or the scratch page,
+    and every refcount matches its accounted holders."""
+    ok = (len(eng._free_pages) + eng.radix.n_nodes
+          == eng.n_pages - 1)
+    return ok and eng.page_leaks() == 0
 
 
 # ---------------------------------------------------------------------------

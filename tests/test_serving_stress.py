@@ -209,7 +209,8 @@ def test_full_feature_composition_torture(server, tmp_path):
     eng.run_until_idle()
     assert all(r.done for r in reqs)
     assert not [r.error for r in reqs if r.error]
-    assert len(eng._free_pages) + len(eng._page_key) == free0
+    assert len(eng._free_pages) + eng.radix.n_nodes == free0
+    assert eng.page_leaks() == 0
     eng2 = InferenceEngine(model, n_slots=2, max_len=96, paged=True,
                            page_size=8, journal=jpath)
     assert len(eng2.recovered_requests) == 0  # all tombstoned
