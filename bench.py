@@ -644,7 +644,7 @@ def child_sim() -> dict:
                         "sym_int4 cost model (sim/cost.py), seed 0",
         }
 
-    for name in ("poisson", "prefix-heavy", "overload"):
+    for name in ("poisson", "prefix-heavy", "overload", "adapter-zipf"):
         # each mix compiles its own tiny-llama engine programs (~25 s
         # on CPU); leave headroom or bank what we have
         if child_budget - (time.time() - T0) < 40:
@@ -670,6 +670,9 @@ def child_sim() -> dict:
             "prefix_hits": r["kv"].get("prefix_hits", 0),
             "prefix_tokens_reused": r["kv"].get("prefix_tokens_reused", 0),
             "prefix_evictions": r["kv"].get("prefix_evictions", 0),
+            # multi-tenant LoRA registry churn (ISSUE 15)
+            "adapter_loads": r.get("adapters", {}).get("loads", 0),
+            "adapter_evictions": r.get("adapters", {}).get("evictions", 0),
         }
         log(f"sim {name}: {sweep[name]['tok_s']} tok/s, "
             f"ttft p99 {sweep[name]['ttft_p99_s']}s, "

@@ -126,6 +126,17 @@ def cmd_chat(args):
     via attention sinks. Families with custom cache adapters fall back
     to one-shot generation."""
     model = _load(args.model, args.qtype)
+    if args.adapter:
+        # one-tenant chat: fold the adapter into the loaded params
+        # (train/qlora.merge_lora) — the REPL serves a single user, so
+        # the multi-tenant epilogue machinery would be pure overhead
+        from bigdl_tpu.serving.adapters import load_adapter
+        from bigdl_tpu.train.qlora import merge_lora
+
+        lora, meta = load_adapter(args.adapter)
+        model.params = merge_lora(model.params, lora)
+        print(f"note: merged adapter {args.adapter} "
+              f"(rank {meta.get('rank')})", file=sys.stderr)
     tok = _tokenizer(args.model)
     history: list[dict] = []
 
@@ -235,6 +246,23 @@ def cmd_serve(args):
     gen = GenerationConfig(
         eos_token_id=(tok.eos_token_id if tok is not None else None)
     )
+    adapters = None
+    if args.adapter_dir or args.adapter_budget_mb or args.adapters:
+        # any adapter flag enables the registry: --adapter-budget-mb
+        # without a dir still serves explicit-path POST /adapters/load,
+        # and must not be silently ignored
+        from bigdl_tpu.serving.adapters import AdapterRegistry
+
+        adapters = AdapterRegistry(
+            dir=args.adapter_dir,
+            budget_bytes=(args.adapter_budget_mb * (1 << 20)
+                          if args.adapter_budget_mb else None),
+        )
+        for spec in args.adapters or []:
+            name, _, path = spec.partition("=")
+            desc = adapters.load(name, path=path or None, pin=True)
+            print(f"pinned adapter {desc['name']} (rank {desc['rank']})",
+                  file=sys.stderr)
     embedder = None
     if args.embedder:
         from bigdl_tpu.convert.hf import open_checkpoint
@@ -254,7 +282,7 @@ def cmd_serve(args):
         embedder=embedder, truncate_prompts=args.truncate_prompts,
         logprobs_top_k=args.logprobs_top_k,
         tracing=args.trace, trace_capacity=args.trace_capacity,
-        request_log=args.request_log,
+        request_log=args.request_log, adapters=adapters,
     )
     server.start()
     server.install_signal_handlers()  # SIGTERM -> drain, flush, exit 0
@@ -495,6 +523,77 @@ def cmd_trace(args):
               f"inspect {out['logdir']} with TensorBoard/XProf")
 
 
+def cmd_adapters(args):
+    """Multi-tenant LoRA adapter lifecycle (docs/serving.md §7) —
+    against a live server, or a local artifact:
+
+        bigdl-tpu adapters list   http://127.0.0.1:8000
+        bigdl-tpu adapters load   http://127.0.0.1:8000 my-tenant [--path p] [--pin]
+        bigdl-tpu adapters unload http://127.0.0.1:8000 my-tenant
+        bigdl-tpu adapters inspect path/to/adapter.npz
+
+    `inspect` verifies the artifact offline (full integrity mode) and
+    prints its rank/targets/size; the server actions drive the
+    registry's load/unload endpoints."""
+    if args.action == "inspect":
+        from bigdl_tpu.serving.adapters import load_adapter
+        from bigdl_tpu.utils.durability import IntegrityError
+
+        try:
+            lora, meta = load_adapter(args.target, verify="full")
+        except FileNotFoundError:
+            raise SystemExit(f"{args.target}: no such adapter artifact")
+        except IntegrityError as e:
+            # the whole point of inspect is catching this: report the
+            # structured finding and exit 1, like `bigdl-tpu verify`
+            raise SystemExit(f"FAILED {e}")
+        from bigdl_tpu.serving.adapters import lora_nbytes
+
+        print(json.dumps({
+            "path": args.target, "rank": meta.get("rank"),
+            "scale": meta.get("scale"), "targets": meta.get("targets"),
+            "nbytes": lora_nbytes(lora), "verified": "full",
+        }, indent=2))
+        return
+    import urllib.error
+    import urllib.request
+
+    base = args.target.rstrip("/")
+
+    def call(path, payload=None):
+        req = (base + path if payload is None else urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        ))
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            raise SystemExit(f"{base}{path} -> HTTP {e.code}: {body}")
+        except urllib.error.URLError as e:
+            raise SystemExit(f"cannot reach {base}{path}: {e.reason}")
+
+    if args.action == "list":
+        out = call("/adapters")
+        print(json.dumps(out, indent=2))
+    elif args.action == "load":
+        if not args.name:
+            raise SystemExit("adapters load needs a NAME")
+        payload = {"name": args.name, "pin": args.pin}
+        if args.path:
+            payload["path"] = args.path
+        out = call("/adapters/load", payload)
+        a = out["adapter"]
+        print(f"loaded {a['name']} (rank {a['rank']}, "
+              f"{a['nbytes']}B{', pinned' if a['pinned'] else ''})")
+    elif args.action == "unload":
+        if not args.name:
+            raise SystemExit("adapters unload needs a NAME")
+        out = call("/adapters/unload", {"name": args.name})
+        print(f"unloaded {out['adapter']['name']}")
+
+
 def cmd_simserve(args):
     """Simulated-clock serving benchmark (docs/benchmarking.md): drive
     the real engine with a seeded synthetic trace under a virtual clock
@@ -663,6 +762,22 @@ def main(argv=None):
                    help="append one derived-timings JSONL record per "
                         "finished request (queue wait, TTFT, "
                         "time-per-output-token, preempted time)")
+    s.add_argument("--adapter-dir", default=None,
+                   help="multi-tenant LoRA: directory of <name>.npz "
+                        "adapter artifacts; requests may then carry "
+                        '"adapter": "<name>" and the /adapters '
+                        "lifecycle endpoints come up (docs/serving.md §7)")
+    s.add_argument("--adapter-budget-mb", type=int, default=None,
+                   help="host-RAM budget for resident adapters; LRU "
+                        "eviction above it (default: unbounded; "
+                        "enables the registry even without "
+                        "--adapter-dir — load via POST /adapters/load "
+                        "with an explicit path)")
+    s.add_argument("--adapters", action="append", default=None,
+                   metavar="NAME[=PATH]",
+                   help="preload + pin an adapter at startup "
+                        "(repeatable; PATH defaults to "
+                        "<adapter-dir>/NAME.npz)")
     s.set_defaults(fn=cmd_serve)
 
     fw = sub.add_parser("fastchat-worker",
@@ -717,6 +832,9 @@ def main(argv=None):
                    help="attention-sink window: unbounded conversation "
                         "in constant memory")
     ch.add_argument("--streaming-sink", type=int, default=4)
+    ch.add_argument("--adapter", default=None,
+                    help="LoRA adapter artifact (.npz) merged into the "
+                         "model for this chat session")
     ch.set_defaults(fn=cmd_chat)
 
     v = sub.add_parser(
@@ -758,6 +876,26 @@ def main(argv=None):
                          "on the SERVER's filesystem")
     tr.set_defaults(fn=cmd_trace)
 
+    ad = sub.add_parser(
+        "adapters",
+        help="multi-tenant LoRA lifecycle: list/load/unload against a "
+             "live server, or inspect a local adapter artifact "
+             "(docs/serving.md §7)",
+    )
+    ad.add_argument("action",
+                    choices=("list", "load", "unload", "inspect"))
+    ad.add_argument("target",
+                    help="server base URL (list/load/unload) or an "
+                         "adapter .npz path (inspect)")
+    ad.add_argument("name", nargs="?", default=None,
+                    help="adapter name (load/unload)")
+    ad.add_argument("--path", default=None,
+                    help="load: explicit artifact path (default: "
+                         "<adapter-dir>/<name>.npz on the server)")
+    ad.add_argument("--pin", action="store_true",
+                    help="load: exempt from LRU eviction")
+    ad.set_defaults(fn=cmd_adapters)
+
     sv = sub.add_parser(
         "simserve",
         help="simulated-clock serving benchmark: real engine + virtual "
@@ -768,9 +906,10 @@ def main(argv=None):
                     # literal: keep CLI startup free of sim/jax imports
                     # (must mirror sim/traces.TRACE_NAMES)
                     choices=("poisson", "bursty", "prefix-heavy",
-                             "overload"),
+                             "overload", "adapter-zipf"),
                     help="named trace mix (overload exercises "
-                         "preemption AND shed)")
+                         "preemption AND shed; adapter-zipf the "
+                         "multi-tenant LoRA registry churn)")
     sv.add_argument("--trace-file", default=None,
                     help="replay a banked trace JSONL instead of "
                          "generating one")

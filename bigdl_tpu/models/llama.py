@@ -333,10 +333,14 @@ def lm_head_logits(config: ModelConfig, params: Params, h: jax.Array,
 
 
 def _lora_delta(x, pair, scale, compute_dtype):
-    """x [.., in] through a LoRA pair {'a': [r, in], 'b': [out, r]}."""
-    a, b = pair["a"], pair["b"]
-    xa = jnp.einsum("...k,rk->...r", x.astype(compute_dtype), a.astype(compute_dtype))
-    return jnp.einsum("...r,or->...o", xa, b.astype(compute_dtype)) * scale
+    """x [.., in] through a LoRA pair {'a': [r, in], 'b': [out, r]}.
+    Batched per-row pairs ({'a': [B, r, in], 'b': [B, out, r]}, scale
+    [B]) apply slot i's adapter to row i — the serving engine's
+    heterogeneous multi-tenant decode batch (ops/linear.lora_epilogue;
+    docs/serving.md §7)."""
+    from bigdl_tpu.ops.linear import lora_epilogue
+
+    return lora_epilogue(x, pair["a"], pair["b"], scale, compute_dtype)
 
 
 def _deq(w, compute_dtype):

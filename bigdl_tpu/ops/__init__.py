@@ -8,13 +8,14 @@ the hot ops (quantized matmul, flash attention) are planned under
 bigdl_tpu/ops/ and will dispatch by backend once present.
 """
 
-from bigdl_tpu.ops.linear import linear
+from bigdl_tpu.ops.linear import linear, lora_epilogue
 from bigdl_tpu.ops.norms import rms_norm, layer_norm
 from bigdl_tpu.ops.rope import apply_rotary_emb, rope_cos_sin
 from bigdl_tpu.ops.attention import attention
 
 __all__ = [
     "linear",
+    "lora_epilogue",
     "rms_norm",
     "layer_norm",
     "apply_rotary_emb",

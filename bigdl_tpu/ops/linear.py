@@ -267,6 +267,41 @@ def _fused_bwd(qtype, block_o, w, g):
 _fused_matmul.defvjp(_fused_fwd, _fused_bwd)
 
 
+def lora_epilogue(x: jax.Array, a: jax.Array, b: jax.Array,
+                  scale: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
+    """The multi-tenant LoRA epilogue ``(x @ A^T) @ B^T * scale`` added
+    to a (fused dequant-)GEMM's output — the base weight stays packed
+    and shared while the adapter applies unquantized on top
+    (serving/adapters.py; arxiv 2301.12017's composability argument
+    against merge-and-requantize per tenant).
+
+    Two shapes, one contract:
+
+    - shared adapter (training / single-tenant): ``a [r, in]``,
+      ``b [out, r]``, scalar ``scale`` — every row of ``x [..., in]``
+      goes through the same pair;
+    - batched per-row adapters (the serving engine's heterogeneous
+      decode batch): ``a [B, r, in]``, ``b [B, out, r]``, ``scale [B]``
+      against ``x [B, T, in]`` — slot ``i`` applies ITS adapter; rank
+      rows/columns zero-padded to the batch's rank bucket contribute
+      exactly 0, so adapter-less slots ride along unchanged and one
+      compiled program serves any mix at or below the bucket.
+    """
+    xc = x.astype(compute_dtype)
+    ac, bc = a.astype(compute_dtype), b.astype(compute_dtype)
+    if a.ndim == 3:  # batched per-row adapters
+        xa = jnp.einsum("btk,brk->btr", xc, ac)
+        y = jnp.einsum("btr,bor->bto", xa, bc)
+        return y * scale.astype(compute_dtype)[:, None, None]
+    xa = jnp.einsum("...k,rk->...r", xc, ac)
+    # scale is cast to the compute dtype, never the other way: an f32
+    # scale leaf (adapter artifacts store it as f32) must not promote
+    # the delta — a promoted residual changes the scan carry's dtype
+    # on wo/w_down targets and breaks the layer scan outright
+    return (jnp.einsum("...r,or->...o", xa, bc)
+            * jnp.asarray(scale).astype(compute_dtype))
+
+
 def linear(
     x: jax.Array,
     w: Union[QTensor, jax.Array],

@@ -77,6 +77,16 @@ def _sampling_kwargs(payload: dict) -> dict:
         kw["repetition_penalty"] = p
     if "eos_token_id" in payload:
         kw["eos_token_id"] = int(payload["eos_token_id"])
+    if payload.get("adapter") is not None:
+        # multi-tenant LoRA (docs/serving.md §7): the named adapter this
+        # request decodes with; resolution/refcounting happens at engine
+        # admission, so a bad name is a structured per-request error
+        a = payload["adapter"]
+        invalid_input_error(
+            isinstance(a, str) and bool(a),
+            f"adapter must be a non-empty string, got {a!r}",
+        )
+        kw["adapter"] = a
     for f in ("queue_deadline_s", "deadline_s"):
         # per-request overload controls (docs/serving.md): how long the
         # request may wait for a slot, and its total wall-clock budget
@@ -148,6 +158,10 @@ class ApiServer:
         deadline_s: Optional[float] = None,  # default total budget (504)
         preemption: bool = True,  # host-RAM KV swap under page pressure
         faults=None,  # FaultInjector for chaos testing (serving/faults.py)
+        adapters=None,  # AdapterRegistry (serving/adapters.py): enables
+        # per-request "adapter" fields on every generate surface plus
+        # the POST /adapters/{load,unload} + GET /adapters lifecycle
+        # endpoints (docs/serving.md §7)
         tracing: bool = False,  # request-lifecycle span recording
         # (obs/tracing.py); the ring always exists so POST /debug/trace
         # can flip it on a live server — disabled it costs one attribute
@@ -168,6 +182,11 @@ class ApiServer:
         self._clock = clock
         self.tracer = TraceRecorder(capacity=trace_capacity,
                                     enabled=tracing, clock=clock)
+        self.adapters = adapters
+        if adapters is not None:
+            # registry lifecycle events land in the same trace ring,
+            # clock domain, and fault-injection table as the engine
+            adapters.bind(tracer=self.tracer, clock=clock, faults=faults)
         self.engine = InferenceEngine(
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, page_size=page_size, n_pages=n_pages,
@@ -178,6 +197,7 @@ class ApiServer:
             logprobs_top_k=logprobs_top_k, journal=journal,
             max_queue=max_queue, queue_deadline_s=queue_deadline_s,
             deadline_s=deadline_s, preemption=preemption, faults=faults,
+            adapters=adapters,
             tracer=self.tracer, request_log=request_log, clock=clock,
         )
         self.request_timeout_s = request_timeout_s
@@ -255,6 +275,17 @@ class ApiServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return None
+                if self.path == "/adapters":
+                    # multi-tenant LoRA inventory (docs/serving.md §7):
+                    # residency, refcounts, pin state, churn counters
+                    if outer.adapters is None:
+                        return self._json(
+                            400, {"error": "no adapter registry (pass "
+                                  "adapters= to ApiServer)"})
+                    return self._json(200, {
+                        "adapters": outer.adapters.resident(),
+                        "stats": outer.adapters.stats(),
+                    })
                 if self.path == "/debug/trace":
                     # the ring buffer as Chrome trace-event JSON — saved
                     # to a file it loads directly in Perfetto
@@ -306,7 +337,59 @@ class ApiServer:
                 "/generate", "/generate_stream", "/v1/completions",
                 "/v1/chat/completions", "/v1/audio/transcriptions",
                 "/v1/embeddings", "/debug/trace", "/debug/profiler",
+                "/adapters/load", "/adapters/unload",
             }
+
+            # AdapterError.kind -> HTTP status (docs/serving.md §7):
+            # missing artifacts are a 404, a live-referenced unload is a
+            # 409 the operator retries after drain, corrupt/mismatched
+            # artifacts are an unprocessable 422, and an over-budget
+            # load is a 507 (insufficient storage — literally)
+            _ADAPTER_STATUS = {"missing": 404, "busy": 409,
+                               "corrupt": 422, "rank_mismatch": 422,
+                               "budget": 507}
+
+            def _adapter_op(self, payload, op: str):
+                """POST /adapters/{load,unload}: operator lifecycle for
+                the multi-tenant registry."""
+                if outer.adapters is None:
+                    return self._json(
+                        400, {"error": "no adapter registry (pass "
+                              "adapters= to ApiServer)"})
+                from bigdl_tpu.serving.adapters import AdapterError
+
+                name = payload.get("name")
+                if not isinstance(name, str) or not name:
+                    return self._json(
+                        400, {"error": "body needs a non-empty "
+                              '"name" string'})
+                try:
+                    if op == "load":
+                        desc = outer.adapters.load(
+                            name, path=payload.get("path"),
+                            pin=bool(payload.get("pin", False)),
+                        )
+                        # validate against the SERVING model now: an
+                        # operator pre-loading a wrong-base artifact
+                        # should hear 422 here, not watch every tenant
+                        # request error later (the registry alone
+                        # cannot see the model's dims). peek() — a
+                        # validation pass must not count as a hit.
+                        entry = outer.adapters.peek(name)
+                        if entry is not None:
+                            try:
+                                outer.engine._check_adapter_dims(entry)
+                            except AdapterError:
+                                outer.adapters.reject(entry, held=False)
+                                raise
+                    else:
+                        desc = outer.adapters.unload(name)
+                except AdapterError as e:
+                    return self._json(
+                        self._ADAPTER_STATUS.get(e.kind, 400),
+                        {"error": str(e), "kind": e.kind, "name": name},
+                    )
+                return self._json(200, {"adapter": desc, "op": op})
 
             def do_POST(self):
                 from bigdl_tpu.utils.errors import (
@@ -347,6 +430,10 @@ class ApiServer:
                     return self._debug_trace(payload)
                 if self.path == "/debug/profiler":
                     return self._debug_profiler(payload)
+                if self.path == "/adapters/load":
+                    return self._adapter_op(payload, "load")
+                if self.path == "/adapters/unload":
+                    return self._adapter_op(payload, "unload")
                 if self.path == "/v1/embeddings":
                     return self._embeddings(payload)
                 if self.path == "/generate":

@@ -59,6 +59,11 @@ class Request:
     top_p: Optional[float] = None
     repetition_penalty: Optional[float] = None
     eos_token_id: Optional[int] = None
+    # multi-tenant LoRA: the named adapter this request decodes with
+    # (serving/adapters.py; None = the shared base). Resolved +
+    # refcounted at admission; applied as a batched epilogue on the
+    # shared fused dequant-GEMM (docs/serving.md §7).
+    adapter: Optional[str] = None
     # filled by the engine
     out_tokens: list[int] = dataclasses.field(default_factory=list)
     # chosen-token logprob per emitted token (log softmax of the model's
@@ -199,6 +204,12 @@ class InferenceEngine:
         # (least progress lost, default) or "oldest"
         faults: Optional[Any] = None,  # FaultInjector (serving/faults.py);
         # None = the shared inert injector (zero-cost hooks)
+        adapters: Optional[Any] = None,  # AdapterRegistry
+        # (serving/adapters.py): requests may name a LoRA adapter and
+        # decode with it applied as a batched unquantized epilogue on
+        # the shared base — one forward serves a heterogeneous adapter
+        # batch (docs/serving.md §7). None = adapter= submits are
+        # rejected as invalid.
         # ---- observability (docs/observability.md) ----
         tracer: Optional[Any] = None,  # obs.tracing.TraceRecorder; spans
         # recorded only while tracer.enabled (off = one attr check)
@@ -402,9 +413,49 @@ class InferenceEngine:
         # _decode_impl
         self.seen = jnp.zeros((n_slots, self.config.vocab_size), jnp.bool_)
 
+        # ---- multi-tenant LoRA adapters (serving/adapters.py) ----
+        self.adapters = adapters
+        # rid -> AdapterEntry: ONE reference per in-flight request that
+        # resolved an adapter (held across preemption parking and the
+        # paged OOM-retry wait; released at the terminal finish in
+        # _note_finish — the kvpaged.PagePool one-hold-per-holder rule)
+        self._adapter_refs: dict[int, Any] = {}
+        self._slot_adapter: list[Optional[Any]] = [None] * n_slots
+        # the decode step's batched per-slot adapter tree, rebuilt only
+        # when a slot's adapter assignment changes (not per token)
+        self._blora: Optional[dict] = None
+        self._blora_dirty = True
+        # rank + target set of the adapter the CURRENT prefill dispatch
+        # serves (0/() = base-only) — observability the sim's cost
+        # wrappers price
+        self._last_prefill_rank = 0
+        self._last_prefill_targets: tuple = ()
+
         # forward_fn: the family forward, or the pipeline step when the
         # mesh has a pp axis (api.TpuModel.forward_fn)
         fwd = getattr(model, "forward_fn", None) or model.family.forward
+        if adapters is not None:
+            if speculative:
+                # the draft scan has no adapter story (drafting with the
+                # base against an adapter-shifted target would crater
+                # acceptance, and the verify forward would need its own
+                # batched epilogue) — refuse honestly
+                raise NotImplementedError(
+                    "adapter serving is not wired through speculative "
+                    "decoding yet; use speculative=False"
+                )
+            import inspect
+
+            try:
+                fwd_params = inspect.signature(fwd).parameters
+            except (TypeError, ValueError):  # pragma: no cover - exotic
+                fwd_params = {"lora": None}
+            if "lora" not in fwd_params:
+                raise NotImplementedError(
+                    f"{model.config.model_type}'s forward has no lora= "
+                    "epilogue path; adapter serving needs a llama-family "
+                    "forward"
+                )
         self._decode = self._with_mesh(jax.jit(
             functools.partial(self._decode_impl, fwd),
             donate_argnames=("cache", "seen"),
@@ -702,8 +753,13 @@ class InferenceEngine:
 
     # ---- jitted pieces ----------------------------------------------------
 
-    def _prefill_impl(self, forward, params, tokens, start, bucket):
-        """Single-request prefill on its own scalar-pos cache."""
+    def _prefill_impl(self, forward, params, tokens, start, bucket,
+                      lora=None):
+        """Single-request prefill on its own scalar-pos cache. `lora`
+        is the request's rank-bucketed adapter tree (None = base): the
+        prompt's KV and first-token logits must carry the adapter or
+        decode parity with the offline-merged weights breaks at token
+        one."""
         cfg = self.config
         if self._family_cache is not None:
             cache = self._family_cache(cfg, 1, bucket)
@@ -713,8 +769,10 @@ class InferenceEngine:
                 cfg.head_dim_, quantize_kv=self.quantize_kv,
             )
         cache = dataclasses.replace(cache, start=start)
+        kw = {} if lora is None else {"lora": lora}
         logits, cache = forward(
-            cfg, params, tokens, cache, mode="prefill", last_logits_only=True
+            cfg, params, tokens, cache, mode="prefill",
+            last_logits_only=True, **kw
         )
         return logits[:, -1], cache
 
@@ -759,30 +817,39 @@ class InferenceEngine:
         return dataclasses.replace(cache, **upd)
 
     def _paged_prefill_impl(self, forward, params, k, v, ks, vs, row_bt,
-                            pos0, tokens, last_idx):
+                            pos0, tokens, last_idx, lora=None):
         """Tail prefill for ONE slot, writing straight into the shared
         page pool (donated k/v): no dense mini-cache, no insert copy.
         tokens are RIGHT-padded to a bucket; last_idx selects the real
         last token's logits (pad writes land at slots >= pos and are
-        overwritten by decode)."""
+        overwritten by decode). `lora` = the request's rank-bucketed
+        adapter tree (every chunk of a chunked prefill carries it)."""
         from bigdl_tpu import kvpaged
 
         cache = kvpaged.PagedKVCache(
             k=k, v=v, k_scale=ks, v_scale=vs, block_tables=row_bt, pos=pos0,
             start=jnp.zeros((1,), jnp.int32),
         )
+        kw = {} if lora is None else {"lora": lora}
         logits, cache = forward(
-            self.config, params, tokens, cache, mode="prefill"
+            self.config, params, tokens, cache, mode="prefill", **kw
         )
         return (logits[0, last_idx], cache.k, cache.v, cache.k_scale,
                 cache.v_scale)
 
     def _decode_impl(self, forward, params, cur, cache, key,
-                     temp, topk, topp, dosample, seen, penalty):
+                     temp, topk, topp, dosample, seen, penalty,
+                     lora=None):
         from bigdl_tpu.generate import apply_repetition_penalty
 
+        # lora = the batched per-slot adapter tree (_gather_blora):
+        # [L, B, rb, in]/[L, B, out, rb] leaves + a [B] scale, applied
+        # as an einsum epilogue on each projection's fused dequant-GEMM
+        # output (ops/linear.lora_epilogue) — adapter-less slots carry
+        # zero-padded rows and a 0 scale, contributing exactly nothing
+        kw = {} if lora is None else {"lora": lora}
         logits, cache = forward(
-            self.config, params, cur[:, None], cache, mode="decode"
+            self.config, params, cur[:, None], cache, mode="decode", **kw
         )
         last = logits[:, -1]
         # all-default batches (every penalty 1.0) skip the O(slots x V)
@@ -946,6 +1013,7 @@ class InferenceEngine:
         eos_token_id: Optional[int] = None,
         queue_deadline_s: Optional[float] = None,
         deadline_s: Optional[float] = None,
+        adapter: Optional[str] = None,
     ) -> Request:
         if repetition_penalty is not None and repetition_penalty <= 0:
             raise ValueError(
@@ -966,6 +1034,7 @@ class InferenceEngine:
             top_k=top_k, top_p=top_p,
             repetition_penalty=repetition_penalty,
             eos_token_id=eos_token_id,
+            adapter=adapter,
             queue_deadline_s=(queue_deadline_s
                               if queue_deadline_s is not None
                               else self.queue_deadline_s),
@@ -998,6 +1067,20 @@ class InferenceEngine:
                 f"prompt token id {bad[0]} outside [0, "
                 f"{self.config.vocab_size}) — wrong tokenizer for this "
                 "model?"
+            )
+            req.finish_reason = "invalid"
+            req.done = True
+            self._note_finish(req, req.submit_ts)
+            if stream is not None:
+                stream.put(None)
+            return req
+        if req.adapter is not None and self.adapters is None:
+            # a config mistake, not overload: the caller named an
+            # adapter on an engine with no registry — serving the base
+            # silently would be the wrong model for that tenant
+            req.error = (
+                f"request names adapter {req.adapter!r} but this engine "
+                "has no adapter registry (construct it with adapters=)"
             )
             req.finish_reason = "invalid"
             req.done = True
@@ -1126,13 +1209,16 @@ class InferenceEngine:
         prompt = req.prompt
 
         # longest cached full-page run (O(prompt) incremental keys;
-        # matched nodes are LRU-refreshed in O(1) each)
-        path = self.radix.match(prompt)
+        # matched nodes are LRU-refreshed in O(1) each), in the
+        # request's adapter namespace: pages prefilled under a LoRA
+        # adapter carry its shifted K/V, so tenants never share pages
+        # with each other or with the base (radix.root_for)
+        path = self.radix.match(prompt, ns=req.adapter)
         shared = [nd.page for nd in path]
         n_hit = len(shared)
         lp = n_hit * page
         tail = prompt[lp:]
-        head_node = path[-1] if path else self.radix.root
+        head_node = path[-1] if path else self.radix.root_for(req.adapter)
 
         # sub-page sharing: the deepest matched node's child whose page
         # agrees with our tail for t_copy tokens lets us COPY those KV
@@ -1251,6 +1337,7 @@ class InferenceEngine:
             self.cache.k_scale, self.cache.v_scale,
             jnp.asarray(row[None]), jnp.asarray([lp_eff], jnp.int32),
             jnp.asarray(toks), jnp.asarray(len(tail2) - 1),
+            lora=self._prefill_lora(req),
         )
         self.cache = dataclasses.replace(
             self.cache, k=k, v=v, k_scale=ks, v_scale=vs,
@@ -1259,7 +1346,7 @@ class InferenceEngine:
         )
         self._slot_pos[slot] = len(prompt)
 
-        self._register_prefix(prompt, path, table)
+        self._register_prefix(prompt, path, table, ns=req.adapter)
 
         if self.speculative:
             # prefix-cache hits only save TARGET prefill; the draft
@@ -1270,13 +1357,15 @@ class InferenceEngine:
         return True
 
     def _register_prefix(self, prompt: list[int], path: list,
-                         table: list[int]) -> None:
+                         table: list[int], ns=None) -> None:
         """Register the prompt's fully-covered pages past the matched
         run as radix nodes (the cache takes its own page reference).
         An existing edge keeps its canonical page — our duplicate stays
-        slot-only and frees at release."""
+        slot-only and frees at release. `ns` = the request's adapter
+        name: adapter-prefilled pages register under that tenant's own
+        radix root, never the shared base tree."""
         page = self.page_size
-        node = path[-1] if path else self.radix.root
+        node = path[-1] if path else self.radix.root_for(ns)
         for i in range(len(path), len(prompt) // page):
             key = tuple(prompt[i * page: (i + 1) * page])
             nxt = node.children.get(key)
@@ -1306,6 +1395,7 @@ class InferenceEngine:
             self.cache.k_scale, self.cache.v_scale,
             jnp.asarray(st.row[None]), jnp.asarray([st.written], jnp.int32),
             jnp.asarray(toks), jnp.asarray(n - 1),
+            lora=self._prefill_lora(st.req),
         )
         self.cache = dataclasses.replace(
             self.cache, k=k, v=v, k_scale=ks, v_scale=vs,
@@ -1323,7 +1413,8 @@ class InferenceEngine:
             start=self.cache.start.at[slot].set(0),
         )
         self._slot_pos[slot] = len(prompt)
-        self._register_prefix(prompt, st.path, self._slot_pages[slot])
+        self._register_prefix(prompt, st.path, self._slot_pages[slot],
+                              ns=st.req.adapter)
         self._activate(slot, st.req, logits_last[None])
 
     def _admit_draft(self, slot: int, prompt: list[int], limit: int) -> None:
@@ -1531,6 +1622,9 @@ class InferenceEngine:
             req=req, remaining=entry.remaining, eos=entry.eos,
             seq=entry.seq, resumed_pos=entry.pos,
         )
+        # the parked request kept its adapter reference (host-RAM
+        # residency survived the swap); re-point the slot at it
+        self._set_slot_adapter(slot, req)
         self.active[slot] = True
         if self.speculative:
             # the draft pool was not swapped (drafts are advisory — any
@@ -1591,16 +1685,192 @@ class InferenceEngine:
                 # marker drops; re-request once decoding)
                 self._preempt_slot(i)
 
+    # ---- multi-tenant LoRA adapters (serving/adapters.py; §7) -------------
+
+    def _resolve_adapter(self, req: Request) -> bool:
+        """Acquire the request's named adapter at admission: load/verify
+        through the registry (LRU-refreshing it) and take the request's
+        ONE reference — held across preemption parking and the paged
+        OOM-retry wait, released at the terminal finish. False = the
+        adapter is missing/corrupt/mismatched: the request finishes
+        "error" with the structured message and the caller admits the
+        next one (a bad tenant artifact must never fail_all a batch)."""
+        from bigdl_tpu.serving.adapters import AdapterError
+
+        if req.rid in self._adapter_refs:  # OOM-retry / prefill-abort
+            # re-admission: the reference is already held
+            return True
+        try:
+            entry = self.adapters.acquire(req.adapter)
+        except AdapterError as e:
+            self._fail_request(req, str(e))
+            return False
+        try:
+            self._check_adapter_dims(entry)
+        except AdapterError as e:
+            # wrong-base artifact: count it as a load failure and drop
+            # it from residency (reject) — a resident entry every
+            # request errors on would read as a healthy registry in
+            # /metrics while squatting on budget
+            self.adapters.reject(entry)
+            self._fail_request(req, str(e))
+            return False
+        self._adapter_refs[req.rid] = entry
+        return True
+
+    def _check_adapter_dims(self, entry) -> None:
+        """An adapter trained against a different base would scatter
+        garbage through the epilogue einsum (or fail deep inside XLA);
+        fail it structurally at admission instead."""
+        from bigdl_tpu.serving.adapters import AdapterError
+        from bigdl_tpu.train.qlora import _target_dims
+
+        L = self.config.num_hidden_layers
+        for t in entry.targets:
+            try:
+                out_d, in_d = _target_dims(self.config, t)
+            except KeyError:
+                raise AdapterError(
+                    entry.name, "rank_mismatch",
+                    f"unknown lora target {t!r} for this model family",
+                ) from None
+            a = entry.layers[t]["a"]
+            b = entry.layers[t]["b"]
+            if (tuple(a.shape) != (L, entry.rank, in_d)
+                    or tuple(b.shape) != (L, out_d, entry.rank)):
+                raise AdapterError(
+                    entry.name, "rank_mismatch",
+                    f"target {t}: a{tuple(a.shape)} / b{tuple(b.shape)} "
+                    f"do not fit this model's [L={L}, r={entry.rank}, "
+                    f"in={in_d}] / [L, out={out_d}, r] — adapter trained "
+                    "on a different base?",
+                )
+
+    def _set_slot_adapter(self, slot: int, req: Request) -> None:
+        """Point the slot at the request's (possibly absent) adapter
+        entry and invalidate the batched decode tree only when the
+        assignment actually changed."""
+        if self.adapters is None:
+            return
+        entry = self._adapter_refs.get(req.rid)
+        if self._slot_adapter[slot] is not entry:
+            self._slot_adapter[slot] = entry
+            self._blora_dirty = True
+
+    def _prefill_lora(self, req: Request):
+        """The request's single-row rank-bucketed adapter tree for the
+        prefill kernels (None = base). Also stamps _last_prefill_rank /
+        _last_prefill_targets for the sim's cost wrappers."""
+        entry = self._adapter_refs.get(req.rid)
+        self._last_prefill_rank = entry.rank if entry is not None else 0
+        self._last_prefill_targets = (entry.targets if entry is not None
+                                      else ())
+        if entry is None:
+            return None
+        return entry.tree()
+
+    def _gather_blora(self) -> Optional[dict]:
+        """The decode step's batched adapter tree: per target,
+        [L, B, rb, in] A-stacks and [L, B, out, rb] B-stacks over every
+        slot (zero rows + scale 0 for adapter-less slots), rb = the
+        power-of-two bucket of the max rank in the batch
+        (adapters.rank_bucket) — compile variants are bounded by
+        (target-set, bucket), never by which tenants happen to share a
+        step. Rebuilt only when the slot->adapter assignment changes;
+        None when no active slot carries an adapter (the base-only
+        program keeps serving)."""
+        if self.adapters is None:
+            return None
+        if not self._blora_dirty:
+            return self._blora
+        self._blora_dirty = False
+        entries = self._slot_adapter
+        live = [e for e in entries if e is not None]
+        if not live:
+            self._blora = None
+            return None
+        from bigdl_tpu.serving.adapters import rank_bucket
+
+        B = self.n_slots
+        L = self.config.num_hidden_layers
+        rb = rank_bucket(max(e.rank for e in live))
+        targets = sorted({t for e in live for t in e.targets})
+        layers: dict = {}
+        for t in targets:
+            ref = next(e.layers[t] for e in live if t in e.layers)
+            in_d = int(np.asarray(ref["a"]).shape[-1])
+            out_d = int(np.asarray(ref["b"]).shape[-2])
+            a = np.zeros((L, B, rb, in_d), np.float32)
+            b = np.zeros((L, B, out_d, rb), np.float32)
+            for i, e in enumerate(entries):
+                if e is None or t not in e.layers:
+                    continue
+                a[:, i, : e.rank, :] = np.asarray(
+                    e.layers[t]["a"], np.float32
+                )
+                b[:, i, :, : e.rank] = np.asarray(
+                    e.layers[t]["b"], np.float32
+                )
+            layers[t] = {"a": jnp.asarray(a, jnp.bfloat16),
+                         "b": jnp.asarray(b, jnp.bfloat16)}
+        scale = np.zeros((B,), np.float32)
+        for i, e in enumerate(entries):
+            if e is not None:
+                scale[i] = e.scale
+        self._blora = {"layers": layers, "scale": jnp.asarray(scale)}
+        return self._blora
+
     # ---- admission --------------------------------------------------------
+
+    # cache-aware admission: oldest entries scored per pop (bounds the
+    # under-mutex radix probe; see _pop_deepest_match)
+    _ADMIT_SCAN_WINDOW = 64
 
     def _pop_request(self) -> Optional[Request]:
         if self._waiting is not None:
             req, self._waiting = self._waiting, None
             return req
+        if self.paged:
+            return self._pop_deepest_match()
         try:
             return self._queue.get_nowait()
         except queue.Empty:
             return None
+
+    def _pop_deepest_match(self) -> Optional[Request]:
+        """Cache-aware admission ordering (docs/serving.md §6): among
+        the queued admissible requests, admit the one with the DEEPEST
+        radix prefix match first — it frees the most prefill work and
+        touches its cached pages before eviction pressure can drop
+        them. Strict-greater comparison keeps ties (including the
+        all-miss common case) in FIFO order, so a workload with no
+        shared prefixes schedules exactly as before; queue/request
+        deadlines still bound how long a 0-match request can be
+        out-prioritized. Probe is read-only (radix.match_len): scoring
+        must not LRU-promote pages for requests that stay queued.
+
+        The scan holds the queue mutex (raw deque surgery, _sweep_queue
+        style), so it is BOUNDED: only the oldest _ADMIT_SCAN_WINDOW
+        entries are scored — an unbounded queue under overload must not
+        turn every admission into an O(queue x prompt) stall that also
+        blocks handler-thread submits for the scan's duration."""
+        with self._queue.mutex:
+            q = self._queue.queue
+            if not q:
+                return None
+            if len(q) > 1 and self.radix.n_nodes:
+                n = min(len(q), self._ADMIT_SCAN_WINDOW)
+                best_i, best_d = 0, self.radix.match_len(
+                    q[0].prompt, ns=q[0].adapter)
+                for i in range(1, n):
+                    d = self.radix.match_len(q[i].prompt, ns=q[i].adapter)
+                    if d > best_d:
+                        best_i, best_d = i, d
+                if best_i:
+                    req = q[best_i]
+                    del q[best_i]
+                    return req
+            return q.popleft()
 
     def _shed_request(self, req: Request, kind: str, msg: str,
                       journaled: bool = True) -> None:
@@ -1641,6 +1911,12 @@ class InferenceEngine:
         reason = req.finish_reason or "?"
         with self._stat_lock:
             self.finish_reasons[reason] += 1
+        entry = self._adapter_refs.pop(req.rid, None)
+        if entry is not None:
+            # the request's one adapter hold releases exactly at its
+            # terminal state (every finish path funnels through here);
+            # a refcount-0 adapter becomes fair eviction game
+            self.adapters.release(entry)
         tr = self.tracer
         if req.preempt_ts is not None:
             # died while PARKED (deadline/cancel/fail_all before any
@@ -1776,6 +2052,7 @@ class InferenceEngine:
         self._topp[slot], self._dosample[slot] = topp, dosample
         self._penalty[slot] = penalty
         self.seen = self.seen.at[slot].set(row).at[slot, first].set(True)
+        self._set_slot_adapter(slot, req)
         self.active[slot] = True
         row_lp = jax.nn.log_softmax(
             jnp.asarray(logits_last, jnp.float32).reshape(-1)
@@ -1815,6 +2092,7 @@ class InferenceEngine:
         logits_last, pcache = self._prefill(
             self.model.params, jnp.asarray(tokens),
             jnp.asarray([pad], jnp.int32), bucket=bucket,
+            lora=self._prefill_lora(req),
         )
         self.cache = self._insert(
             self.cache, pcache, jnp.asarray(slot), jnp.asarray(pad)
@@ -1874,6 +2152,9 @@ class InferenceEngine:
             if which is not None:
                 self._expire_queued(req, which, now)
                 continue
+            if req.adapter is not None and not self._resolve_adapter(req):
+                continue  # structured failure: ONE request errors, the
+                # batch keeps serving (never fail_all for a bad adapter)
             if self.paged:
                 if not self._admit_paged(req, slot):
                     self._waiting = req  # pool full: retry after frees
@@ -1988,6 +2269,12 @@ class InferenceEngine:
             self._prefilling = None
         self._slots[slot] = _Slot()
         self.active[slot] = False
+        if self._slot_adapter[slot] is not None:
+            # the slot's adapter row leaves the batched tree; the
+            # request's registry reference (if still alive — parked)
+            # is _adapter_refs' business, not the slot's
+            self._slot_adapter[slot] = None
+            self._blora_dirty = True
         self._dosample[slot] = False  # idle rows decode deterministic garbage
         self._penalty[slot] = 1.0
         self.seen = self.seen.at[slot].set(False)
@@ -2008,6 +2295,8 @@ class InferenceEngine:
         self.active[:] = False
         self._preempted.clear()  # blobs reference the old pool's layout
         self._prefilling = None  # a half-run chunk plan died with the pool
+        self._slot_adapter = [None] * self.n_slots
+        self._blora, self._blora_dirty = None, True
         if self.paged:
             from bigdl_tpu import kvpaged
             from bigdl_tpu.serving.radix import RadixPrefixCache
@@ -2235,6 +2524,7 @@ class InferenceEngine:
                 jnp.asarray(self._temp), jnp.asarray(self._topk),
                 jnp.asarray(self._topp), jnp.asarray(self._dosample),
                 self.seen, jnp.asarray(self._penalty),
+                lora=self._gather_blora(),
             )
         except Exception:
             # the donated cache buffer is gone — rebuild before re-raising

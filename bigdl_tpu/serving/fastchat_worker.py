@@ -62,13 +62,18 @@ class FastChatWorker:
         queue_deadline_s: Optional[float] = None,
         deadline_s: Optional[float] = None,
         preemption: bool = True,
+        adapters=None,  # AdapterRegistry (serving/adapters.py): worker
+        # payloads gain an "adapter" field — one FastChat worker serves
+        # many tenants' fine-tunes over one shared base
     ):
+        self.adapters = adapters
         self.engine = InferenceEngine(
             model, n_slots=n_slots, max_len=max_len, gen=gen,
             paged=paged, speculative=speculative, draft_k=draft_k,
             truncate_prompts=truncate_prompts, journal=journal,
             max_queue=max_queue, queue_deadline_s=queue_deadline_s,
             deadline_s=deadline_s, preemption=preemption,
+            adapters=adapters,
         )
         self.tokenizer = tokenizer
         self.controller_addr = controller_addr

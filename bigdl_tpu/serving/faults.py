@@ -25,6 +25,10 @@ point               effect when armed
                     request is complete but BEFORE its journal tombstone
                     is written — the crash-recovery window the journal
                     replay must cover
+``adapter_load_corrupt``  the next LoRA adapter load fails as if the
+                    artifact were corrupt (structured AdapterError,
+                    serving/adapters.py) — the request naming it must
+                    finish "error" without taking the batch down
 ==================  =======================================================
 
 Arming is deterministic by construction: ``arm(point, times=N, after=M)``
@@ -46,7 +50,8 @@ import threading
 from collections import defaultdict
 from typing import Optional
 
-POINTS = ("alloc_page", "nan_logits", "slow_step", "crash_before_done")
+POINTS = ("alloc_page", "nan_logits", "slow_step", "crash_before_done",
+          "adapter_load_corrupt")
 
 
 class FaultError(RuntimeError):

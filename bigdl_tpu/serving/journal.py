@@ -78,6 +78,10 @@ _crc_line = crc_line
 _REPLAY_FIELDS = (
     "max_new_tokens", "do_sample", "temperature", "top_k", "top_p",
     "repetition_penalty", "eos_token_id", "queue_deadline_s", "deadline_s",
+    # the named LoRA adapter (serving/adapters.py): a replayed tenant
+    # request must decode with ITS fine-tune, not the shared base — the
+    # registry re-resolves the name at the successor's admission
+    "adapter",
 )
 
 

@@ -374,6 +374,28 @@ class Metrics:
                     "# TYPE bigdl_tpu_radix_nodes gauge",
                     f"bigdl_tpu_radix_nodes {self.engine.radix.n_nodes}",
                 ]
+            if getattr(self.engine, "adapters", None) is not None:
+                # multi-tenant LoRA registry (serving/adapters.py §7)
+                st = self.engine.adapters.stats()
+                lines += [
+                    "# HELP bigdl_tpu_adapter_loads_total LoRA adapter "
+                    "artifact loads (incl. post-eviction reloads)",
+                    "# TYPE bigdl_tpu_adapter_loads_total counter",
+                    f"bigdl_tpu_adapter_loads_total {st['loads']}",
+                    "# HELP bigdl_tpu_adapter_evictions_total adapters "
+                    "dropped from host RAM under budget pressure",
+                    "# TYPE bigdl_tpu_adapter_evictions_total counter",
+                    f"bigdl_tpu_adapter_evictions_total {st['evictions']}",
+                    "# HELP bigdl_tpu_adapter_load_failures_total "
+                    "missing/corrupt/rank-mismatched adapter loads",
+                    "# TYPE bigdl_tpu_adapter_load_failures_total counter",
+                    f"bigdl_tpu_adapter_load_failures_total "
+                    f"{st['load_failures']}",
+                    "# HELP bigdl_tpu_adapters_resident adapters "
+                    "currently resident in host RAM",
+                    "# TYPE bigdl_tpu_adapters_resident gauge",
+                    f"bigdl_tpu_adapters_resident {st['resident']}",
+                ]
             if self.engine.speculative:
                 lines += [
                     "# HELP bigdl_tpu_spec_rounds_total verify rounds run",
@@ -451,6 +473,13 @@ _SPEC_FAMILIES = (
     "bigdl_tpu_spec_draft_k",
 )
 
+_ADAPTER_FAMILIES = (
+    "bigdl_tpu_adapter_loads_total",
+    "bigdl_tpu_adapter_evictions_total",
+    "bigdl_tpu_adapter_load_failures_total",
+    "bigdl_tpu_adapters_resident",
+)
+
 
 def expected_families(engine=None) -> list:
     """Every metric family a `Metrics(engine).render()` must expose."""
@@ -459,6 +488,8 @@ def expected_families(engine=None) -> list:
         names += _ENGINE_FAMILIES
         if getattr(engine, "paged", False):
             names += _PAGED_FAMILIES
+        if getattr(engine, "adapters", None) is not None:
+            names += _ADAPTER_FAMILIES
         if getattr(engine, "speculative", False):
             names += _SPEC_FAMILIES
     return names

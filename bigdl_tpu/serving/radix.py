@@ -68,6 +68,14 @@ class RadixPrefixCache:
         self.page_size = page_size
         self.pool = pool  # kvpaged.PagePool: one hold per cached node
         self.root = RadixNode((), -1, None)
+        # adapter namespaces (docs/serving.md §7): KV pages prefilled
+        # under a LoRA adapter carry that adapter's shifted K/V from
+        # the first adapted layer up — sharing them with another tenant
+        # (or the base) would silently leak one fine-tune's activations
+        # into another's generation. Each namespace gets its own root,
+        # so cross-tenant pages are unreachable BY CONSTRUCTION; all
+        # namespaces share one LRU and one eviction policy.
+        self._ns_roots: dict = {}  # adapter name -> RadixNode
         # node -> None, least-recently-used first. Hits move_to_end
         # (O(1)); eviction scans from the front for the first leaf
         # whose page only the cache holds.
@@ -83,13 +91,25 @@ class RadixPrefixCache:
     def nodes(self) -> Iterator[RadixNode]:
         return iter(self._lru)
 
-    def match(self, prompt: list) -> list:
+    def root_for(self, ns=None) -> RadixNode:
+        """The descent root for `ns` (an adapter name; None = the
+        shared base namespace). Created on first use — a namespace with
+        no cached pages costs one dict entry."""
+        if ns is None:
+            return self.root
+        root = self._ns_roots.get(ns)
+        if root is None:
+            root = self._ns_roots[ns] = RadixNode((), -1, None)
+        return root
+
+    def match(self, prompt: list, ns=None) -> list:
         """The longest cached run of full pages prefixing `prompt`,
         leaving at least one tail token to prefill (its logits seed
         generation). Returns the node path root-first; every matched
-        node is LRU-refreshed. O(len(prompt)) total hashing."""
+        node is LRU-refreshed. O(len(prompt)) total hashing. `ns`
+        selects the adapter namespace (see `root_for`)."""
         page = self.page_size
-        node, path = self.root, []
+        node, path = self.root_for(ns), []
         while (len(path) + 1) * page <= len(prompt) - 1:
             lo = len(path) * page
             child = node.children.get(tuple(prompt[lo:lo + page]))
@@ -100,6 +120,31 @@ class RadixPrefixCache:
         for nd in path:
             self._lru.move_to_end(nd)
         return path
+
+    def match_len(self, prompt: list, ns=None) -> int:
+        """Read-only probe: how many prompt tokens the cached full-page
+        run would cover (same descent bound as `match`, but NO LRU
+        refresh — the admission-ordering sort key must not promote
+        entries for requests that merely got scored). Namespaced like
+        `match`: a tenant's score counts only its own cached pages —
+        and, staying read-only, never materializes a root for a
+        namespace nothing has cached under yet."""
+        page = self.page_size
+        if ns is None:
+            node = self.root
+        else:
+            node = self._ns_roots.get(ns)
+            if node is None:
+                return 0
+        depth = 0
+        while (depth + 1) * page <= len(prompt) - 1:
+            lo = depth * page
+            child = node.children.get(tuple(prompt[lo:lo + page]))
+            if child is None:
+                break
+            depth += 1
+            node = child
+        return depth * page
 
     def match_partial(self, node: RadixNode, tail: list):
         """Best mid-page extension under `node`: the child page whose
@@ -167,6 +212,7 @@ class RadixPrefixCache:
             node.children.clear()
         self._lru.clear()
         self.root = RadixNode((), -1, None)
+        self._ns_roots = {}
 
     # -- invariants (tests + engine leak accounting) -------------------------
 
@@ -176,7 +222,7 @@ class RadixPrefixCache:
         cache's stale-children bug class), every cached page holds at
         least the cache's reference, and edge labels are page-sized."""
         reachable = set()
-        stack = [self.root]
+        stack = [self.root, *self._ns_roots.values()]
         while stack:
             nd = stack.pop()
             for key, child in nd.children.items():

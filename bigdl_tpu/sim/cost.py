@@ -100,6 +100,47 @@ class CostModel:
         peak = self.peak_tflops * 1e12
         return max(nbytes / bw, flops / peak)
 
+    def _lora_dim_sum(self, targets=None) -> int:
+        """Sum of (in + out) over the adapter's target set (None = all
+        seven) — the per-rank-unit size of one layer's adapter pairs
+        (A [r, in] + B [out, r] per target)."""
+        cfg = self.config
+        H, I = cfg.hidden_size, cfg.intermediate_size
+        dims = {
+            "wq": (H, cfg.q_dim),
+            "wk": (H, cfg.kv_dim),
+            "wv": (H, cfg.kv_dim),
+            "wo": (cfg.q_dim, H),
+            "w_gate": (H, I),
+            "w_up": (H, I),
+            "w_down": (I, H),
+        }
+        names = dims.keys() if targets is None else targets
+        return sum(dims[t][0] + dims[t][1] for t in names if t in dims)
+
+    def lora_cost(self, ranks, M: int = 1) -> dict:
+        """The multi-tenant LoRA epilogue's extra traffic per forward
+        (ops/linear.lora_epilogue): every adapter's bf16 A/B pairs
+        stream from HBM once per dispatch, and each of its rows pays
+        2*M*r*(in+out) FLOPs per target per layer. `ranks` = one entry
+        per adapter-carrying row — a bare rank (priced over all seven
+        targets) or a (rank, targets) pair priced over the adapter's
+        ACTUAL target set; adapter-less rows cost nothing (their
+        zero-padded rows still move with the batch's bucket, but the
+        dominant term — distinct adapters' weights — is what's priced).
+        """
+        items = []
+        for r in ranks:
+            rank, targets = r if isinstance(r, tuple) else (r, None)
+            if rank:
+                items.append((rank, self._lora_dim_sum(targets)))
+        if not items:
+            return {"bytes": 0, "flops": 0}
+        L = self.config.num_hidden_layers
+        nbytes = sum(2 * r * d for r, d in items) * L  # bf16 A+B stream
+        flops = sum(2 * M * r * d for r, d in items) * L
+        return {"bytes": nbytes, "flops": flops}
+
     def kv_token_bytes(self) -> int:
         """HBM bytes one token's K+V occupies across all layers."""
         cfg = self.config
@@ -112,10 +153,13 @@ class CostModel:
     # -- phases (what the driver's wrappers charge) --------------------------
 
     def decode_step_s(self, positions, page: int,
-                      paged: bool = True, max_len: int = 0) -> float:
+                      paged: bool = True, max_len: int = 0,
+                      adapter_ranks=()) -> float:
         """One batched decode step: M=occupancy through every
         projection + the decode-attention KV sweep at the rows' actual
-        positions."""
+        positions. `adapter_ranks` (one LoRA rank per adapter-carrying
+        row) adds the multi-tenant epilogue's weight stream + einsum
+        FLOPs (serving/adapters.py)."""
         rows = list(positions)
         if not rows:
             return self.step_overhead_s
@@ -126,14 +170,18 @@ class CostModel:
             cfg.head_dim_, layers=cfg.num_hidden_layers, paged=paged,
             quantize_kv=self.quantize_kv, max_len=max_len,
         )
-        return self._seconds(lin["bytes"] + att["bytes"],
-                             lin["flops"] + att["flops"]) \
+        lo = self.lora_cost(adapter_ranks, M=1)
+        return self._seconds(lin["bytes"] + att["bytes"] + lo["bytes"],
+                             lin["flops"] + att["flops"] + lo["flops"]) \
             + self.step_overhead_s
 
-    def prefill_s(self, chunk_tokens: int, prior_tokens: int = 0) -> float:
+    def prefill_s(self, chunk_tokens: int, prior_tokens: int = 0,
+                  adapter_rank=0) -> float:
         """A prefill chunk of `chunk_tokens` attending `prior_tokens`
         of existing context (prefix-cache hits shrink the chunk, which
-        is exactly how the cache saves simulated time)."""
+        is exactly how the cache saves simulated time). `adapter_rank`
+        (a rank or a (rank, targets) pair) prices the request's LoRA
+        epilogue over the chunk."""
         cfg = self.config
         lin = self.linear_cost(chunk_tokens)
         att = flash_prefill_cost(
@@ -142,8 +190,9 @@ class CostModel:
             cfg.head_dim_, layers=cfg.num_hidden_layers,
             quantize_kv=self.quantize_kv, q_offset=prior_tokens,
         )
-        return self._seconds(lin["bytes"] + att["bytes"],
-                             lin["flops"] + att["flops"]) \
+        lo = self.lora_cost([adapter_rank], M=chunk_tokens)
+        return self._seconds(lin["bytes"] + att["bytes"] + lo["bytes"],
+                             lin["flops"] + att["flops"] + lo["flops"]) \
             + self.step_overhead_s
 
     def suggest_prefill_chunk(self, occupancy: int = 4,

@@ -123,6 +123,12 @@ class SimConfig:
     preemption: bool = True
     # chunked prefill (docs/serving.md §6): None = monolithic
     prefill_chunk_tokens: Optional[int] = None
+    # multi-tenant LoRA (serving/adapters.py §7): when the trace's
+    # arrivals carry adapter ids, the driver mints one synthetic
+    # rank-4 adapter artifact per tenant and serves through a real
+    # AdapterRegistry whose budget holds this many adapters (None =
+    # unbounded — no eviction churn)
+    adapter_budget: Optional[int] = None
     seed: int = 0
 
 
@@ -146,6 +152,8 @@ class SimDriver:
         self.max_steps = max_steps
         self.model = model if model is not None else tiny_model()
         s = self.sim
+        self._adapter_dir = None
+        self.adapters = self._make_adapters()
         self.engine = InferenceEngine(
             self.model, n_slots=s.n_slots, max_len=s.max_len,
             paged=s.paged, page_size=s.page_size, n_pages=s.n_pages,
@@ -153,6 +161,7 @@ class SimDriver:
             deadline_s=s.deadline_s, preemption=s.preemption,
             prefill_chunk_tokens=s.prefill_chunk_tokens,
             seed=s.seed, faults=faults, tracer=tracer, clock=self.clock,
+            adapters=self.adapters,
         )
         if self.engine.speculative:  # defensive: ctor above never sets it
             raise NotImplementedError(
@@ -162,6 +171,63 @@ class SimDriver:
         self._install_cost_wrappers()
         if faults is not None:
             self._wrap_faults(faults)
+
+    # -- multi-tenant adapters (serving/adapters.py §7) ----------------------
+
+    def _make_adapters(self):
+        """When the trace's arrivals name adapters, mint one synthetic
+        rank-4 LoRA artifact per tenant (seeded, B=0 identity init —
+        token dynamics stay those of the tiny model while the engine
+        runs the REAL batched-epilogue decode program and the cost
+        model prices its extra bytes/FLOPs) and serve through a real
+        AdapterRegistry: verify-on-load, LRU, refcounts, and — under
+        `SimConfig.adapter_budget` — genuine eviction/reload churn, on
+        the same SimClock as everything else."""
+        names = sorted({a.adapter for a in self.trace.arrivals
+                        if a.adapter})
+        if not names:
+            return None
+        import os
+        import tempfile
+
+        import jax
+
+        from bigdl_tpu.serving.adapters import (
+            AdapterRegistry, lora_nbytes, save_adapter,
+        )
+        from bigdl_tpu.train.qlora import init_lora
+
+        self._adapter_dir = tempfile.TemporaryDirectory(
+            prefix="bigdl-tpu-sim-adapters-"
+        )
+        cfg = self.model.config
+        nbytes = 0
+        for i, name in enumerate(names):
+            lora = init_lora(
+                cfg, jax.random.PRNGKey(self.sim.seed * 1009 + i),
+                rank=4, alpha=8.0, targets=("wq", "wv"),
+            )
+            nbytes = lora_nbytes(lora)
+            save_adapter(
+                os.path.join(self._adapter_dir.name, f"{name}.npz"), lora
+            )
+        budget = (None if self.sim.adapter_budget is None
+                  else self.sim.adapter_budget * nbytes)
+        return AdapterRegistry(dir=self._adapter_dir.name,
+                               budget_bytes=budget, clock=self.clock)
+
+    def _active_adapter_ranks(self) -> list:
+        """(rank, targets) per ACTIVE adapter-carrying slot — the
+        decode-step epilogue cost's input, priced over each adapter's
+        ACTUAL target set (a wq/wv-only adapter must not charge all
+        seven projections)."""
+        eng = self.engine
+        out = []
+        for i in np.nonzero(eng.active)[0]:
+            e = eng._slot_adapter[int(i)]
+            if e is not None:
+                out.append((e.rank, e.targets))
+        return out
 
     # -- instrumentation ----------------------------------------------------
 
@@ -198,9 +264,11 @@ class SimDriver:
 
         def decode(*a, **k):
             rows = self._active_positions()
+            ranks = self._active_adapter_ranks()
             out = decode0(*a, **k)
             clock.advance(cost.decode_step_s(
-                rows, page, paged=eng.paged, max_len=eng.max_len))
+                rows, page, paged=eng.paged, max_len=eng.max_len,
+                adapter_ranks=ranks))
             return out
 
         eng._decode = decode
@@ -211,7 +279,10 @@ class SimDriver:
             out = prefill0(*a, **k)
             chunk = int(a[1].shape[1])
             self._last_prefill_tokens = chunk
-            clock.advance(cost.prefill_s(chunk, prior_tokens=0))
+            clock.advance(cost.prefill_s(
+                chunk, prior_tokens=0,
+                adapter_rank=(eng._last_prefill_rank,
+                              eng._last_prefill_targets)))
             return out
 
         eng._prefill = prefill
@@ -232,7 +303,10 @@ class SimDriver:
             out = paged_prefill0(*a, **k)
             chunk = int(a[7].shape[1])  # bucketed tail tokens
             prior = int(np.asarray(a[6])[0])  # prefix-cache coverage
-            clock.advance(cost.prefill_s(chunk, prior_tokens=prior))
+            clock.advance(cost.prefill_s(
+                chunk, prior_tokens=prior,
+                adapter_rank=(eng._last_prefill_rank,
+                              eng._last_prefill_targets)))
             return out
 
         eng._paged_prefill = paged_prefill
@@ -302,6 +376,7 @@ class SimDriver:
                 requests.append(eng.submit(
                     arrivals[i].prompt,
                     max_new_tokens=arrivals[i].max_new_tokens,
+                    adapter=arrivals[i].adapter,
                 ))
                 i += 1
             t_before = self.clock.now
@@ -361,9 +436,26 @@ class SimDriver:
                 "prefix_tokens_reused": eng.prefix_tokens_reused,
                 "prefix_evictions": eng.prefix_evictions,
             }
+        adapter_extra: dict = {}
+        if self.adapters is not None:
+            # registry churn counters (adapter hit/evict — the
+            # scheduler-level cost of multi-tenant adapter traffic,
+            # gated on CPU like everything else)
+            st = self.adapters.stats()
+            adapter_extra["adapters"] = {
+                "n_tenants": len({a.adapter for a in tr.arrivals
+                                  if a.adapter}),
+                "budget": self.sim.adapter_budget,
+                "loads": st["loads"],
+                "hits": st["hits"],
+                "evictions": st["evictions"],
+                "load_failures": st["load_failures"],
+                "resident_at_drain": st["resident"],
+            }
         s = self.sim
         return {
             "format": REPORT_FORMAT, "version": REPORT_VERSION,
+            **adapter_extra,
             "trace": {
                 "name": tr.name, "seed": tr.seed, "n_requests": len(tr.arrivals),
                 "duration_s": round(tr.duration_s, 6),
@@ -443,6 +535,10 @@ SCENARIOS: dict = {
         n_pages=18, max_queue=6, queue_deadline_s=0.75, deadline_s=3.0,
         prefill_chunk_tokens=32,
     ),
+    # 4 Zipf-popular tenants over a 2-adapter host-RAM budget: the
+    # hot tenants stay resident, the tail churns — loads, hits AND
+    # evictions all fire (serving/adapters.py §7)
+    "adapter-zipf": SimConfig(adapter_budget=2),
 }
 
 

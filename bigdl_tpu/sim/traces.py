@@ -35,11 +35,14 @@ VERSION = 1
 
 @dataclasses.dataclass
 class Arrival:
-    """One request of the offered load: submit at simulated second `t`."""
+    """One request of the offered load: submit at simulated second `t`.
+    `adapter` names the LoRA fine-tune this tenant decodes with (None =
+    the shared base; serving/adapters.py)."""
 
     t: float
     prompt: list
     max_new_tokens: int
+    adapter: Optional[str] = None
 
     def tokens_offered(self) -> int:
         return len(self.prompt) + self.max_new_tokens
@@ -73,6 +76,8 @@ class Trace:
         for a in self.arrivals:
             rec = {"t": round(a.t, 6), "prompt": a.prompt,
                    "max_new_tokens": a.max_new_tokens}
+            if a.adapter is not None:
+                rec["adapter"] = a.adapter
             lines.append(crc_line(json.dumps(rec, sort_keys=True)))
         return lines
 
@@ -104,7 +109,8 @@ class Trace:
         if head.get("format") != FORMAT:
             raise ValueError(f"{path}: not a {FORMAT} file")
         arrivals = [Arrival(t=b["t"], prompt=list(b["prompt"]),
-                            max_new_tokens=b["max_new_tokens"])
+                            max_new_tokens=b["max_new_tokens"],
+                            adapter=b.get("adapter"))
                     for b in bodies[1:]]
         if head.get("n") != len(arrivals):
             raise ValueError(
@@ -220,6 +226,27 @@ def prefix_heavy_trace(rate_rps: float, n_requests: int, seed: int = 0,
     })
 
 
+def assign_adapters(trace: Trace, n_adapters: int, seed: int = 0,
+                    zipf_a: float = 1.3,
+                    name_fmt: str = "tenant-{:02d}") -> Trace:
+    """Stamp every arrival with an adapter id drawn from a seeded,
+    truncated Zipf over `n_adapters` tenants — the multi-tenant
+    popularity law (a few hot fine-tunes, a long cold tail) that makes
+    the registry's LRU/eviction behavior measurable: a budget below
+    n_adapters forces churn exactly on the tail. Deterministic in
+    `seed`; mutates + returns `trace` (its params record the draw)."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_adapters + 1, dtype=np.float64)
+    p = ranks ** -zipf_a
+    p /= p.sum()
+    draws = rng.choice(n_adapters, size=len(trace.arrivals), p=p)
+    for a, k in zip(trace.arrivals, draws):
+        a.adapter = name_fmt.format(int(k))
+    trace.params["n_adapters"] = n_adapters
+    trace.params["zipf_a"] = zipf_a
+    return trace
+
+
 # ---------------------------------------------------------------------------
 # named mixes: the CLI / bench.py vocabulary. Sizes are chosen so every
 # mix completes on CPU (tiny-llama token dynamics) in seconds while
@@ -228,7 +255,8 @@ def prefix_heavy_trace(rate_rps: float, n_requests: int, seed: int = 0,
 # all fire (sim/engine_driver.py pairs it with a small page pool).
 # ---------------------------------------------------------------------------
 
-TRACE_NAMES = ("poisson", "bursty", "prefix-heavy", "overload")
+TRACE_NAMES = ("poisson", "bursty", "prefix-heavy", "overload",
+               "adapter-zipf")
 
 
 def named_trace(name: str, seed: int = 0) -> Trace:
@@ -250,5 +278,15 @@ def named_trace(name: str, seed: int = 0) -> Trace:
         return poisson_trace(
             rate_rps=40.0, n_requests=48, seed=seed, name="overload",
             prompt_len=(24, 56), out_tokens=(16, 32),
+        )
+    if name == "adapter-zipf":
+        # the multi-tenant workload (serving/adapters.py §7): Poisson
+        # arrivals, each naming one of 4 tenants' LoRA adapters under a
+        # Zipf popularity law — the scenario pairs it with a 2-adapter
+        # registry budget so LRU eviction + reload churn genuinely fire
+        return assign_adapters(
+            poisson_trace(rate_rps=8.0, n_requests=40, seed=seed,
+                          name="adapter-zipf"),
+            n_adapters=4, seed=seed,
         )
     raise ValueError(f"unknown trace mix {name!r}; known: {TRACE_NAMES}")

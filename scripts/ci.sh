@@ -98,6 +98,19 @@ hit_rate = pref["kv"]["prefix_hits"] / pref["trace"]["n_requests"]
 assert hit_rate > 30 / 40, f"radix hit-rate {hit_rate} <= full-page baseline"
 assert pref["kv"]["prefix_tokens_reused"] > 16, \
     "mid-page (sub-page) reuse regressed to the full-page baseline"
+# multi-tenant LoRA adapter smoke (ISSUE 15): a 4-tenant Zipf trace over
+# a 2-adapter host-RAM budget must churn the registry (loads AND
+# evictions), leak nothing, and report byte-identically at one seed
+from bigdl_tpu.sim.engine_driver import report_json
+adz = run_scenario("adapter-zipf", seed=0, model=m)
+assert adz["adapters"]["loads"] > 0, "adapter trace must load adapters"
+assert adz["adapters"]["evictions"] > 0, \
+    "2-adapter budget over 4 tenants must evict"
+assert adz["adapters"]["load_failures"] == 0, adz["adapters"]
+assert adz["kv"]["page_leak_at_drain"] == 0, "adapter-zipf page leak"
+assert report_json(adz) == report_json(
+    run_scenario("adapter-zipf", seed=0, model=m)
+), "adapter-zipf report must be byte-identical at seed 0"
 print("sim smoke: prefix-heavy %.0f tok/s (%d hits, %d tokens reused, "
       "%d evictions), overload shed_rate %.2f, preemptions %d, "
       "prefill_chunks %d, itl p99 %.4fs" % (
@@ -107,6 +120,11 @@ print("sim smoke: prefix-heavy %.0f tok/s (%d hits, %d tokens reused, "
           over["rates"]["shed_rate"], over["counters"]["preemptions"],
           over["counters"]["prefill_chunks"],
           over["latency"]["itl_s"]["p99"]))
+print("adapter smoke: %d loads, %d hits, %d evictions over %d tenants "
+      "(budget 2), resident %d at drain" % (
+          adz["adapters"]["loads"], adz["adapters"]["hits"],
+          adz["adapters"]["evictions"], adz["adapters"]["n_tenants"],
+          adz["adapters"]["resident_at_drain"]))
 PY
   echo "CORE OK"
   exit 0

@@ -22,12 +22,12 @@ def _cache(n_pages=64):
     return RadixPrefixCache(PAGE, pool), pool
 
 
-def _admit(cache, pool, prompt):
+def _admit(cache, pool, prompt, ns=None):
     """A minimal engine-admission stand-in: match, allocate fresh pages
     for the uncovered remainder, register fully-covered pages, then
     release the slot holds (the request 'finishes' immediately).
     Returns the number of full-page hits."""
-    path = cache.match(prompt)
+    path = cache.match(prompt, ns=ns)
     shared = [nd.page for nd in path]
     for pg in shared:
         pool.incref(pg)
@@ -40,7 +40,7 @@ def _admit(cache, pool, prompt):
             pg = pool.alloc()
         fresh.append(pg)
     table = shared + fresh
-    node = path[-1] if path else cache.root
+    node = path[-1] if path else cache.root_for(ns)
     for i in range(len(path), len(prompt) // PAGE):
         key = tuple(prompt[i * PAGE:(i + 1) * PAGE])
         nxt = node.children.get(key)
@@ -196,3 +196,120 @@ def test_lru_hits_scale_constant_time():
         assert len(cache.match(p)) == 1
     dt = time.perf_counter() - t0
     assert dt < 2.0, f"20k hits over a 20k-node cache took {dt:.2f}s"
+
+
+# ---------------------------------------------------------------------------
+# cache-aware admission ordering (ISSUE 15 satellite: match_len probe +
+# engine._pop_deepest_match)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.core
+def test_match_len_counts_without_lru_touch():
+    cache, pool = _cache()
+    pre = list(range(1, 3 * PAGE + 1))
+    _admit(cache, pool, pre + [99])
+    other = [7] * (2 * PAGE)
+    _admit(cache, pool, other + [98])
+    # LRU order now: pre-chain nodes older than other-chain nodes.
+    order_before = [nd.page for nd in cache.nodes()]
+    # probe matches the same bound as match(): full pages, one tail
+    # token always left to prefill
+    assert cache.match_len(pre + [99]) == 3 * PAGE
+    assert cache.match_len(pre[:PAGE] + [50, 51]) == PAGE
+    assert cache.match_len([42] * 10) == 0
+    # a prompt ENDING flush with a cached run leaves the last page to
+    # prefill (its logits seed generation) — same rule as match()
+    assert cache.match_len(pre) == 2 * PAGE
+    # read-only: scoring promoted nothing
+    assert [nd.page for nd in cache.nodes()] == order_before
+    # ...whereas a real match() does promote
+    cache.match(pre + [99])
+    assert [nd.page for nd in cache.nodes()] != order_before
+
+
+@pytest.mark.core
+def test_pop_deepest_match_orders_and_keeps_fifo_ties():
+    """engine._pop_deepest_match: deepest cached prefix pops first;
+    ties (including all-miss) keep strict FIFO."""
+    import jax
+
+    from bigdl_tpu import optimize_model
+    from bigdl_tpu.api import TpuModel
+    from bigdl_tpu.models import llama
+    from bigdl_tpu.models.config import PRESETS
+    from bigdl_tpu.serving.engine import InferenceEngine
+
+    cfg = PRESETS["tiny-llama"]
+    params = optimize_model(
+        llama.init_params(cfg, jax.random.PRNGKey(7)), cfg, "sym_int4"
+    )
+    eng = InferenceEngine(TpuModel(cfg, params, "sym_int4"), n_slots=2,
+                          max_len=128, paged=True, page_size=16)
+    pre = list(range(1, 33))  # 2 full pages at page_size 16
+    seed = eng.submit(pre + [40, 41], max_new_tokens=2)
+    eng.run_until_idle(max_steps=100)
+    assert seed.done and eng.radix.n_nodes == 2  # cache primed
+    # queue: miss A, 1-page match B, 2-page match C, miss D
+    a = eng.submit([9] * 8, max_new_tokens=2)
+    b = eng.submit(pre[:16] + [7, 7], max_new_tokens=2)
+    c = eng.submit(pre + [8, 8], max_new_tokens=2)
+    d = eng.submit([3] * 8, max_new_tokens=2)
+    assert eng._pop_deepest_match() is c   # deepest first
+    assert eng._pop_deepest_match() is b   # then the 1-page match
+    assert eng._pop_deepest_match() is a   # 0-0 tie: FIFO
+    assert eng._pop_deepest_match() is d
+    assert eng._pop_deepest_match() is None
+    for r in (a, b, c, d):  # drain cleanly (they were popped, not run)
+        eng._finish_detached(r, "stop")
+    assert eng.idle()
+
+
+# ---------------------------------------------------------------------------
+# adapter namespaces: cross-tenant pages unreachable by construction
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.core
+def test_namespaces_isolate_adapter_pages():
+    """KV pages prefilled under a LoRA adapter carry its shifted K/V —
+    the same token content cached under another tenant (or the base)
+    must never match (docs/serving.md §7)."""
+    cache, pool = _cache()
+    p = list(range(1, 14))  # 3 full pages + tail
+    _admit(cache, pool, p)                 # base
+    _admit(cache, pool, p, ns="tenant-a")  # same tokens, tenant A
+    assert cache.n_nodes == 6  # two disjoint 3-node chains
+    # each namespace matches only its own chain
+    assert len(cache.match(p)) == 3
+    assert len(cache.match(p, ns="tenant-a")) == 3
+    assert cache.match(p, ns="tenant-b") == []
+    assert {nd.page for nd in cache.match(p)}.isdisjoint(
+        {nd.page for nd in cache.match(p, ns="tenant-a")}
+    )
+    # match_len scores per-namespace and, read-only, materializes no
+    # root for a namespace nothing has cached under
+    assert cache.match_len(p) == 3 * PAGE
+    assert cache.match_len(p, ns="tenant-a") == 3 * PAGE
+    assert cache.match_len(p, ns="never-seen") == 0
+    assert "never-seen" not in cache._ns_roots
+    cache.check()  # invariant walk covers namespace roots
+
+
+@pytest.mark.core
+def test_namespace_nodes_evict_and_clear():
+    """Namespace chains ride the shared LRU: leaf-first eviction
+    unlinks them from their tenant root, and clear() drops the roots
+    themselves (engine _reset_state rebuilds the pool alongside)."""
+    cache, pool = _cache()
+    _admit(cache, pool, list(range(1, 10)), ns="t")  # 2-node chain
+    assert cache.n_nodes == 2
+    assert cache.evict_one() and cache.evict_one()
+    cache.check()
+    assert cache.n_nodes == 0
+    assert cache.root_for("t").children == {}
+    assert pool.n_free == pool.n_pages - 1  # page 0 = scratch
+    _admit(cache, pool, list(range(1, 10)), ns="t")
+    cache.clear()
+    assert cache.n_nodes == 0 and cache._ns_roots == {}
+    assert pool.n_free == pool.n_pages - 1
