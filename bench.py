@@ -590,7 +590,9 @@ def child_analytic() -> dict:
     a dead-tunnel day, so perf PRs always land with a number."""
     os.environ["BENCH_FORCE_CPU"] = "1"  # never touch the tunnel
     _child_setup()
-    from bigdl_tpu.benchmark.roofline import attention_matrix, gemm_matrix
+    from bigdl_tpu.benchmark.roofline import (
+        attention_matrix, collective_matrix, gemm_matrix,
+    )
     from bigdl_tpu.ops.linear import _QGEMV_QTYPES
 
     rows = gemm_matrix(sorted(_QGEMV_QTYPES), Ms=(1, 128, 512, 2048),
@@ -598,6 +600,12 @@ def child_analytic() -> dict:
     # attention twin (ISSUE 13): flash prefill + paged decode at the
     # kernels' real tile shapes, bf16 and fp8 KV — same no-device story
     rows.update(attention_matrix())
+    # collective twin (ISSUE 17): the per-layer TP all-reduce's ICI
+    # bytes + modeled ring time at llama2-7b tp=4, fp32 vs the
+    # quantized wire formats (parallel/qcollectives.py)
+    rows.update(collective_matrix())
+    ar32 = rows["allreduce_tp4_m1_fp32"]
+    ar8 = rows["allreduce_tp4_m1_int8"]
     m512 = rows["sym_int4_m512"]
     return {
         "metric": "fused_gemm_analytic_bytes_ratio_m512",
@@ -605,6 +613,10 @@ def child_analytic() -> dict:
         "unit": "x_vs_xla_dequant",
         "vs_baseline": 0,
         "shape": m512["shape"],
+        "collective_int8_bytes_ratio_tp4": ar8["bytes_ratio_vs_fp32"],
+        "collective_int8_time_recovered_tp4": round(
+            1 - ar8["per_step_s"] / ar32["per_step_s"], 4
+        ),
         "analytic": rows,
     }
 

@@ -50,7 +50,8 @@ class WallClockBan(Check):
     rule = "WCT001"
     description = (
         "wall-clock calls in clock-injected subsystems (serving/, obs/, "
-        "train/supervisor.py, parallel/health.py)"
+        "train/supervisor.py, parallel/health.py, "
+        "parallel/qcollectives.py)"
     )
 
     SCOPES = (
@@ -60,6 +61,9 @@ class WallClockBan(Check):
         # wall-clock call would silently re-couple reports to the host
         "bigdl_tpu/train/supervisor.py",
         "bigdl_tpu/parallel/health.py",
+        # collectives run inside jit traces priced by roofline/sim
+        # models — any host-clock call there is a trace-time landmine
+        "bigdl_tpu/parallel/qcollectives.py",
     )
     BANNED = {
         "time.time", "time.time_ns", "time.monotonic",

@@ -55,6 +55,10 @@ class TpuModel:
     # set by to_mesh(): params are sharded over this jax.sharding.Mesh and
     # every generate/serving entry point runs SPMD under it
     mesh: Optional[Any] = None
+    # set by to_mesh(comm_qtype=...): parallel/qcollectives.CommConfig —
+    # routes the TP row-parallel epilogues through the block-quantized
+    # ring all-reduce; None keeps GSPMD's implicit fp32 psum
+    comm: Optional[Any] = None
 
     @property
     def family(self):
@@ -74,7 +78,23 @@ class TpuModel:
         the same (config, params, tokens, cache, mode, last_logits_only)
         call shape so callers don't branch."""
         if self.pp_size <= 1:
-            return self.family.forward
+            fwd = self.family.forward
+            if self.comm is not None and self.comm.enabled:
+                if getattr(self, "_comm_fwd", None) is None:
+                    import functools
+                    import inspect
+
+                    if "comm" not in inspect.signature(fwd).parameters:
+                        raise NotImplementedError(
+                            f"{self.config.model_type}'s forward does not "
+                            "take comm= — quantized TP collectives are "
+                            "wired for the llama family only"
+                        )
+                    # cached: a stable callable identity keeps the jit
+                    # caches in generate/serving warm across calls
+                    self._comm_fwd = functools.partial(fwd, comm=self.comm)
+                return self._comm_fwd
+            return fwd
         if getattr(self, "_pp_step", None) is None:
             from bigdl_tpu.parallel.pipeline import make_pipeline_step
 
@@ -109,7 +129,8 @@ class TpuModel:
 
     def to_mesh(self, mesh=None, tp: Optional[int] = None,
                 dp: Optional[int] = None, sp: int = 1,
-                pp: int = 1) -> "TpuModel":
+                pp: int = 1,
+                comm_qtype: Optional[str] = None) -> "TpuModel":
         """Shard the params for multi-chip inference and make generate()
         / the serving engine run SPMD over the mesh.
 
@@ -127,6 +148,13 @@ class TpuModel:
 
         mesh=None builds a (pp, dp, sp, tp) mesh over all visible devices
         (tp defaulting to every device).
+
+        comm_qtype ("none"|"int8"|"fp8_e4m3", default "none" — or the
+        model's `default_comm_qtype` attribute, which `serve
+        --comm-qtype` sets) quantizes the wire format of the per-layer
+        TP all-reduce epilogues (parallel/qcollectives.py,
+        docs/parallelism.md): block-scaled payloads with error feedback
+        replace the implicit fp32 psum behind wo / w_down.
         """
         from bigdl_tpu.parallel import make_mesh, shard_params
         from bigdl_tpu.parallel.mesh import mesh_shape_for
@@ -208,6 +236,24 @@ class TpuModel:
             specs = pp_param_specs(self.config, specs)
         self.params = shard_params(self.params, specs, mesh)
         self._pp_step = None  # rebuilt for the new mesh on next use
+        self._comm_fwd = None
+        from bigdl_tpu.parallel.qcollectives import (
+            CommConfig, resolve_comm_qtype,
+        )
+
+        cq = resolve_comm_qtype(
+            comm_qtype if comm_qtype is not None
+            else getattr(self, "default_comm_qtype", None)
+        )
+        self.comm = None
+        if cq != "none":
+            if self.pp_size > 1:
+                raise NotImplementedError(
+                    "comm_qtype is wired for the tp epilogues of the "
+                    "single-stage forward; pipeline stages keep fp32 "
+                    "collectives (pp=1 to quantize comms)"
+                )
+            self.comm = CommConfig(mesh=mesh, axis_name="tp", qtype=cq)
         return self
 
     def _mesh_ctx(self):

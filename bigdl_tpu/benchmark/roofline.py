@@ -216,3 +216,99 @@ def gemm_matrix(qtypes, Ms=(1, 128, 512, 2048), K: int = 4096,
             c = qmatmul_cost(qt, m, K, O)
             out[f"{qt}_m{m}"] = c
     return out
+
+
+# ---------------------------------------------------------------------------
+# quantized ICI collectives (parallel/qcollectives.py): bytes on the
+# interconnect per algorithm x payload format. `ici_gbps` is the
+# calibration knob twin of sim/cost.py's `hbm_gbps` — the achievable
+# per-chip ring bandwidth the next live-TPU window tunes against
+# measured hop times.
+# ---------------------------------------------------------------------------
+
+_SCALE_BPE = 2  # f16 per-block absmax scales (the codec's sidecar)
+_COMM_BLOCK = 256  # qcollectives.DEFAULT_BLOCK (kept in sync by test)
+
+
+def collective_payload_bytes(n_elems: int, comm_qtype: str = "none",
+                             block_size: int = _COMM_BLOCK) -> int:
+    """Wire bytes of one encoded payload of `n_elems` fp32 values:
+    fp32 as-is for "none", 1 byte/elem + one f16 scale per block for
+    the int8 and fp8_e4m3 codecs (identical wire size — fp8 trades
+    precision for range, not bytes)."""
+    if comm_qtype == "none":
+        return n_elems * 4
+    if comm_qtype in ("int8", "fp8_e4m3"):
+        blocks = -(-n_elems // block_size)
+        return n_elems + blocks * _SCALE_BPE
+    raise ValueError(
+        f"unknown comm_qtype {comm_qtype!r}; expected none|int8|fp8_e4m3"
+    )
+
+
+def all_reduce_cost(n_elems: int, axis_size: int,
+                    comm_qtype: str = "none",
+                    block_size: int = _COMM_BLOCK,
+                    ici_gbps=None) -> dict:
+    """Ring all-reduce of `n_elems` over an `axis_size` ring:
+    reduce-scatter (n-1 hops) + all-gather (n-1 hops), each hop moving
+    one 1/n chunk — per-device ICI bytes = 2*(n-1)/n * payload. The
+    quantized ring sends codes+scales on every hop (the error-feedback
+    residual stays device-local, costing nothing on the wire)."""
+    n = max(int(axis_size), 1)
+    payload = collective_payload_bytes(n_elems, comm_qtype, block_size)
+    fp32 = collective_payload_bytes(n_elems, "none")
+    ici = 2 * (n - 1) * payload / n
+    out = {
+        "algorithm": "ring_all_reduce", "qtype": comm_qtype,
+        "axis_size": n, "elems": n_elems,
+        "payload_bytes": payload,
+        "ici_bytes_per_device": round(ici, 1),
+        "bytes_ratio_vs_fp32": round(fp32 / max(payload, 1), 3),
+    }
+    if ici_gbps:
+        out["ring_time_s"] = ici / (float(ici_gbps) * 1e9)
+    return out
+
+
+def all_gather_cost(n_elems_local: int, axis_size: int,
+                    comm_qtype: str = "none",
+                    block_size: int = _COMM_BLOCK,
+                    ici_gbps=None) -> dict:
+    """Ring all-gather of an `n_elems_local` shard over `axis_size`
+    ranks: each shard's payload is encoded ONCE and forwarded n-1 hops
+    (per-device ICI bytes = (n-1) * payload) — PP/multihost weight and
+    KV-page distribution (sharding.gather_array)."""
+    n = max(int(axis_size), 1)
+    payload = collective_payload_bytes(n_elems_local, comm_qtype,
+                                       block_size)
+    fp32 = collective_payload_bytes(n_elems_local, "none")
+    ici = (n - 1) * payload
+    out = {
+        "algorithm": "ring_all_gather", "qtype": comm_qtype,
+        "axis_size": n, "elems_local": n_elems_local,
+        "payload_bytes": payload,
+        "ici_bytes_per_device": ici,
+        "bytes_ratio_vs_fp32": round(fp32 / max(payload, 1), 3),
+    }
+    if ici_gbps:
+        out["ring_time_s"] = ici / (float(ici_gbps) * 1e9)
+    return out
+
+
+def collective_matrix(hidden: int = 4096, layers: int = 32, tp: int = 4,
+                      ici_gbps: float = 45.0, Ms=(1, 8, 32)) -> dict:
+    """bench.py's analytic collective sweep at llama2-7b decode shapes:
+    the per-layer TP all-reduce (o-proj + down-proj epilogues, M rows x
+    hidden) at fp32 vs int8 vs fp8_e4m3, with the modeled per-decode-
+    step ring time at `ici_gbps`. Pure host math — the dead-tunnel-day
+    collective-bytes evidence ISSUE 17 banks."""
+    out = {}
+    for m in Ms:
+        for qt in ("none", "int8", "fp8_e4m3"):
+            c = all_reduce_cost(m * hidden, tp, qt, ici_gbps=ici_gbps)
+            # 2 row-parallel epilogues per layer (wo, w_down)
+            c["per_step_s"] = 2 * layers * c["ring_time_s"]
+            tag = "fp32" if qt == "none" else qt
+            out[f"allreduce_tp{tp}_m{m}_{tag}"] = c
+    return out

@@ -242,6 +242,10 @@ def cmd_serve(args):
                     f"(-q bf16/fp16); got -q {args.qtype}"
                 )
     model = _load(args.model, args.qtype)
+    # consumed by TpuModel.to_mesh() whenever the model is later sharded
+    # over a tp axis (parallel/qcollectives.py wire format for the
+    # row-parallel epilogue all-reduces; "none" keeps GSPMD's exact psum)
+    model.default_comm_qtype = args.comm_qtype
     tok = _tokenizer(args.model)
     gen = GenerationConfig(
         eos_token_id=(tok.eos_token_id if tok is not None else None)
@@ -628,7 +632,9 @@ def cmd_simserve(args):
         print(f"saved {len(trace.arrivals)}-arrival trace to "
               f"{args.save_trace}", file=sys.stderr)
     driver = SimDriver(trace, sim=sim,
-                       cost=default_cost_model(hbm_gbps=args.hbm_gbps))
+                       cost=default_cost_model(
+                           hbm_gbps=args.hbm_gbps, ici_gbps=args.ici_gbps,
+                           tp=args.tp, comm_qtype=args.comm_qtype))
     report = driver.run()
     line = report_json(report)
     if args.output:
@@ -778,6 +784,12 @@ def main(argv=None):
                    help="preload + pin an adapter at startup "
                         "(repeatable; PATH defaults to "
                         "<adapter-dir>/NAME.npz)")
+    s.add_argument("--comm-qtype", default="none",
+                   choices=("none", "int8", "fp8_e4m3"),
+                   help="multi-chip: quantize TP collectives to this "
+                        "block-scaled wire format (parallel/"
+                        "qcollectives.py; picked up by to_mesh(); "
+                        "'none' = exact fp32/bf16 ICI traffic)")
     s.set_defaults(fn=cmd_serve)
 
     fw = sub.add_parser("fastchat-worker",
@@ -906,7 +918,7 @@ def main(argv=None):
                     # literal: keep CLI startup free of sim/jax imports
                     # (must mirror sim/traces.TRACE_NAMES)
                     choices=("poisson", "bursty", "prefix-heavy",
-                             "overload", "adapter-zipf"),
+                             "overload", "adapter-zipf", "speculative"),
                     help="named trace mix (overload exercises "
                          "preemption AND shed; adapter-zipf the "
                          "multi-tenant LoRA registry churn)")
@@ -919,6 +931,19 @@ def main(argv=None):
     sv.add_argument("--hbm-gbps", type=float, default=None,
                     help="cost-model calibration knob: achievable HBM "
                          "GB/s of the modeled chip (default v5e-class)")
+    sv.add_argument("--ici-gbps", type=float, default=None,
+                    help="cost-model calibration knob: per-link ICI "
+                         "GB/s for the modeled TP ring (default "
+                         "v5e-class; only matters with --tp > 1)")
+    sv.add_argument("--tp", type=int, default=None,
+                    help="model the per-layer TP all-reduce for this "
+                         "ring size (additive comm overhead; "
+                         "default 1 = no collective term)")
+    sv.add_argument("--comm-qtype", default=None,
+                    choices=("none", "int8", "fp8_e4m3"),
+                    help="price the modeled all-reduce at this "
+                         "block-scaled wire format instead of fp32 "
+                         "(benchmark/roofline.all_reduce_cost)")
     sv.add_argument("--save-trace", default=None,
                     help="bank the generated arrival trace as crc'd "
                          "JSONL")

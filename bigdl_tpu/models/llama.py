@@ -532,6 +532,10 @@ def forward(
     remat: bool = False,  # static: jax.checkpoint each scan layer —
     # backward recomputes the layer instead of saving its activations
     # (long-context training memory lever; make_train_step(remat=True))
+    comm=None,  # static: parallel/qcollectives.CommConfig — routes the
+    # row-parallel epilogues (wo, w_down) through the explicit
+    # block-quantized ring all-reduce instead of GSPMD's implicit fp32
+    # psum. None or comm_qtype="none" keeps today's path bit-identical.
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache with pos advanced).
 
@@ -713,8 +717,21 @@ def forward(
 
     lora_scale = lora["scale"] if lora is not None else None
 
+    quantize_comm = comm is not None and comm.enabled
+
     def proj(x, p, lp, wname, bname=None):
-        y = linear(x, p[wname], p.get(bname) if bname else None, compute_dtype)
+        b = p.get(bname) if bname else None
+        if quantize_comm and wname in ("wo", "w_down"):
+            # the two per-layer row-parallel epilogues whose implicit TP
+            # psum the quantized ring replaces (the lm_head's single
+            # vocab-shard reduce and MoE experts stay on GSPMD's); the
+            # LoRA delta below still reduces implicitly — rank-r traffic
+            # is negligible next to the hidden-size epilogue
+            from bigdl_tpu.ops.linear import row_parallel_linear
+
+            y = row_parallel_linear(x, p[wname], comm, b, compute_dtype)
+        else:
+            y = linear(x, p[wname], b, compute_dtype)
         if lp is not None and wname in lp:
             y = y + _lora_delta(x, lp[wname], lora_scale, compute_dtype)
         return y

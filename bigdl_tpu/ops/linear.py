@@ -335,3 +335,105 @@ def linear(
     if bias is not None:
         y = y + bias.astype(compute_dtype)
     return y
+
+
+def _half_split_perm(a: jax.Array, n: int) -> jax.Array:
+    """Reorder the last axis from half-split to shard-major order.
+
+    `pack_nibbles` stores column j and column j + K/2 in the same byte,
+    so shard s of the packed axis holds columns [s*h, (s+1)*h) of EACH
+    half (h = K/(2n)). [..., 2, n, h] -> [..., n, 2, h]: after this, a
+    contiguous 1/n slice of the last axis is exactly the column set the
+    matching packed-byte slice carries. Applied to x and to the
+    per-block scales/mins (whose last axis has the same half-block
+    structure at K/block granularity)."""
+    m = a.shape[-1] // (2 * n)
+    a = a.reshape(*a.shape[:-1], 2, n, m)
+    return a.swapaxes(-3, -2).reshape(*a.shape[:-3], 2 * n * m)
+
+
+def row_parallel_linear(
+    x: jax.Array,
+    w: Union[QTensor, jax.Array],
+    comm,
+    bias: Optional[jax.Array] = None,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """`linear` for a row-parallel (contraction-sharded) weight with an
+    EXPLICIT quantized all-reduce epilogue (parallel/qcollectives.py).
+
+    Under plain GSPMD the psum behind wo / w_down is implicit — XLA
+    inserts it from the shardings, fp32/bf16 on the wire. A
+    `CommConfig` with a quantized `comm_qtype` replaces that one
+    epilogue with a shard_map partial matmul + block-scaled ring
+    all-reduce with error feedback; ``comm.enabled == False`` (qtype
+    "none" or a 1-wide axis) falls straight back to `linear`, leaving
+    the implicit-psum path bit-identical to today's.
+
+    The shard_map's in_specs shard only `comm.axis_name` (x's
+    contraction dim, W's K dim); other mesh axes see the operands
+    replicated at this boundary, which is the decode-epilogue regime the
+    quantized ring targets (tiny M, weight-stationary). Bias is added
+    AFTER the reduce, once.
+
+    QTensor weights need care: unlike GSPMD (where sharding is pure
+    layout and XLA sees the whole dequant+matmul), shard_map hands each
+    shard a literal byte slice. `pack_nibbles`' half-split layout means
+    byte j of the packed axis carries logical columns j AND j + K/2, so
+    a contiguous byte slice is a NON-contiguous column set — x and the
+    per-block scales are permuted into that same shard-major order
+    before slicing (`_half_split_perm`), which keeps every shard's
+    sub-QTensor self-consistent and the fused dequant-GEMM path intact.
+    Layouts that cannot be sliced consistently (bit planes, k-quant
+    superblocks, shards that straddle a scale block) dequantize once and
+    take the dense partial-matmul path instead."""
+    if comm is None or not comm.enabled:
+        return linear(x, w, bias, compute_dtype)
+    import dataclasses
+
+    from bigdl_tpu.parallel import qcollectives as qc
+    from bigdl_tpu.parallel._compat import shard_map
+
+    from jax.sharding import PartitionSpec as P
+
+    ax = comm.axis_name
+    n = comm.axis_size
+    if isinstance(w, QTensor):
+        spec = w.spec
+        K = w.shape[-1]
+        h = K // (2 * n)  # columns per nibble plane per shard
+        if (spec.storage == "packed_u8" and not spec.superblock
+                and w.sub_scales is None
+                and K % (2 * n) == 0 and h % spec.block_size == 0):
+            x = _half_split_perm(x, n)
+            w = dataclasses.replace(
+                w, scales=_half_split_perm(w.scales, n),
+                mins=(None if w.mins is None
+                      else _half_split_perm(w.mins, n)),
+            )
+        elif (spec.storage in ("int8", "fp8_e4m3", "fp8_e5m2")
+                and not spec.superblock and w.sub_scales is None
+                and K % n == 0 and (K // n) % spec.block_size == 0):
+            pass  # unpacked codes: contiguous K slices self-consistent
+        else:
+            w = w.dequantize(compute_dtype)
+    if not isinstance(w, QTensor) and x.shape[-1] % n:
+        # contraction dim not shardable: keep the exact implicit psum
+        return linear(x, w, bias, compute_dtype)
+    xspec = P(*([None] * (x.ndim - 1) + [ax]))
+    wspec = P(None, ax)  # [O, K/n]; QTensor leaves take it as a prefix
+
+    def part(xs, ws):
+        y = linear(xs, ws, None, compute_dtype)
+        return qc.quantized_psum(
+            y, ax, qtype=comm.qtype, axis_size=n,
+            block_size=comm.block_size,
+            error_feedback=comm.error_feedback,
+        )
+
+    f = shard_map(part, mesh=comm.mesh, in_specs=(xspec, wspec),
+                  out_specs=P(), check_vma=False)
+    y = f(x, w)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y

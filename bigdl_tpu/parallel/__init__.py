@@ -24,6 +24,14 @@ from bigdl_tpu.parallel.health import (
 )
 from bigdl_tpu.parallel.mesh import make_mesh, mesh_shape_for
 from bigdl_tpu.parallel.multihost import host_aware_mesh, init_multihost
+from bigdl_tpu.parallel.qcollectives import (
+    COMM_QTYPES,
+    CommConfig,
+    quantized_all_gather,
+    quantized_psum,
+    quantized_reduce_scatter,
+    resolve_comm_qtype,
+)
 from bigdl_tpu.parallel.sharding import (
     layer_specs,
     param_specs,
@@ -32,6 +40,8 @@ from bigdl_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "COMM_QTYPES",
+    "CommConfig",
     "HealthMonitor",
     "RankDropError",
     "anomaly_consensus",
@@ -43,6 +53,10 @@ __all__ = [
     "mesh_shape_for",
     "param_specs",
     "layer_specs",
+    "quantized_all_gather",
+    "quantized_psum",
+    "quantized_reduce_scatter",
+    "resolve_comm_qtype",
     "shard_params",
     "sharding_tree",
 ]

@@ -248,3 +248,19 @@ def sharding_tree(specs: dict, mesh: Mesh, params) -> dict:
 def shard_params(params, specs: dict, mesh: Mesh):
     """Place a param tree onto the mesh (host → sharded device buffers)."""
     return jax.device_put(params, sharding_tree(specs, mesh, params))
+
+
+def gather_array(x, mesh: Mesh, axis_name: str = "tp",
+                 comm_qtype: str = "none"):
+    """Replicate an axis-0-sharded array to every device along
+    `axis_name` — PP/multihost weight distribution and KV-page handout.
+
+    With a quantized `comm_qtype` ("int8"|"fp8_e4m3") the wire format
+    is the block-scaled ring all-gather of parallel/qcollectives.py
+    (each shard encodes once, payloads forward unchanged, every rank
+    decodes identical bytes) instead of GSPMD's fp32/bf16 all-gather —
+    the bandwidth-bound half of the multi-chip story, priced by
+    `benchmark/roofline.all_gather_cost`."""
+    from bigdl_tpu.parallel.qcollectives import mesh_all_gather
+
+    return mesh_all_gather(x, mesh, axis_name=axis_name, qtype=comm_qtype)

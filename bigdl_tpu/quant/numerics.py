@@ -56,10 +56,18 @@ def pack_nibbles(codes: jax.Array) -> jax.Array:
 
 
 def unpack_nibbles(packed: jax.Array) -> jax.Array:
-    """[..., K//2] packed uint8 -> [..., K] uint8 codes (element order)."""
-    lo = packed & 0xF
-    hi = packed >> 4
-    return jnp.concatenate([lo, hi], axis=-1)
+    """[..., K//2] packed uint8 -> [..., K] uint8 codes (element order).
+
+    Written as broadcast-shift + reshape rather than
+    ``concatenate([lo, hi], -1)``: the pinned jaxlib's SPMD partitioner
+    miscompiles concatenate along a sharded axis whenever the mesh has a
+    second non-trivial axis (partial replication), which silently
+    corrupted every packed-weight dequant on dp>1 inference meshes.
+    The two spellings are bit-identical on unsharded inputs.
+    """
+    shifts = jnp.asarray([0, 4], jnp.uint8)[:, None]
+    out = (packed[..., None, :] >> shifts) & 0xF
+    return out.reshape(*packed.shape[:-1], 2 * packed.shape[-1])
 
 
 def pack_planes(codes: jax.Array, planes: tuple) -> jax.Array:
@@ -90,7 +98,12 @@ def pack_planes(codes: jax.Array, planes: tuple) -> jax.Array:
 
 
 def unpack_planes(data: jax.Array, planes: tuple, k: int) -> jax.Array:
-    """Inverse of pack_planes: concatenated planes -> [..., K] uint8."""
+    """Inverse of pack_planes: concatenated planes -> [..., K] uint8.
+
+    Same broadcast-shift + reshape spelling as unpack_nibbles (instead of
+    a concatenate over the per-byte sub-element splits) — see the
+    sharded-concatenate note there.
+    """
     off = 0
     shift = 0
     code = None
@@ -98,10 +111,9 @@ def unpack_planes(data: jax.Array, planes: tuple, k: int) -> jax.Array:
         s = 8 // bits
         q = k // s
         plane = data[..., off:off + q]
-        vals = jnp.concatenate(
-            [(plane >> (bits * m)) & ((1 << bits) - 1) for m in range(s)],
-            axis=-1,
-        )
+        shifts = (bits * jnp.arange(s, dtype=jnp.uint8))[:, None]
+        vals = (plane[..., None, :] >> shifts) & ((1 << bits) - 1)
+        vals = vals.reshape(*plane.shape[:-1], s * q)
         part = (vals.astype(jnp.uint8) << shift).astype(jnp.uint8)
         code = part if code is None else code | part
         off += q

@@ -23,7 +23,8 @@ import dataclasses
 from typing import Optional
 
 from bigdl_tpu.benchmark.roofline import (
-    decode_attention_cost, flash_prefill_cost, qmatmul_cost,
+    all_reduce_cost, decode_attention_cost, flash_prefill_cost,
+    qmatmul_cost,
 )
 from bigdl_tpu.models.config import ModelConfig
 from bigdl_tpu.quant.qtypes import resolve_qtype
@@ -49,6 +50,22 @@ class CostModel:
     page_size: int = 64
     quantize_kv: bool = False
     label: str = ""
+    #: tensor-parallel degree of the MODELED deployment. tp > 1 adds the
+    #: per-layer TP all-reduce epilogues (wo + w_down, M x hidden each)
+    #: over the ICI ring to every decode step / prefill chunk. The charge
+    #: is purely ADDITIVE — compute is deliberately NOT divided by tp, so
+    #: this knob prices the communication OVERHEAD of going multi-chip
+    #: (decode_step_s rises with tp at fp32; quantized comms claw it
+    #: back), not the compute speedup. tp=1 (default) charges nothing and
+    #: keeps every banked report byte-identical.
+    tp: int = 1
+    #: achievable per-chip ICI GB/s — the collective calibration knob
+    #: twin of hbm_gbps (benchmark/roofline.py collective cost model);
+    #: default is a v5e-class 45 GB/s per link direction
+    ici_gbps: float = 45.0
+    #: wire format of the TP all-reduce ("none"|"int8"|"fp8_e4m3") —
+    #: parallel/qcollectives.py's comm_qtype knob, priced here
+    comm_qtype: str = "none"
 
     # -- pieces --------------------------------------------------------------
 
@@ -141,6 +158,18 @@ class CostModel:
         flops = sum(2 * M * r * d for r, d in items) * L
         return {"bytes": nbytes, "flops": flops}
 
+    def tp_comm_s(self, M: int) -> float:
+        """Seconds of per-forward TP collective traffic at M rows: two
+        ring all-reduces per layer (the wo and w_down row-parallel
+        epilogues parallel/qcollectives.py makes explicit), each over
+        [M, hidden] at `comm_qtype`'s wire format, serialized on the
+        ICI ring at `ici_gbps`. Zero at tp=1."""
+        if self.tp <= 1 or M <= 0:
+            return 0.0
+        c = all_reduce_cost(M * self.config.hidden_size, self.tp,
+                            self.comm_qtype, ici_gbps=self.ici_gbps)
+        return 2 * self.config.num_hidden_layers * c["ring_time_s"]
+
     def kv_token_bytes(self) -> int:
         """HBM bytes one token's K+V occupies across all layers."""
         cfg = self.config
@@ -173,7 +202,54 @@ class CostModel:
         lo = self.lora_cost(adapter_ranks, M=1)
         return self._seconds(lin["bytes"] + att["bytes"] + lo["bytes"],
                              lin["flops"] + att["flops"] + lo["flops"]) \
-            + self.step_overhead_s
+            + self.tp_comm_s(len(rows)) + self.step_overhead_s
+
+    def spec_round_s(self, positions, page: int, draft_k: int,
+                     paged: bool = True, max_len: int = 0,
+                     adapter_ranks=()) -> float:
+        """One speculative round (serving/engine.py `_spec_decode`):
+        `draft_k` sequential per-token draft steps at advancing
+        positions, then ONE batched verify forward over each row's
+        draft_k+1 candidate tokens through the target. Monotonically
+        increasing in draft_k (each extra draft adds a full decode-step
+        charge plus a wider verify).
+
+        Approximation (documented in docs/benchmarking.md): the draft
+        model is priced at this CostModel's own qtype/config — the
+        engine's self-draft shares the target's architecture, and the
+        sym_int4 default IS the self-draft's format; a separately-sized
+        draft model would need its own CostModel."""
+        rows = list(positions)
+        if not rows:
+            return self.step_overhead_s
+        if draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+        cfg = self.config
+        total = 0.0
+        for i in range(draft_k):
+            total += self.decode_step_s(
+                [p + i for p in rows], page, paged=paged,
+                max_len=max_len, adapter_ranks=adapter_ranks,
+            )
+        # verify: M = rows * (K+1) candidate tokens through every
+        # projection; each candidate's attention sweeps its row's KV at
+        # the post-draft depth (the verify writes K drafts first, so
+        # every query sees the full speculated context)
+        M = len(rows) * (draft_k + 1)
+        lin = self.linear_cost(M)
+        vrows = [p + draft_k for p in rows for _ in range(draft_k + 1)]
+        att = decode_attention_cost(
+            vrows, page, cfg.num_attention_heads,
+            cfg.num_key_value_heads, cfg.head_dim_,
+            layers=cfg.num_hidden_layers, paged=paged,
+            quantize_kv=self.quantize_kv, max_len=max_len,
+        )
+        lo = self.lora_cost(adapter_ranks, M=draft_k + 1)
+        total += self._seconds(
+            lin["bytes"] + att["bytes"] + lo["bytes"],
+            lin["flops"] + att["flops"] + lo["flops"],
+        ) + self.tp_comm_s(M) + self.step_overhead_s
+        return total
 
     def prefill_s(self, chunk_tokens: int, prior_tokens: int = 0,
                   adapter_rank=0) -> float:
@@ -193,7 +269,7 @@ class CostModel:
         lo = self.lora_cost([adapter_rank], M=chunk_tokens)
         return self._seconds(lin["bytes"] + att["bytes"] + lo["bytes"],
                              lin["flops"] + att["flops"] + lo["flops"]) \
-            + self.step_overhead_s
+            + self.tp_comm_s(chunk_tokens) + self.step_overhead_s
 
     def suggest_prefill_chunk(self, occupancy: int = 4,
                               context_tokens: int = 1024,
@@ -238,4 +314,7 @@ class CostModel:
             "peak_tflops": self.peak_tflops,
             "step_overhead_s": self.step_overhead_s,
             "swap_gbps": self.swap_gbps,
+            "tp": self.tp,
+            "ici_gbps": self.ici_gbps,
+            "comm_qtype": self.comm_qtype,
         }

@@ -95,14 +95,26 @@ def tiny_model(qtype: str = "sym_int4", seed: int = 7):
 
 
 def default_cost_model(hbm_gbps: Optional[float] = None,
-                       quantize_kv: bool = False) -> CostModel:
+                       quantize_kv: bool = False,
+                       ici_gbps: Optional[float] = None,
+                       tp: Optional[int] = None,
+                       comm_qtype: Optional[str] = None) -> CostModel:
     """The modeled target: llama2-7b sym_int4 on a v5e-class HBM (the
-    BASELINE.json headline pair). `hbm_gbps` is the calibration knob."""
+    BASELINE.json headline pair). `hbm_gbps` is the calibration knob;
+    `ici_gbps`/`tp`/`comm_qtype` are its collective-side twins (simserve
+    --ici-gbps): tp > 1 prices the per-layer TP all-reduce into every
+    step, at fp32 or quantized wire format."""
     from bigdl_tpu.models.config import PRESETS
 
     kw: dict = {"label": "llama2-7b"}
     if hbm_gbps is not None:
         kw["hbm_gbps"] = float(hbm_gbps)
+    if ici_gbps is not None:
+        kw["ici_gbps"] = float(ici_gbps)
+    if tp is not None:
+        kw["tp"] = int(tp)
+    if comm_qtype is not None:
+        kw["comm_qtype"] = comm_qtype
     return CostModel(config=PRESETS["llama2-7b"], qtype="sym_int4",
                      quantize_kv=quantize_kv, **kw)
 
@@ -129,6 +141,14 @@ class SimConfig:
     # AdapterRegistry whose budget holds this many adapters (None =
     # unbounded — no eviction churn)
     adapter_budget: Optional[int] = None
+    # in-engine speculative decoding (serving/engine.py §spec): the
+    # engine runs REAL draft+verify rounds on the tiny model (which
+    # must be dense — bf16/fp16 — for the sym_int4 self-draft) while
+    # cost.spec_round_s prices each round as draft_k draft steps + one
+    # batched verify. Incompatible with chunked prefill and adapter
+    # traces (the engine refuses those combinations itself).
+    speculative: bool = False
+    draft_k: int = 4
     seed: int = 0
 
 
@@ -150,8 +170,15 @@ class SimDriver:
         self.clock = SimClock()
         self.host_step_s = host_step_s
         self.max_steps = max_steps
-        self.model = model if model is not None else tiny_model()
         s = self.sim
+        if model is not None:
+            self.model = model
+        elif s.speculative:
+            # the self-draft needs a dense target (api.self_draft_params
+            # re-quantizes to sym_int4); token dynamics stay tiny-llama
+            self.model = tiny_model("bf16")
+        else:
+            self.model = tiny_model()
         self._adapter_dir = None
         self.adapters = self._make_adapters()
         self.engine = InferenceEngine(
@@ -162,11 +189,8 @@ class SimDriver:
             prefill_chunk_tokens=s.prefill_chunk_tokens,
             seed=s.seed, faults=faults, tracer=tracer, clock=self.clock,
             adapters=self.adapters,
+            speculative=s.speculative, draft_k=s.draft_k,
         )
-        if self.engine.speculative:  # defensive: ctor above never sets it
-            raise NotImplementedError(
-                "the sim does not price speculative rounds yet"
-            )
         self._install_recorders()
         self._install_cost_wrappers()
         if faults is not None:
@@ -319,6 +343,23 @@ class SimDriver:
             return out
 
         eng._copy_page = copy_page
+
+        # speculative rounds: the engine's real draft+verify program
+        # runs on the tiny model; the charge is K draft steps + one
+        # batched verify at the modeled config (cost.spec_round_s)
+        if getattr(eng, "_spec_decode", None) is not None:
+            spec0 = eng._spec_decode
+
+            def spec_decode(k_draft, *a, **kw):
+                rows = self._active_positions()
+                ranks = self._active_adapter_ranks()
+                out = spec0(k_draft, *a, **kw)
+                clock.advance(cost.spec_round_s(
+                    rows, page, int(k_draft), paged=eng.paged,
+                    max_len=eng.max_len, adapter_ranks=ranks))
+                return out
+
+            eng._spec_decode = spec_decode
 
         # preemption swap traffic (round trip charged at swap-in; the
         # swap-out device_get has no jitted hook)
@@ -539,6 +580,11 @@ SCENARIOS: dict = {
     # hot tenants stay resident, the tail churns — loads, hits AND
     # evictions all fire (serving/adapters.py §7)
     "adapter-zipf": SimConfig(adapter_budget=2),
+    # real self-draft + verify rounds on a dense tiny model, each round
+    # priced as draft_k decode steps + one batched verify
+    # (cost.spec_round_s) — the ROADMAP sim-calibration remainder that
+    # previously made SimDriver refuse speculative engines
+    "speculative": SimConfig(speculative=True, draft_k=4),
 }
 
 

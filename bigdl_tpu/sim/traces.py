@@ -256,7 +256,7 @@ def assign_adapters(trace: Trace, n_adapters: int, seed: int = 0,
 # ---------------------------------------------------------------------------
 
 TRACE_NAMES = ("poisson", "bursty", "prefix-heavy", "overload",
-               "adapter-zipf")
+               "adapter-zipf", "speculative")
 
 
 def named_trace(name: str, seed: int = 0) -> Trace:
@@ -288,5 +288,13 @@ def named_trace(name: str, seed: int = 0) -> Trace:
             poisson_trace(rate_rps=8.0, n_requests=40, seed=seed,
                           name="adapter-zipf"),
             n_adapters=4, seed=seed,
+        )
+    if name == "speculative":
+        # greedy long-ish generations — the acceptance-friendly regime
+        # where draft+verify rounds dominate (sim prices each round via
+        # cost.spec_round_s; the engine's rollback machinery is real)
+        return poisson_trace(
+            rate_rps=6.0, n_requests=24, seed=seed, name="speculative",
+            prompt_len=(8, 24), out_tokens=(16, 48),
         )
     raise ValueError(f"unknown trace mix {name!r}; known: {TRACE_NAMES}")

@@ -57,7 +57,10 @@ if [[ "${1:-}" == "--core" ]]; then
   echo "   compact journal) +"
   echo "   observability layer (test_obs: trace-export golden + span"
   echo "   nesting, TTFT/ITL under injected slow_step, tracing-off"
-  echo "   overhead guard, profiler-window guards, metrics drift)"
+  echo "   overhead guard, profiler-window guards, metrics drift) +"
+  echo "   quantized ICI collectives (test_qcollectives: int8/fp8 ring"
+  echo "   all-reduce parity matrix on dryrun meshes, error-feedback"
+  echo "   property, to_mesh comm_qtype routing, roofline block sync)"
   python -m pytest tests/ -q "${XDIST[@]}" -m "core or (chaos and not slow)"
   echo "== metrics exposition drift gate (registry <-> /metrics, both ways)"
   python -c "

@@ -78,6 +78,24 @@ def test_wct001_out_of_scope_file_ignored():
     assert fs == []
 
 
+def test_wct001_covers_qcollectives():
+    # ISSUE 17: the quantized-collective module runs inside jit traces
+    # priced by the roofline/sim models — it joined the clock-injected
+    # scope set, so a wall-clock call there must fire
+    fs = lint("""
+        import time
+
+        def encode(x):
+            t0 = time.time()
+            return x, t0
+    """, "bigdl_tpu/parallel/qcollectives.py", "WCT001")
+    assert len(fs) == 1
+    assert "time.time" in fs[0].message
+    # siblings in parallel/ (other than health.py) stay out of scope
+    assert lint("import time\nx = time.time()\n",
+                "bigdl_tpu/parallel/ring.py", "WCT001") == []
+
+
 def test_wct001_inline_suppression():
     fs = lint("""
         import time
@@ -126,6 +144,17 @@ def test_flt001_scoped_per_registry():
     src = "inj.arm('rank_drop')\n"
     assert lint(src, "bigdl_tpu/train/foo.py", "FLT001") == []
     assert len(lint(src, "bigdl_tpu/serving/foo.py", "FLT001")) == 1
+
+
+def test_flt001_covers_qcollectives():
+    # parallel/ maps to the train fault registry: a bogus point in the
+    # new collectives module is a typo, a declared train point is fine
+    bad = lint("inj.fire('bogus_point')\n",
+               "bigdl_tpu/parallel/qcollectives.py", "FLT001")
+    assert len(bad) == 1
+    assert "bogus_point" in bad[0].message
+    assert lint("inj.arm('rank_drop')\n",
+                "bigdl_tpu/parallel/qcollectives.py", "FLT001") == []
 
 
 def test_flt001_dynamic_point_string_is_skipped():

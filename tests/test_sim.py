@@ -287,3 +287,63 @@ def test_sim_chaos_deterministic(model):
         return r
 
     assert report_json(faulted()) == report_json(faulted())
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: TP collective pricing + speculative rounds
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.core
+def test_cost_model_prices_tp_collectives():
+    base = default_cost_model()
+    tp4 = default_cost_model(tp=4)
+    one_b = base.decode_step_s([64], page=64)
+    one_t = tp4.decode_step_s([64], page=64)
+    # tp adds the per-layer all-reduce as comm overhead (additive model:
+    # compute is NOT divided, so the step strictly rises with tp)
+    assert one_t > one_b
+    assert base.tp_comm_s(1) == 0.0 and tp4.tp_comm_s(1) > 0.0
+    # the quantized wire recovers most of the modeled collective time
+    # (the >=40% acceptance bar of the banked --analytic output)
+    tp4_q = default_cost_model(tp=4, comm_qtype="int8")
+    recovered = (one_t - tp4_q.decode_step_s([64], page=64)) / \
+        (one_t - one_b)
+    assert recovered >= 0.4
+    # slower ICI -> more comm time; prefill pays the collective too
+    slow = default_cost_model(tp=4, ici_gbps=10.0)
+    assert slow.decode_step_s([64], page=64) > one_t
+    assert tp4.prefill_s(128) > base.prefill_s(128)
+    d = tp4_q.describe()
+    assert d["tp"] == 4 and d["comm_qtype"] == "int8"
+    assert d["ici_gbps"] == tp4_q.ici_gbps
+
+
+@pytest.mark.core
+def test_cost_model_spec_round_monotonic():
+    cm = default_cost_model()
+    costs = [cm.spec_round_s([64], page=64, draft_k=k)
+             for k in (1, 2, 4, 8)]
+    # k drafts + one verify: strictly more work per round as k grows
+    assert all(b > a for a, b in zip(costs, costs[1:]))
+    assert costs[0] > cm.decode_step_s([64], page=64)
+    with pytest.raises(ValueError):
+        cm.spec_round_s([64], page=64, draft_k=0)
+    # empty batch degenerates to pure overhead, like decode_step_s
+    assert cm.spec_round_s([], page=64, draft_k=4) == cm.step_overhead_s
+
+
+def test_sim_speculative_scenario_runs_and_is_deterministic():
+    # a speculative round advances the clock by spec_round_s (not by
+    # draft_k untracked decode steps); dense tiny model, self-draft
+    sim = SimConfig(speculative=True, draft_k=2)
+    tr = poisson_trace(rate_rps=8.0, n_requests=6, seed=0,
+                       prompt_len=(4, 8), out_tokens=(4, 8))
+    d1 = SimDriver(tr, sim=sim)
+    r1 = d1.run()
+    d2 = SimDriver(tr, sim=sim)
+    r2 = d2.run()
+    assert report_json(r1) == report_json(r2)
+    assert d1.engine.spec_rounds > 0
+    assert sum(r1["counters"]["finish_reasons"].values()) == 6
+    assert r1["sim"]["sim_seconds"] > 0
