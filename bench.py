@@ -656,7 +656,8 @@ def child_sim() -> dict:
                         "sym_int4 cost model (sim/cost.py), seed 0",
         }
 
-    for name in ("poisson", "prefix-heavy", "overload", "adapter-zipf"):
+    for name in ("poisson", "prefix-heavy", "overload", "adapter-zipf",
+                 "speculative", "adapter-spec"):
         # each mix compiles its own tiny-llama engine programs (~25 s
         # on CPU); leave headroom or bank what we have
         if child_budget - (time.time() - T0) < 40:
@@ -685,6 +686,14 @@ def child_sim() -> dict:
             # multi-tenant LoRA registry churn (ISSUE 15)
             "adapter_loads": r.get("adapters", {}).get("loads", 0),
             "adapter_evictions": r.get("adapters", {}).get("evictions", 0),
+            # unified HBM paging + adapter-aware speculative decode
+            # (ISSUE 18): device-page churn in the shared KV pool and
+            # tokens-per-verify-round acceptance
+            "adapter_page_ins": r.get("adapters", {}).get("page_ins", 0),
+            "adapter_page_outs": r.get("adapters", {}).get("page_outs", 0),
+            "spec_rounds": r.get("speculative", {}).get("rounds", 0),
+            "spec_tokens_per_round": r.get("speculative", {}).get(
+                "tokens_per_round", 0.0),
         }
         log(f"sim {name}: {sweep[name]['tok_s']} tok/s, "
             f"ttft p99 {sweep[name]['ttft_p99_s']}s, "

@@ -96,6 +96,52 @@ def qmatmul_cost(qtype: str, M: int, K: int, O: int) -> dict:
     }
 
 
+def lora_epilogue_cost(M: int, K: int, O: int, R: int,
+                       fused: bool = True) -> dict:
+    """Analytic cost of the multi-tenant LoRA epilogue
+    ``((x @ A_cat^T) * gate) @ B_cat^T`` added to a y[M,O] = x[M,K]
+    matmul, at the dequant-GEMM's real M tiles (the epilogue rides
+    inside qmatmul's grid — ops/pallas/tiling.py is the shared policy).
+    ``R`` is the total adapter width: the rank bucket for one shared
+    adapter, or batch * rank-bucket for the serving engine's
+    concatenated per-row form.
+
+    Fused (qmatmul_lora): the x tile is already in VMEM, so the only
+    NEW traffic is the adapter operands — A_cat once per M tile, B_cat
+    tiles once per M-tile sweep, the gate once. Activation HBM round
+    trips: **0**.
+
+    XLA fallback (ops/linear.lora_epilogue): two extra activation round
+    trips on top of the adapter stream — x is re-read by the first
+    einsum, and the [M, O] delta is written then read back by the add
+    (the [M, R] xa intermediate round-trips too, a third, rank-thin
+    trip the summary number ignores)."""
+    block_m = pick_block_m(M, K)
+    mp = round_up(max(M, 1), block_m)
+    grid_m = mp // block_m
+    adapter_bytes = (R * K + O * R) * _X_BPE
+    gate_bytes = mp * R * _X_BPE
+    flops = 2 * M * R * (K + O)
+    if fused:
+        bytes_ = adapter_bytes * grid_m + gate_bytes
+        round_trips = 0
+    else:
+        bytes_ = (adapter_bytes + M * K * _X_BPE
+                  + 2 * M * R * _X_BPE + 2 * M * O * _OUT_BPE)
+        round_trips = 2
+    return {
+        "kernel": "lora_epilogue",
+        "shape": f"m{M}xk{K}xo{O}xr{R}",
+        "fused": fused,
+        "block_m": block_m,
+        "grid_m": grid_m,
+        "activation_round_trips": round_trips,
+        "adapter_bytes": adapter_bytes,
+        "bytes": bytes_,
+        "flops": flops,
+    }
+
+
 # ---------------------------------------------------------------------------
 # attention kernels (ISSUE 13 satellite): flash prefill +
 # paged/dense decode attention, fp8-KV variants. Block/tile policy is

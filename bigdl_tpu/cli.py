@@ -631,6 +631,16 @@ def cmd_simserve(args):
         trace.save(args.save_trace)
         print(f"saved {len(trace.arrivals)}-arrival trace to "
               f"{args.save_trace}", file=sys.stderr)
+    if args.speculative or args.draft_k is not None:
+        # flag overrides on top of the named scenario: any mix can run
+        # through draft+verify rounds (adapter mixes draft with the
+        # base and verify with the adapter applied — engine.py §spec)
+        import dataclasses as _dc
+
+        sim = _dc.replace(
+            sim, speculative=True,
+            draft_k=sim.draft_k if args.draft_k is None else args.draft_k,
+        )
     driver = SimDriver(trace, sim=sim,
                        cost=default_cost_model(
                            hbm_gbps=args.hbm_gbps, ici_gbps=args.ici_gbps,
@@ -918,10 +928,21 @@ def main(argv=None):
                     # literal: keep CLI startup free of sim/jax imports
                     # (must mirror sim/traces.TRACE_NAMES)
                     choices=("poisson", "bursty", "prefix-heavy",
-                             "overload", "adapter-zipf", "speculative"),
+                             "overload", "adapter-zipf", "speculative",
+                             "adapter-spec"),
                     help="named trace mix (overload exercises "
                          "preemption AND shed; adapter-zipf the "
-                         "multi-tenant LoRA registry churn)")
+                         "multi-tenant LoRA registry churn; adapter-spec "
+                         "adapters THROUGH speculative decode under a "
+                         "tight unified page pool)")
+    sv.add_argument("--speculative", action="store_true",
+                    help="run the mix through draft+verify speculative "
+                         "rounds regardless of its scenario default "
+                         "(adapter mixes verify with the adapter "
+                         "applied)")
+    sv.add_argument("--draft-k", type=int, default=None,
+                    help="draft length for --speculative (implies it "
+                         "when set; default: the scenario's draft_k)")
     sv.add_argument("--trace-file", default=None,
                     help="replay a banked trace JSONL instead of "
                          "generating one")

@@ -149,6 +149,60 @@ class PagePool:
         return len(self.free)
 
 
+def kv_page_nbytes(cache: PagedKVCache) -> int:
+    """Bytes of ONE physical page across every layer (K + V + fp8
+    scales) — the unit the unified KV/adapter device budget is
+    denominated in (serving/adapters.AdapterPager)."""
+    L, _, page, Hkv, D = cache.k.shape
+    n = 2 * L * page * Hkv * D * cache.k.dtype.itemsize
+    if cache.quantized:
+        n += 2 * L * page * Hkv * cache.k_scale.dtype.itemsize
+    return n
+
+
+class AdapterPageStore:
+    """Device residency for LoRA adapter weights, page-framed so it
+    draws from the SAME :class:`PagePool` as KV.
+
+    One flat bf16 buffer ``buf [n_pages, page_elems]`` where
+    ``page_elems`` is the element count whose byte size matches one KV
+    page (``kv_page_nbytes``). The store is a typed VIEW of the page
+    frame, not a second allocation pool: page ids come from the shared
+    PagePool, so every adapter page resident here is one KV page the
+    radix cache / slots cannot hold — a single HBM budget, the S-LoRA
+    unified-paging model (docs/serving.md §7).
+
+    The store itself does no accounting; ownership (refcounts, LRU,
+    eviction order) lives in ``serving/adapters.AdapterPager``."""
+
+    def __init__(self, n_pages: int, page_nbytes: int):
+        self.page_elems = max(page_nbytes // 2, 1)  # bf16 elements/page
+        self.buf = jnp.zeros((n_pages, self.page_elems), jnp.bfloat16)
+
+    def n_for(self, n_elems: int) -> int:
+        """Pages needed to hold ``n_elems`` bf16 elements."""
+        return -(-int(n_elems) // self.page_elems)
+
+    def write(self, pages, flat) -> None:
+        """Scatter a flat bf16 host/device vector into physical pages
+        `pages` (zero-padded to the page frame)."""
+        import numpy as np
+
+        n = len(pages) * self.page_elems
+        v = np.zeros((n,), np.float32)
+        v[: flat.size] = np.asarray(flat, np.float32).ravel()
+        self.buf = self.buf.at[jnp.asarray(list(pages), jnp.int32)].set(
+            jnp.asarray(v.reshape(len(pages), self.page_elems),
+                        jnp.bfloat16)
+        )
+
+    def read(self, pages, n_elems: int) -> jax.Array:
+        """Gather pages back into the leading ``n_elems`` of the flat
+        vector (device-side — no host round trip)."""
+        ids = jnp.asarray(list(pages), jnp.int32)
+        return self.buf[ids].reshape(-1)[:n_elems]
+
+
 # ---------------------------------------------------------------------------
 # Host-RAM page swap (serving preemption)
 # ---------------------------------------------------------------------------

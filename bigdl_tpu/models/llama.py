@@ -721,6 +721,7 @@ def forward(
 
     def proj(x, p, lp, wname, bname=None):
         b = p.get(bname) if bname else None
+        pair = lp[wname] if lp is not None and wname in lp else None
         if quantize_comm and wname in ("wo", "w_down"):
             # the two per-layer row-parallel epilogues whose implicit TP
             # psum the quantized ring replaces (the lm_head's single
@@ -730,10 +731,16 @@ def forward(
             from bigdl_tpu.ops.linear import row_parallel_linear
 
             y = row_parallel_linear(x, p[wname], comm, b, compute_dtype)
+            if pair is not None:
+                y = y + _lora_delta(x, pair, lora_scale, compute_dtype)
         else:
-            y = linear(x, p[wname], b, compute_dtype)
-        if lp is not None and wname in lp:
-            y = y + _lora_delta(x, lp[wname], lora_scale, compute_dtype)
+            # the adapter delta rides INTO linear: eligible quantized
+            # shapes fold it into the Pallas dequant-GEMM's writeback
+            # (zero extra activation HBM round trips); every other path
+            # applies the same lora_epilogue einsums as before
+            lo = ((pair["a"], pair["b"], lora_scale)
+                  if pair is not None else None)
+            y = linear(x, p[wname], b, compute_dtype, lora=lo)
         return y
 
     # per-layer static sliding flags, as a traced vector for the scan body

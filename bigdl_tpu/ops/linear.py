@@ -267,6 +267,84 @@ def _fused_bwd(qtype, block_o, w, g):
 _fused_matmul.defvjp(_fused_fwd, _fused_bwd)
 
 
+def _lora_cat_operands(x: jax.Array, lora, compute_dtype):
+    """Canonicalize a lora triple (a, b, scale) — shared [r, K]/[O, r]
+    or batched per-row [B, rb, K]/[B, O, rb]/[B] — into the fused
+    epilogue's concatenated operand form (a_cat [R, K], b_cat [O, R],
+    gate [M, R]), or None when the shape is ineligible (rank columns
+    would blow the epilogue's VMEM allowance, or the batched form does
+    not line up with x's rows). Column order is group-major, rank
+    within; gate row m carries scale_g in its own group g's columns and
+    0 elsewhere, so each row receives exactly its adapter's delta."""
+    from bigdl_tpu.ops.pallas.tiling import lora_fused_ok
+
+    a, b, scale = lora
+    K = x.shape[-1]
+    M = _rows(x.shape)
+    if a.ndim == 3:  # batched per-row adapters (serving)
+        if x.ndim != 3 or a.shape[0] != x.shape[0]:
+            return None
+        B, rb, ka = a.shape
+        R = B * rb
+        if ka != K or rb == 0 or not lora_fused_ok(R, K):
+            return None
+        T = x.shape[1]
+        a_cat = a.reshape(R, K)
+        b_cat = jnp.moveaxis(b, 0, 1).reshape(b.shape[1], R)
+        grp = jnp.repeat(jnp.arange(B, dtype=jnp.int32), T)  # row -> group
+        col = jnp.repeat(jnp.arange(B, dtype=jnp.int32), rb)  # col -> group
+        sc = jnp.asarray(scale).astype(compute_dtype)
+        gate = ((grp[:, None] == col[None, :]).astype(compute_dtype)
+                * sc[grp][:, None])
+        return a_cat, b_cat, gate
+    r, ka = a.shape
+    if ka != K or r == 0 or not lora_fused_ok(r, K):
+        return None
+    sc = jnp.asarray(scale).astype(compute_dtype)
+    gate = jnp.broadcast_to(sc, (M, r))
+    return a, b, gate
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _fused_lora_matmul(x: jax.Array, w: QTensor, a_cat, b_cat, gate,
+                       qtype: str, block_o: int):
+    from bigdl_tpu.ops.pallas import qmatmul_lora
+
+    return qmatmul_lora(x, w, a_cat, b_cat, gate, out_dtype=x.dtype,
+                        block_o=block_o)
+
+
+def _fused_lora_fwd(x, w, a_cat, b_cat, gate, qtype, block_o):
+    y = _fused_lora_matmul(x, w, a_cat, b_cat, gate, qtype, block_o)
+    return y, (x, w, a_cat, b_cat, gate)
+
+
+def _fused_lora_bwd(qtype, block_o, res, g):
+    # the backward stays on the XLA path like _fused_bwd, with the
+    # epilogue's product-rule terms spelled out so QLoRA training can
+    # differentiate a lora-fused forward: for v = (x @ A^T) * gate,
+    # y = x @ dq(W)^T + v @ B^T
+    x, w, a, b, gt = res
+    cd = g.dtype
+    K = x.shape[-1]
+    O = g.shape[-1]
+    xf = x.reshape(-1, K).astype(cd)
+    gf = g.reshape(-1, O)
+    ac, bc, gtc = a.astype(cd), b.astype(cd), gt.astype(cd)
+    wd = w.dequantize(cd)
+    u = xf @ ac.T  # [M, R]
+    dv = gf @ bc  # [M, R]
+    du = dv * gtc
+    dx = (gf @ wd + du @ ac).reshape(x.shape).astype(x.dtype)
+    da = (du.T @ xf).astype(a.dtype)
+    db = (gf.T @ (u * gtc)).astype(b.dtype)
+    dgate = (dv * u).astype(gt.dtype)
+    return dx, _zero_cotangent(w), da, db, dgate
+
+
+_fused_lora_matmul.defvjp(_fused_lora_fwd, _fused_lora_bwd)
+
+
 def lora_epilogue(x: jax.Array, a: jax.Array, b: jax.Array,
                   scale: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
     """The multi-tenant LoRA epilogue ``(x @ A^T) @ B^T * scale`` added
@@ -307,19 +385,39 @@ def linear(
     w: Union[QTensor, jax.Array],
     bias: Optional[jax.Array] = None,
     compute_dtype=jnp.bfloat16,
+    lora=None,
 ) -> jax.Array:
-    """y = x @ W^T (+ bias). W has logical shape [out_features, in_features].
+    """y = x @ W^T (+ bias) (+ LoRA delta). W has logical shape
+    [out_features, in_features].
 
     QTensor weights route to the fused Pallas dequant kernels whenever
     the shape is eligible (GEMV below `_GEMV_MAX_ROWS` rows, tiled GEMM
     above); otherwise the dequantization is expressed in-graph so XLA
     fuses unpack+scale into the matmul's operand read. Weights stay
     packed in HBM either way.
+
+    ``lora`` is an optional (a, b, scale) triple in either
+    `lora_epilogue` shape. On the fused path it folds into the kernel's
+    writeback (`ops/pallas/qmatmul.qmatmul_lora` — zero extra
+    activation HBM round trips); everywhere else — XLA fallback, exempt
+    formats, dense weights, operand shapes past the epilogue's VMEM
+    allowance — it applies as the `lora_epilogue` einsum pair, which
+    doubles as the fused path's parity oracle.
     """
     if isinstance(w, QTensor):
         if _fused_kernel(x, w) is not None:
             block_o = 256 if w.data.shape[0] % 256 == 0 else 128
-            y = _fused_matmul(x.astype(compute_dtype), w, w.qtype, block_o)
+            xc = x.astype(compute_dtype)
+            if lora is not None:
+                ops = _lora_cat_operands(x, lora, compute_dtype)
+                if ops is not None:
+                    y = _fused_lora_matmul(xc, w, *ops, w.qtype, block_o)
+                    if bias is not None:
+                        y = y + bias.astype(compute_dtype)
+                    return y
+            y = _fused_matmul(xc, w, w.qtype, block_o)
+            if lora is not None:
+                y = y + lora_epilogue(x, *lora, compute_dtype)
             if bias is not None:
                 y = y + bias.astype(compute_dtype)
             return y
@@ -332,6 +430,8 @@ def linear(
         wd,
         preferred_element_type=compute_dtype,
     )
+    if lora is not None:
+        y = y + lora_epilogue(x, *lora, compute_dtype)
     if bias is not None:
         y = y + bias.astype(compute_dtype)
     return y

@@ -47,8 +47,8 @@ from bigdl_tpu.ops.pallas.paged_attention import (  # noqa: E402
 )
 from bigdl_tpu.ops.pallas.qmatmul import (  # noqa: E402
     qmatmul, qmatmul_asym_int4, qmatmul_bytes, qmatmul_codebook,
-    qmatmul_fp8, qmatmul_int4, qmatmul_int8, qmatmul_planes, qmatmul_q2k,
-    qmatmul_q4k, qmatmul_q5k, qmatmul_q6k,
+    qmatmul_fp8, qmatmul_int4, qmatmul_int8, qmatmul_lora, qmatmul_planes,
+    qmatmul_q2k, qmatmul_q4k, qmatmul_q5k, qmatmul_q6k,
 )
 
 __all__ = ["use_pallas", "interpret_mode", "flash_attention",
@@ -57,4 +57,5 @@ __all__ = ["use_pallas", "interpret_mode", "flash_attention",
            "qmatmul_codebook",
            "qmatmul_int8", "qmatmul_asym_int4", "qmatmul_q4k",
            "qmatmul_q6k", "qmatmul_bytes", "qmatmul_fp8",
-           "qmatmul_planes", "qmatmul_q2k", "qmatmul_q5k"]
+           "qmatmul_planes", "qmatmul_q2k", "qmatmul_q5k",
+           "qmatmul_lora"]

@@ -63,7 +63,8 @@ from jax.experimental.pallas import tpu as pltpu
 from bigdl_tpu.ops.pallas import qdecode
 from bigdl_tpu.ops.pallas.qdecode import DecodeSpec
 from bigdl_tpu.ops.pallas.tiling import (
-    chunk_target, finest_split, pick_block_m, pick_block_o, round_up,
+    chunk_target, finest_split, lora_operand_bytes, pick_block_m,
+    pick_block_o, round_up,
 )
 
 BLOCK = 32  # quant block (elements per scale) for sym_int4; nf4/fp4 use 64
@@ -86,15 +87,31 @@ def _f16_bits(a: jax.Array) -> jax.Array:
 # the unified kernel: one O x M tile, any DecodeSpec
 # ---------------------------------------------------------------------------
 
-def _kernel(x_ref, w_ref, *rest, K: int, ck: int, spec: DecodeSpec):
+def _kernel(x_ref, w_ref, *rest, K: int, ck: int, spec: DecodeSpec,
+            lora: bool = False):
     """One [block_m, block_o] output tile: acc += x_chunk @ dq(W_chunk)^T
     over statically-unrolled chunks of the logical contraction axis.
     The weight tile is loaded packed and upcast PER CHUNK inside
     qdecode.decode_chunk — a hoisted full-row int32 copy would keep
     4 B/packed-byte live across the whole unrolled loop and defeat the
-    O(block_o * ck) VMEM bound."""
+    O(block_o * ck) VMEM bound.
+
+    With ``lora`` the multi-tenant LoRA epilogue folds into the same
+    tile before writeback (the S-LoRA/Punica batched-adapter GEMM,
+    ISSUE 18): the x tile is already in VMEM, so
+    ``(x @ A_cat^T) * gate @ B_cat^T`` adds ZERO activation HBM round
+    trips — the XLA fallback (ops/linear.lora_epilogue) pays two
+    (re-read x, round-trip the delta). ``gate [block_m, R]`` carries the
+    per-row adapter selection AND scale: row m holds scale_m in its own
+    adapter group's rank-bucket columns and 0 elsewhere, which is how
+    one dot pair serves a heterogeneous multi-tenant batch."""
     o_ref = rest[-1]
-    side = qdecode.load_side(spec, rest[:-1])
+    if lora:
+        a_ref, b_ref, g_ref = rest[-4:-1]
+        side_refs = rest[:-4]
+    else:
+        side_refs = rest[:-1]
+    side = qdecode.load_side(spec, side_refs)
     w = w_ref[:]  # packed codes [block_o, row_bytes]
     x = x_ref[:].astype(jnp.bfloat16)  # [block_m, K]
 
@@ -105,17 +122,31 @@ def _kernel(x_ref, w_ref, *rest, K: int, ck: int, spec: DecodeSpec):
             qdecode.slc(x, e0, c), wd, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+    if lora:
+        xa = jax.lax.dot_general(  # [block_m, R]
+            x, a_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        xa = xa * g_ref[:].astype(jnp.float32)
+        acc += jax.lax.dot_general(  # [block_m, block_o]
+            xa.astype(jnp.bfloat16), b_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
     o_ref[:] = acc.astype(o_ref.dtype)
 
 
 @functools.partial(
     jax.jit, static_argnames=("spec", "out_dtype", "block_m", "block_o",
-                              "ck", "interpret")
+                              "ck", "interpret", "lora")
 )
 def _qmm(spec, out_dtype, block_m: int, block_o: int, ck: int,
-         interpret: bool, x2, w, *side):
+         interpret: bool, lora: bool, x2, w, *rest):
     Mp, K = x2.shape
     O = w.shape[0]
+    if lora:
+        *side, la, lb, lg = rest
+    else:
+        side = rest
     row = lambda m, o: (o, 0)  # weight-side blocks follow the O grid dim
     in_specs = [
         pl.BlockSpec((block_m, K), lambda m, o: (m, 0),
@@ -125,12 +156,26 @@ def _qmm(spec, out_dtype, block_m: int, block_o: int, ck: int,
         pl.BlockSpec((block_o, a.shape[1]), row, memory_space=pltpu.VMEM)
         for a in side
     ]
+    if lora:
+        # LoRA epilogue operands: A_cat rides as a FULL block (resident
+        # across the whole o sweep, like the x tile), B_cat tiles follow
+        # the O grid, the gate follows the M grid. Full-dim blocks keep
+        # every spec legal at any rank bucket (R need not be
+        # lane/sublane aligned when the block covers the whole dim).
+        in_specs += [
+            pl.BlockSpec((la.shape[0], K), lambda m, o: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_o, lb.shape[1]), row,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_m, lg.shape[1]), lambda m, o: (m, 0),
+                         memory_space=pltpu.VMEM),
+        ]
     # grid order (m, o): o innermost, so the x tile stays resident across
     # a full sweep of weight tiles and packed weights are re-fetched only
     # once per M tile (the roofline model in benchmark/roofline.py
     # assumes exactly this fetch pattern)
     return pl.pallas_call(
-        functools.partial(_kernel, K=K, ck=ck, spec=spec),
+        functools.partial(_kernel, K=K, ck=ck, spec=spec, lora=lora),
         grid=(Mp // block_m, O // block_o),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
@@ -139,7 +184,7 @@ def _qmm(spec, out_dtype, block_m: int, block_o: int, ck: int,
         out_shape=jax.ShapeDtypeStruct((Mp, O), out_dtype),
         compiler_params=_params_parallel(),
         interpret=interpret,
-    )(x2, w, *side)
+    )(x2, w, *rest)
 
 
 def _validate(spec: DecodeSpec, K: int, data) -> None:
@@ -170,8 +215,13 @@ def _side_arrays(spec: DecodeSpec, scales, mins, sub_scales, sub_mins):
     return (_f16_bits(scales),)
 
 
-def _fused(x, data, spec: DecodeSpec, side, out_dtype, block_o, interpret):
-    """Shared wrapper: flatten/pad rows, pick tiles, run the kernel."""
+def _fused(x, data, spec: DecodeSpec, side, out_dtype, block_o, interpret,
+           lora=None):
+    """Shared wrapper: flatten/pad rows, pick tiles, run the kernel.
+
+    ``lora`` (optional) is the fused-epilogue operand triple
+    ``(a_cat [R, K], b_cat [O, R], gate [M, R])`` — see _kernel; the
+    gate is padded alongside x (zero rows contribute exactly 0)."""
     from bigdl_tpu.ops.pallas import interpret_mode
 
     if interpret is None:
@@ -191,15 +241,30 @@ def _fused(x, data, spec: DecodeSpec, side, out_dtype, block_o, interpret):
     if Mp != M:
         x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
 
+    extra = ()
+    lora_bytes = 0
+    if lora is not None:
+        a_cat, b_cat, gate = lora
+        R = a_cat.shape[0]
+        assert a_cat.shape == (R, K), (a_cat.shape, K)
+        assert b_cat.shape == (O, R), (b_cat.shape, O, R)
+        assert gate.shape == (M, R), (gate.shape, M, R)
+        gate2 = gate.astype(jnp.bfloat16)
+        if Mp != M:
+            gate2 = jnp.pad(gate2, ((0, Mp - M), (0, 0)))
+        extra = (a_cat.astype(jnp.bfloat16), b_cat.astype(jnp.bfloat16),
+                 gate2)
+        lora_bytes = lora_operand_bytes(R, K, 256, block_m)
+
     persist_row = data.shape[1] * data.dtype.itemsize + sum(
         a.shape[1] * a.dtype.itemsize for a in side)
     block_o = pick_block_o(O, persist_row, cap=block_o)
     persist = (block_o * persist_row + block_m * K * 2
-               + block_m * block_o * 4)
+               + block_m * block_o * 4 + lora_bytes)
     ck = chunk_target(block_o, persist, finest_split(K, spec.planes),
                       temp_bpe=20 if spec.mins else 14)
     y = _qmm(spec, jnp.dtype(out_dtype), block_m, block_o, ck,
-             bool(interpret), x2, data, *side)
+             bool(interpret), lora is not None, x2, data, *side, *extra)
     return y[:M].reshape(*lead, O)
 
 
@@ -226,6 +291,35 @@ def qmatmul(
         data = jax.lax.bitcast_convert_type(data, jnp.uint8)
     side = _side_arrays(spec, w.scales, w.mins, w.sub_scales, w.sub_mins)
     return _fused(x, data, spec, side, out_dtype, block_o, interpret)
+
+
+def qmatmul_lora(
+    x: jax.Array,  # [..., K]
+    w,  # QTensor (any registered non-dense qtype)
+    a_cat: jax.Array,  # [R, K] concatenated adapter A rows (bf16-able)
+    b_cat: jax.Array,  # [O, R] concatenated adapter B columns
+    gate: jax.Array,  # [M, R] per-row scale-in-own-group selection mask
+    out_dtype=jnp.bfloat16,
+    block_o: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``qmatmul`` with the multi-tenant LoRA epilogue fused into the
+    writeback: y = x @ dq(W)^T + ((x @ A_cat^T) * gate) @ B_cat^T.
+
+    R concatenates the rank-bucket columns of every adapter group in the
+    batch (Punica's batched-adapter GEMM realized with two plain dots +
+    a gate, no vector gather); ``gate[m, j] = scale_g`` iff column j
+    belongs to row m's group g, else 0 — so each row receives exactly
+    its own adapter's delta and adapter-less rows (gate row 0) ride
+    along unchanged. Parity oracle: ops/linear.lora_epilogue added to
+    the unfused qmatmul."""
+    spec = qdecode.spec_for(w.spec)
+    data = w.data
+    if w.spec.storage.startswith("fp8"):
+        data = jax.lax.bitcast_convert_type(data, jnp.uint8)
+    side = _side_arrays(spec, w.scales, w.mins, w.sub_scales, w.sub_mins)
+    return _fused(x, data, spec, side, out_dtype, block_o, interpret,
+                  lora=(a_cat, b_cat, gate))
 
 
 # ---------------------------------------------------------------------------

@@ -84,6 +84,40 @@ def pick_block_m(M: int, K: int, x_bpe: int = 2) -> int:
 
 
 # ---------------------------------------------------------------------------
+# LoRA epilogue policy — shared by ops/pallas/qmatmul.py (the fused
+# epilogue's operand blocks) and benchmark/roofline.py / sim/cost.py's
+# analytic LoRA cost, extending the "never disagree" contract to the
+# S-LoRA serving path (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+#: bytes/element of the LoRA operands inside the kernel (A/B/gate cross
+#: as bf16; the xa intermediate is f32)
+LORA_BPE = 2
+
+#: persistent-VMEM allowance for the fused epilogue's operands: they
+#: ride INSIDE the dequant-GEMM's existing budget, so they must stay a
+#: small fraction of it or the chunk loop collapses to its floor
+LORA_VMEM_CAP = 4 * 1024 * 1024
+
+
+def lora_operand_bytes(R: int, K: int, O_block: int, M_block: int) -> int:
+    """Persistent VMEM the fused LoRA epilogue adds to one grid step:
+    A_cat [R, K] (full block, resident across the o sweep), one B_cat
+    tile [O_block, R], the per-row gate tile [M_block, R], and the f32
+    xa intermediate [M_block, R]."""
+    return (R * K * LORA_BPE + O_block * R * LORA_BPE
+            + M_block * R * LORA_BPE + M_block * R * 4)
+
+
+def lora_fused_ok(R: int, K: int) -> bool:
+    """Eligibility of the fused-epilogue path for a total LoRA width R
+    (= sum of rank-bucket columns across the batch's adapter groups):
+    the operands must fit the epilogue allowance at the largest tiles
+    the GEMM can pick (256 x 256)."""
+    return R > 0 and lora_operand_bytes(R, K, 256, 256) <= LORA_VMEM_CAP
+
+
+# ---------------------------------------------------------------------------
 # attention tile policy — shared by ops/pallas/flash_attention.py (the
 # kernel's default block shapes) and benchmark/roofline.py's analytic
 # attention costs, so the sim's cost model and the implementation cannot

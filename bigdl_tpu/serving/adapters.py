@@ -90,6 +90,9 @@ class AdapterError(ValueError):
     - ``busy``: unload refused while requests hold references
     - ``budget``: the host-RAM budget cannot fit the adapter even
       after evicting every evictable entry
+    - ``page_in_stall``: the device page-in of the adapter's weights
+      stalled (injected via the ``adapter_page_in_stall`` fault point)
+      — the request naming it finishes "error", never fail_all
 
     Subclasses ValueError so generic input-validation guards keep
     working; the HTTP layer maps kinds to status codes."""
@@ -548,3 +551,168 @@ class AdapterRegistry:
                 "resident_bytes": self._resident_bytes(),
                 "budget_bytes": self.budget_bytes,
             }
+
+
+# ---------------------------------------------------------------------------
+# unified HBM paging: adapter weights in the KV page pool
+# ---------------------------------------------------------------------------
+
+class _PagedAdapter:
+    """One device-resident adapter: its physical pages (each carrying
+    the pager's ONE PagePool reference), the leaf shapes needed to
+    reconstruct (A, B) from the flat page frame, and the rids holding
+    it resident (one hold per in-flight request — the same
+    one-hold-per-holder rule as the registry and the PagePool)."""
+
+    __slots__ = ("name", "pages", "shapes", "n_elems", "holders")
+
+    def __init__(self, name, pages, shapes, n_elems):
+        self.name = name
+        self.pages = pages
+        self.shapes = shapes
+        self.n_elems = n_elems
+        self.holders: set = set()
+
+
+class AdapterPager:
+    """Device residency for resident adapters' (A, B) weight leaves,
+    allocated from the serving engine's KV :class:`kvpaged.PagePool` —
+    ONE HBM budget for KV and adapters (the S-LoRA unified paging
+    model, docs/serving.md §7). Engine-thread only (no lock): page-in
+    happens at admission, page-out under the engine's own allocation
+    escalation.
+
+    Lifecycle:
+
+    * **page-in** (:meth:`ensure`): flatten the entry's host leaves at
+      its OWN rank (bucket padding happens at gather time, device
+      side), allocate pages through the engine's radix-escalated
+      allocator, scatter into the :class:`kvpaged.AdapterPageStore`.
+      A dry pool (even after radix eviction) is NOT fatal: the caller
+      falls back to host-sourced gathers for that adapter — page-in
+      never preempts KV.
+    * **page-out** (:meth:`evict_one`): LRU-first holder-free adapter
+      drops its device pages (decref -> free list). The host copy in
+      the AdapterRegistry survives, so "page-out to host" is a free
+      drop, and the next request naming the tenant pages back in.
+    * eviction order under page pressure (engine._alloc_page): radix
+      leaf -> refcount-0 adapter page-out -> preemption.
+
+    ``scale`` stays host-side registry metadata (f32) — only the bf16
+    A/B leaves are paged, so paging is parity-exact with the host path
+    (the epilogue computes in bf16 either way)."""
+
+    def __init__(self, store, pool, alloc: Callable[[], Optional[int]],
+                 faults=None):
+        self.store = store
+        self._pool = pool
+        self._alloc = alloc
+        self._faults = faults if faults is not None else NULL_INJECTOR
+        # name -> _PagedAdapter, least-recently-used first
+        self._res: "collections.OrderedDict[str, _PagedAdapter]" = \
+            collections.OrderedDict()
+        # observability (serving/metrics.py + the sim report)
+        self.page_ins = 0   # pages written device-ward
+        self.page_outs = 0  # pages dropped back to the free list
+
+    @property
+    def pages_resident(self) -> int:
+        return sum(len(r.pages) for r in self._res.values())
+
+    def held_pages(self):
+        for rec in self._res.values():
+            yield from rec.pages
+
+    def ensure(self, entry: AdapterEntry, rid: int) -> bool:
+        """Make `entry` device-resident and add `rid`'s hold. False =
+        the pool stayed dry after eviction (caller uses host fallback).
+        Raises AdapterError(kind="page_in_stall") when the fault point
+        fires — the caller quarantines ONE request, never the batch."""
+        rec = self._res.get(entry.name)
+        if rec is not None:
+            self._res.move_to_end(entry.name)
+            rec.holders.add(rid)
+            return True
+        if self._faults.fire("adapter_page_in_stall") is not None:
+            raise AdapterError(
+                entry.name, "page_in_stall",
+                "injected device page-in stall (fault point "
+                "adapter_page_in_stall)",
+            )
+        flats, shapes = [], []
+        for t in entry.targets:
+            for leaf in ("a", "b"):
+                arr = np.asarray(entry.layers[t][leaf], np.float32)
+                shapes.append((t, leaf, arr.shape))
+                flats.append(arr.ravel())
+        flat = (np.concatenate(flats) if flats
+                else np.zeros((0,), np.float32))
+        pages: list = []
+        for _ in range(self.store.n_for(flat.size)):
+            pg = self._alloc()
+            if pg is None:
+                # dry even after radix + adapter eviction: give the
+                # pages back and serve this tenant from host RAM —
+                # admission semantics are unchanged, only the gather
+                # source differs
+                for p in pages:
+                    self._pool.decref(p)
+                return False
+            pages.append(pg)
+        self.store.write(pages, flat)
+        self.page_ins += len(pages)
+        rec = _PagedAdapter(entry.name, pages, shapes, int(flat.size))
+        rec.holders.add(rid)
+        self._res[entry.name] = rec  # most-recently-used
+        return True
+
+    def leaves(self, name: str) -> Optional[dict]:
+        """Device-side {target: {'a', 'b'}} bf16 leaves for a RESIDENT
+        adapter (LRU-refreshed), or None — the engine's _gather_blora
+        reads pages instead of re-transferring host weights."""
+        rec = self._res.get(name)
+        if rec is None:
+            return None
+        self._res.move_to_end(name)
+        flat = self.store.read(rec.pages, rec.n_elems)
+        out: dict = {}
+        off = 0
+        for t, leaf, shape in rec.shapes:
+            sz = 1
+            for d in shape:
+                sz *= int(d)
+            out.setdefault(t, {})[leaf] = flat[off:off + sz].reshape(shape)
+            off += sz
+        return out
+
+    def drop_holder(self, rid: int) -> None:
+        """Release `rid`'s holds (terminal finish). The adapter STAYS
+        resident — holder-free residency is what the LRU evicts under
+        pressure, not what release drops (warm reuse is the point)."""
+        for rec in self._res.values():
+            rec.holders.discard(rid)
+
+    def evict_one(self) -> bool:
+        """Page out the LRU holder-free adapter; False when every
+        resident adapter is held (the allocator escalates to
+        preemption)."""
+        victim = None
+        for rec in self._res.values():  # LRU -> MRU
+            if not rec.holders:
+                victim = rec
+                break
+        if victim is None:
+            return False
+        for pg in victim.pages:
+            self._pool.decref(pg)
+        self.page_outs += len(victim.pages)
+        del self._res[victim.name]
+        return True
+
+    def reset(self, pool) -> None:
+        """Post-crash rebuild (engine._reset_state): the old PagePool
+        died with the cache, so residency is simply forgotten — no
+        decrefs against a pool that no longer exists. Counters survive
+        (engine totals, not cache state)."""
+        self._pool = pool
+        self._res.clear()

@@ -430,20 +430,35 @@ class InferenceEngine:
         # wrappers price
         self._last_prefill_rank = 0
         self._last_prefill_targets: tuple = ()
+        # unified HBM paging (docs/serving.md §7): resident adapters'
+        # (A, B) leaves live in pages drawn from the SAME PagePool as
+        # KV — one device budget. Under page pressure the allocator's
+        # escalation is radix leaf -> holder-free adapter page-out ->
+        # preemption (_alloc_page); _gather_blora reads the pages
+        # instead of re-transferring host weights per assignment change
+        self._pager = None
+        if adapters is not None and paged and self._family_pool is None:
+            from bigdl_tpu import kvpaged
+            from bigdl_tpu.serving.adapters import AdapterPager
+
+            self._adapter_store = kvpaged.AdapterPageStore(
+                self.n_pages, kvpaged.kv_page_nbytes(self.cache)
+            )
+            self._pager = AdapterPager(
+                self._adapter_store, self._pool, self._alloc_page,
+                faults=faults,
+            )
 
         # forward_fn: the family forward, or the pipeline step when the
         # mesh has a pp axis (api.TpuModel.forward_fn)
         fwd = getattr(model, "forward_fn", None) or model.family.forward
         if adapters is not None:
-            if speculative:
-                # the draft scan has no adapter story (drafting with the
-                # base against an adapter-shifted target would crater
-                # acceptance, and the verify forward would need its own
-                # batched epilogue) — refuse honestly
-                raise NotImplementedError(
-                    "adapter serving is not wired through speculative "
-                    "decoding yet; use speculative=False"
-                )
+            # speculative + adapters: the draft scan stays base/dense
+            # (advisory — any draft content yields the same emitted
+            # tokens; an adapter-shifted target only lowers acceptance)
+            # while the VERIFY forward applies the batched adapter tree
+            # at the draft's proposed positions, so emitted tokens match
+            # non-speculative adapter decode exactly (_spec_decode_impl)
             import inspect
 
             try:
@@ -533,7 +548,7 @@ class InferenceEngine:
             self._cur_k = draft_k
             self._accept_ema: Optional[float] = None
             self._spec_exec = None
-            if adaptive_draft:
+            if adaptive_draft and adapters is None:
                 # AOT-compile every ladder program NOW: the first ladder
                 # switch must not stall in-flight streams on a
                 # mid-serving XLA compile. lower() only reads avals (no
@@ -875,7 +890,7 @@ class InferenceEngine:
 
     def _spec_decode_impl(self, forward, k_draft, params, dparams, cur, cache,
                           dcache, key, temp, topk, topp, dosample, seen,
-                          penalty):
+                          penalty, lora=None):
         """One speculative round for the whole slot pool. Returns
         (choice [B, K], lp_all [B, K], n_acc [B], cur' [B], cache,
         dcache, seen): slot b emits choice[b, :n_acc[b]+1], with
@@ -910,7 +925,17 @@ class InferenceEngine:
         drafts = jnp.swapaxes(drafts, 0, 1)  # [B, K]
 
         verify_in = jnp.concatenate([cur[:, None], drafts[:, :K - 1]], axis=1)
-        tlogits, cache = forward(cfg, params, verify_in, cache, mode="prefill")
+        # adapter-aware verification: the TARGET forward applies the
+        # batched per-slot adapter tree (the same one plain decode
+        # uses), so accepted tokens follow the adapter-shifted target
+        # law exactly — emitted tokens match non-speculative adapter
+        # decode token-for-token. The draft above stays base/dense
+        # (drafts are advisory: any draft content yields the same
+        # output law, only the acceptance RATE moves)
+        kw = {} if lora is None else {"lora": lora}
+        tlogits, cache = forward(
+            cfg, params, verify_in, cache, mode="prefill", **kw
+        )
         tlogits = tlogits.astype(jnp.float32)
         greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # [B, K]
 
@@ -1168,15 +1193,21 @@ class InferenceEngine:
 
     def _alloc_page(self) -> Optional[int]:
         """A free page, evicting LRU radix leaves (serving/radix.py)
-        while the free list is dry. Eviction only ever drops nodes
-        whose page no slot holds, so it composes with preemption: the
-        escalation order is free list -> cache eviction -> host-RAM
-        swap-out (_alloc_page_preempting)."""
+        while the free list is dry, then paging out holder-free
+        adapters (serving/adapters.AdapterPager) — adapters share this
+        pool's budget, and their host copies make page-out free to
+        undo. Eviction only ever drops pages no slot holds, so it
+        composes with preemption: the escalation order is free list ->
+        cache eviction -> adapter page-out -> host-RAM swap-out
+        (_alloc_page_preempting)."""
         if self._faults.fire("alloc_page") is not None:
             return None  # injected pool exhaustion (serving/faults.py)
         pg = self._pool.alloc()
         while pg is None and self.radix.evict_one():
             self.prefix_evictions += 1
+            pg = self._pool.alloc()
+        while pg is None and self._pager is not None \
+                and self._pager.evict_one():
             pg = self._pool.alloc()
         return pg
 
@@ -1698,7 +1729,16 @@ class InferenceEngine:
         from bigdl_tpu.serving.adapters import AdapterError
 
         if req.rid in self._adapter_refs:  # OOM-retry / prefill-abort
-            # re-admission: the reference is already held
+            # re-admission: the reference is already held; re-page-in
+            # best-effort (the pages may have been evicted while the
+            # request was parked — a dry pool just means the gather
+            # falls back to the registry's host copy)
+            if self._pager is not None:
+                try:
+                    self._pager.ensure(self._adapter_refs[req.rid],
+                                       req.rid)
+                except AdapterError:
+                    pass
             return True
         try:
             entry = self.adapters.acquire(req.adapter)
@@ -1716,6 +1756,20 @@ class InferenceEngine:
             self._fail_request(req, str(e))
             return False
         self._adapter_refs[req.rid] = entry
+        if self._pager is not None:
+            try:
+                self._pager.ensure(entry, req.rid)
+            except AdapterError as e:
+                # injected page-in stall (serving/faults.py): quarantine
+                # exactly this request — release the reference we just
+                # took so the registry's refcounts stay exact
+                del self._adapter_refs[req.rid]
+                self.adapters.release(entry)
+                self._fail_request(req, str(e))
+                return False
+            # ensure() returning False (pool dry even after eviction) is
+            # NOT an error: the gather reads the host copy instead —
+            # adapter paging never preempts KV to make room
         return True
 
     def _check_adapter_dims(self, entry) -> None:
@@ -1795,6 +1849,19 @@ class InferenceEngine:
         L = self.config.num_hidden_layers
         rb = rank_bucket(max(e.rank for e in live))
         targets = sorted({t for e in live for t in e.targets})
+        # unified paging: adapters resident in the shared page pool are
+        # read straight out of their device pages — the host->device
+        # transfer below shrinks to only the non-resident stragglers
+        # (dry-pool fallbacks). Device reads round through the same
+        # bf16 the host path casts to, so the two sources are
+        # bit-identical in the epilogue.
+        dev: dict = {}
+        if self._pager is not None:
+            for e in live:
+                if e.name not in dev:
+                    lv = self._pager.leaves(e.name)
+                    if lv is not None:
+                        dev[e.name] = lv
         layers: dict = {}
         for t in targets:
             ref = next(e.layers[t] for e in live if t in e.layers)
@@ -1803,7 +1870,7 @@ class InferenceEngine:
             a = np.zeros((L, B, rb, in_d), np.float32)
             b = np.zeros((L, B, out_d, rb), np.float32)
             for i, e in enumerate(entries):
-                if e is None or t not in e.layers:
+                if e is None or t not in e.layers or e.name in dev:
                     continue
                 a[:, i, : e.rank, :] = np.asarray(
                     e.layers[t]["a"], np.float32
@@ -1811,8 +1878,15 @@ class InferenceEngine:
                 b[:, i, :, : e.rank] = np.asarray(
                     e.layers[t]["b"], np.float32
                 )
-            layers[t] = {"a": jnp.asarray(a, jnp.bfloat16),
-                         "b": jnp.asarray(b, jnp.bfloat16)}
+            ja = jnp.asarray(a, jnp.bfloat16)
+            jb = jnp.asarray(b, jnp.bfloat16)
+            for i, e in enumerate(entries):
+                if e is None or t not in e.layers or e.name not in dev:
+                    continue
+                lv = dev[e.name][t]
+                ja = ja.at[:, i, : e.rank, :].set(lv["a"])
+                jb = jb.at[:, i, :, : e.rank].set(lv["b"])
+            layers[t] = {"a": ja, "b": jb}
         scale = np.zeros((B,), np.float32)
         for i, e in enumerate(entries):
             if e is not None:
@@ -1917,6 +1991,10 @@ class InferenceEngine:
             # terminal state (every finish path funnels through here);
             # a refcount-0 adapter becomes fair eviction game
             self.adapters.release(entry)
+            if self._pager is not None:
+                # the device pages mirror the hold: holder-free pages
+                # become page-out candidates for _alloc_page
+                self._pager.drop_holder(req.rid)
         tr = self.tracer
         if req.preempt_ts is not None:
             # died while PARKED (deadline/cancel/fail_all before any
@@ -2308,6 +2386,11 @@ class InferenceEngine:
             self._free_pages = self._pool.free
             self._page_ref = self._pool.ref
             self.radix = RadixPrefixCache(self.page_size, self._pool)
+            if self._pager is not None:
+                # resident adapters referenced the dead pool's pages;
+                # drop residency (host copies in the registry survive —
+                # the next admission re-pages-in) and retarget the pool
+                self._pager.reset(self._pool)
             self._slot_pages = [[] for _ in range(self.n_slots)]
             self._slot_written = [0] * self.n_slots
             self._slot_pos = [0] * self.n_slots
@@ -2588,6 +2671,13 @@ class InferenceEngine:
             fn = self._spec_exec[self._cur_k]
         else:
             fn = functools.partial(self._spec_decode, self._cur_k)
+        kw = {}
+        if self.adapters is not None:
+            # verify with the slots' adapters applied (None when no
+            # active slot carries one). AOT executables have no lora
+            # slot, but adapter engines never build them (_spec_exec
+            # stays None — the jit path retraces per tree structure)
+            kw["lora"] = self._gather_blora()
         t0 = self._clock()
         try:
             (choice, lp_all, n_acc, cur2, self.cache, self.dcache,
@@ -2597,6 +2687,7 @@ class InferenceEngine:
                 jnp.asarray(self._temp), jnp.asarray(self._topk),
                 jnp.asarray(self._topp), jnp.asarray(self._dosample),
                 self.seen, jnp.asarray(self._penalty),
+                **kw,
             )
         except Exception:
             self.fail_all("speculative decode step failed")
@@ -2771,6 +2862,9 @@ class InferenceEngine:
                 held[pg] += 1
         for node in self.radix.nodes():
             held[node.page] += 1
+        if self._pager is not None:
+            for pg in self._pager.held_pages():
+                held[pg] += 1
         return sum(1 for pg in range(1, self.n_pages)
                    if self._page_ref[pg] != held[pg])
 

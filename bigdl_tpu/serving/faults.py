@@ -29,6 +29,10 @@ point               effect when armed
                     artifact were corrupt (structured AdapterError,
                     serving/adapters.py) — the request naming it must
                     finish "error" without taking the batch down
+``adapter_page_in_stall``  the next device page-in of an adapter's
+                    weights stalls (AdapterPager.ensure raises a
+                    structured AdapterError) — quarantines exactly the
+                    one request naming the tenant, never fail_all
 ==================  =======================================================
 
 Arming is deterministic by construction: ``arm(point, times=N, after=M)``
@@ -51,7 +55,7 @@ from collections import defaultdict
 from typing import Optional
 
 POINTS = ("alloc_page", "nan_logits", "slow_step", "crash_before_done",
-          "adapter_load_corrupt")
+          "adapter_load_corrupt", "adapter_page_in_stall")
 
 
 class FaultError(RuntimeError):

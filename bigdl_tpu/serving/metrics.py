@@ -396,6 +396,29 @@ class Metrics:
                     "# TYPE bigdl_tpu_adapters_resident gauge",
                     f"bigdl_tpu_adapters_resident {st['resident']}",
                 ]
+                # unified HBM paging (docs/serving.md §7): device
+                # residency in the shared KV page pool. Families render
+                # whenever the adapter block does (0 when the engine has
+                # no pager — dense pool or family cache) so the drift
+                # gate stays structural, not configuration-dependent.
+                pager = getattr(self.engine, "_pager", None)
+                pi = pager.page_ins if pager is not None else 0
+                po = pager.page_outs if pager is not None else 0
+                pr = pager.pages_resident if pager is not None else 0
+                lines += [
+                    "# HELP bigdl_tpu_adapter_page_ins_total adapter "
+                    "weight pages written into the shared KV page pool",
+                    "# TYPE bigdl_tpu_adapter_page_ins_total counter",
+                    f"bigdl_tpu_adapter_page_ins_total {pi}",
+                    "# HELP bigdl_tpu_adapter_page_outs_total adapter "
+                    "weight pages dropped back to host under pressure",
+                    "# TYPE bigdl_tpu_adapter_page_outs_total counter",
+                    f"bigdl_tpu_adapter_page_outs_total {po}",
+                    "# HELP bigdl_tpu_adapter_pages_resident device "
+                    "pages currently holding adapter weights",
+                    "# TYPE bigdl_tpu_adapter_pages_resident gauge",
+                    f"bigdl_tpu_adapter_pages_resident {pr}",
+                ]
             if self.engine.speculative:
                 lines += [
                     "# HELP bigdl_tpu_spec_rounds_total verify rounds run",
@@ -478,6 +501,9 @@ _ADAPTER_FAMILIES = (
     "bigdl_tpu_adapter_evictions_total",
     "bigdl_tpu_adapter_load_failures_total",
     "bigdl_tpu_adapters_resident",
+    "bigdl_tpu_adapter_page_ins_total",
+    "bigdl_tpu_adapter_page_outs_total",
+    "bigdl_tpu_adapter_pages_resident",
 )
 
 

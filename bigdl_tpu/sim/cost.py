@@ -24,7 +24,7 @@ from typing import Optional
 
 from bigdl_tpu.benchmark.roofline import (
     all_reduce_cost, decode_attention_cost, flash_prefill_cost,
-    qmatmul_cost,
+    lora_epilogue_cost, qmatmul_cost,
 )
 from bigdl_tpu.models.config import ModelConfig
 from bigdl_tpu.quant.qtypes import resolve_qtype
@@ -66,6 +66,13 @@ class CostModel:
     #: wire format of the TP all-reduce ("none"|"int8"|"fp8_e4m3") —
     #: parallel/qcollectives.py's comm_qtype knob, priced here
     comm_qtype: str = "none"
+    #: whether the LoRA epilogue is priced as the fused Pallas writeback
+    #: (qmatmul_lora: zero activation HBM round trips) or the XLA einsum
+    #: fallback (two round trips — re-read x, round-trip the delta).
+    #: True matches the serving engine's dispatch on eligible shapes;
+    #: False reproduces the pre-fusion path for before/after comparisons
+    #: (docs/benchmarking.md §3 banks the seed-0 pair)
+    fused_lora: bool = True
 
     # -- pieces --------------------------------------------------------------
 
@@ -117,10 +124,10 @@ class CostModel:
         peak = self.peak_tflops * 1e12
         return max(nbytes / bw, flops / peak)
 
-    def _lora_dim_sum(self, targets=None) -> int:
-        """Sum of (in + out) over the adapter's target set (None = all
-        seven) — the per-rank-unit size of one layer's adapter pairs
-        (A [r, in] + B [out, r] per target)."""
+    def _lora_target_dims(self, targets=None):
+        """(in, out) per target of the adapter's target set (None = all
+        seven) — the per-layer shapes of its A [r, in] / B [out, r]
+        pairs."""
         cfg = self.config
         H, I = cfg.hidden_size, cfg.intermediate_size
         dims = {
@@ -133,30 +140,36 @@ class CostModel:
             "w_down": (I, H),
         }
         names = dims.keys() if targets is None else targets
-        return sum(dims[t][0] + dims[t][1] for t in names if t in dims)
+        return [dims[t] for t in names if t in dims]
 
-    def lora_cost(self, ranks, M: int = 1) -> dict:
-        """The multi-tenant LoRA epilogue's extra traffic per forward
-        (ops/linear.lora_epilogue): every adapter's bf16 A/B pairs
-        stream from HBM once per dispatch, and each of its rows pays
-        2*M*r*(in+out) FLOPs per target per layer. `ranks` = one entry
-        per adapter-carrying row — a bare rank (priced over all seven
+    def lora_cost(self, ranks, M: int = 1, fused=None) -> dict:
+        """The multi-tenant LoRA epilogue's extra traffic per forward,
+        priced by `roofline.lora_epilogue_cost` per target per layer at
+        the dequant-GEMM's real M tiles. `ranks` = one entry per
+        adapter-carrying row — a bare rank (priced over all seven
         targets) or a (rank, targets) pair priced over the adapter's
         ACTUAL target set; adapter-less rows cost nothing (their
         zero-padded rows still move with the batch's bucket, but the
         dominant term — distinct adapters' weights — is what's priced).
-        """
-        items = []
+
+        ``fused`` (default: the model's `fused_lora` field) switches
+        between the fused-writeback pricing (adapter stream only, zero
+        activation round trips) and the XLA fallback's two extra
+        activation HBM round trips per target — the ISSUE 18 perf delta
+        the adapter-zipf before/after banks."""
+        if fused is None:
+            fused = self.fused_lora
+        nbytes = flops = 0
         for r in ranks:
             rank, targets = r if isinstance(r, tuple) else (r, None)
-            if rank:
-                items.append((rank, self._lora_dim_sum(targets)))
-        if not items:
-            return {"bytes": 0, "flops": 0}
+            if not rank:
+                continue
+            for K, O in self._lora_target_dims(targets):
+                c = lora_epilogue_cost(M, K, O, rank, fused=fused)
+                nbytes += c["bytes"]
+                flops += c["flops"]
         L = self.config.num_hidden_layers
-        nbytes = sum(2 * r * d for r, d in items) * L  # bf16 A+B stream
-        flops = sum(2 * M * r * d for r, d in items) * L
-        return {"bytes": nbytes, "flops": flops}
+        return {"bytes": nbytes * L, "flops": flops * L}
 
     def tp_comm_s(self, M: int) -> float:
         """Seconds of per-forward TP collective traffic at M rows: two
@@ -317,4 +330,5 @@ class CostModel:
             "tp": self.tp,
             "ici_gbps": self.ici_gbps,
             "comm_qtype": self.comm_qtype,
+            "fused_lora": self.fused_lora,
         }

@@ -145,8 +145,8 @@ class SimConfig:
     # engine runs REAL draft+verify rounds on the tiny model (which
     # must be dense — bf16/fp16 — for the sym_int4 self-draft) while
     # cost.spec_round_s prices each round as draft_k draft steps + one
-    # batched verify. Incompatible with chunked prefill and adapter
-    # traces (the engine refuses those combinations itself).
+    # batched verify. Composes with adapter traces (base draft,
+    # adapter-applied verify); chunked prefill the engine still refuses.
     speculative: bool = False
     draft_k: int = 4
     seed: int = 0
@@ -483,6 +483,7 @@ class SimDriver:
             # scheduler-level cost of multi-tenant adapter traffic,
             # gated on CPU like everything else)
             st = self.adapters.stats()
+            pager = getattr(eng, "_pager", None)
             adapter_extra["adapters"] = {
                 "n_tenants": len({a.adapter for a in tr.arrivals
                                   if a.adapter}),
@@ -492,11 +493,31 @@ class SimDriver:
                 "evictions": st["evictions"],
                 "load_failures": st["load_failures"],
                 "resident_at_drain": st["resident"],
+                # unified HBM paging churn (serving/adapters.AdapterPager):
+                # device pages in the SHARED KV pool; 0s when the engine
+                # runs dense (no pager)
+                "page_ins": pager.page_ins if pager is not None else 0,
+                "page_outs": pager.page_outs if pager is not None else 0,
+                "pages_resident_at_drain": (
+                    pager.pages_resident if pager is not None else 0),
+            }
+        spec_extra: dict = {}
+        if getattr(eng, "speculative", False):
+            rounds = eng.spec_rounds
+            spec_extra["speculative"] = {
+                "draft_k": self.sim.draft_k,
+                "rounds": rounds,
+                "emitted": eng.spec_emitted,
+                # tokens per verify round (1.0 = nothing accepted,
+                # draft_k = every draft accepted + the bonus token)
+                "tokens_per_round": round(
+                    eng.spec_emitted / rounds, 4) if rounds else 0.0,
             }
         s = self.sim
         return {
             "format": REPORT_FORMAT, "version": REPORT_VERSION,
             **adapter_extra,
+            **spec_extra,
             "trace": {
                 "name": tr.name, "seed": tr.seed, "n_requests": len(tr.arrivals),
                 "duration_s": round(tr.duration_s, 6),
@@ -585,6 +606,16 @@ SCENARIOS: dict = {
     # (cost.spec_round_s) — the ROADMAP sim-calibration remainder that
     # previously made SimDriver refuse speculative engines
     "speculative": SimConfig(speculative=True, draft_k=4),
+    # S-LoRA completion: Zipf adapter traffic THROUGH speculative
+    # decoding (base draft, adapter-applied verify) over a page pool
+    # tight enough that adapter pages and KV fight for the same budget
+    # — acceptance, adapter page churn AND zero-leak drain all gate on
+    # this mix (scripts/ci.sh --core). Host-RAM budget covers all 4
+    # tenants (host churn is adapter-zipf's story); the pressure here
+    # is DEVICE pages: 16 shared pages force holder-free adapter
+    # page-outs when concurrent KV demand spikes
+    "adapter-spec": SimConfig(adapter_budget=4, speculative=True,
+                              draft_k=4, n_pages=16),
 }
 
 

@@ -256,7 +256,7 @@ def assign_adapters(trace: Trace, n_adapters: int, seed: int = 0,
 # ---------------------------------------------------------------------------
 
 TRACE_NAMES = ("poisson", "bursty", "prefix-heavy", "overload",
-               "adapter-zipf", "speculative")
+               "adapter-zipf", "speculative", "adapter-spec")
 
 
 def named_trace(name: str, seed: int = 0) -> Trace:
@@ -296,5 +296,17 @@ def named_trace(name: str, seed: int = 0) -> Trace:
         return poisson_trace(
             rate_rps=6.0, n_requests=24, seed=seed, name="speculative",
             prompt_len=(8, 24), out_tokens=(16, 48),
+        )
+    if name == "adapter-spec":
+        # S-LoRA completion: Zipf adapter traffic THROUGH speculative
+        # decoding — base-model draft, adapter-applied verify. The
+        # scenario's tight shared page pool makes adapter pages and KV
+        # fight for one budget, so unified-paging churn fires alongside
+        # acceptance (engine_driver SCENARIOS["adapter-spec"])
+        return assign_adapters(
+            poisson_trace(rate_rps=16.0, n_requests=24, seed=seed,
+                          name="adapter-spec", prompt_len=(8, 24),
+                          out_tokens=(16, 48)),
+            n_adapters=4, seed=seed,
         )
     raise ValueError(f"unknown trace mix {name!r}; known: {TRACE_NAMES}")

@@ -128,6 +128,33 @@ print("adapter smoke: %d loads, %d hits, %d evictions over %d tenants "
           adz["adapters"]["loads"], adz["adapters"]["hits"],
           adz["adapters"]["evictions"], adz["adapters"]["n_tenants"],
           adz["adapters"]["resident_at_drain"]))
+# S-LoRA completion smoke (ISSUE 18): adapter traffic THROUGH
+# speculative decode over a page pool shared with KV (unified paging).
+# Gates: verify rounds genuinely accept (> 1 token/round on average),
+# adapter pages churn through the shared pool under the tight budget,
+# nothing leaks at drain, and the report is byte-identical at seed 0.
+# NOTE: dense bf16 tiny model (the self-draft re-quantizes a sym_int4
+# base), so no model= reuse here — the driver builds its own.
+asp = run_scenario("adapter-spec", seed=0)
+assert asp["speculative"]["rounds"] > 0, "adapter-spec ran no verify rounds"
+assert asp["speculative"]["tokens_per_round"] > 1.0, \
+    "speculative verify under adapters accepted nothing"
+assert asp["adapters"]["page_ins"] > 0, \
+    "unified paging idle: no adapter pages entered the shared pool"
+assert asp["adapters"]["page_ins"] + asp["adapters"]["page_outs"] > \
+    asp["adapters"]["pages_resident_at_drain"], \
+    "no adapter page churn under the tight shared budget"
+assert asp["adapters"]["load_failures"] == 0, asp["adapters"]
+assert asp["kv"]["page_leak_at_drain"] == 0, \
+    "adapter-spec leaked pages (KV + adapter holders must reconcile)"
+assert report_json(asp) == report_json(run_scenario("adapter-spec", seed=0)), \
+    "adapter-spec report must be byte-identical at seed 0"
+print("adapter-spec smoke: %d rounds, %.2f tokens/round, "
+      "%d page-ins / %d page-outs, %d pages resident at drain" % (
+          asp["speculative"]["rounds"],
+          asp["speculative"]["tokens_per_round"],
+          asp["adapters"]["page_ins"], asp["adapters"]["page_outs"],
+          asp["adapters"]["pages_resident_at_drain"]))
 PY
   echo "CORE OK"
   exit 0

@@ -323,8 +323,12 @@ def test_parity_under_preemption(model, adapter_dir, merged_oracle):
     jobs = list(zip(PROMPTS, [None, "t-r2", "t-r3", "t-r5"]))
     # injected pool exhaustion mid-decode (the chaos-suite pattern)
     # forces a victim to host RAM; decode runs long enough that every
-    # row crosses a page boundary and needs the allocation
-    inj = FaultInjector(seed=0).arm("alloc_page", times=2, after=6)
+    # row crosses a page boundary and needs the allocation. The first
+    # 10 alloc_page fires are admission (4) + adapter page-ins (6,
+    # ISSUE 18's unified paging — a fault there is absorbed as a host
+    # epilogue fallback, never a preemption), so skip 12 to land both
+    # faults on decode page growth.
+    inj = FaultInjector(seed=0).arm("alloc_page", times=2, after=12)
     eng, reqs = _run_engine(model, jobs, reg, n_new=16, faults=inj)
     assert eng.preemptions > 0, "scenario must actually preempt"
     for (prompt, name), req in zip(jobs, reqs):
@@ -609,3 +613,155 @@ def test_cost_model_prices_epilogue():
     r16 = cm.decode_step_s([64, 64], 64, adapter_ranks=[16, 16])
     assert r16 > with_lora
     assert cm.prefill_s(64, adapter_rank=8) > cm.prefill_s(64)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: unified HBM paging + adapter-aware speculative decode
+# ---------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_unified_paging_shares_kv_pool(model, adapter_dir, merged_oracle):
+    """Adapter weights live in pages of the SAME PagePool as KV: the
+    pager pages in at admission, residency survives the request (warm
+    reuse), page_leaks() reconciles adapter holds, and KV pressure
+    pages holder-free adapters back out (host copy survives)."""
+    d, _ = adapter_dir
+    reg = AdapterRegistry(dir=d)
+    jobs = list(zip(PROMPTS, [None, "t-r2", "t-r3", "t-r5"]))
+    eng, reqs = _run_engine(model, jobs, reg)
+    pager = eng._pager
+    assert pager is not None and pager.page_ins >= 3
+    assert pager.pages_resident > 0  # warm after drain, holder-free
+    for (prompt, name), req in zip(jobs, reqs):
+        assert req.out_tokens == merged_oracle[(name, tuple(prompt))], \
+            (name, prompt)
+    # every resident page carries a real pool reference (one each)
+    for pg in pager.held_pages():
+        assert eng._pool.ref[pg] >= 1
+    # holder-free residency is evictable: drain the pool and the
+    # allocator's escalation (radix -> adapter page-out) frees them
+    grabbed = []
+    pg = eng._alloc_page()
+    while pg is not None:
+        grabbed.append(pg)
+        pg = eng._alloc_page()
+    assert pager.pages_resident == 0 and pager.page_outs >= 3
+    for pg in grabbed:
+        eng._pool.decref(pg)
+    assert eng.page_leaks() == 0
+    # next admission pages back in from the surviving host copy
+    r = eng.submit(PROMPTS[1], max_new_tokens=4, adapter="t-r2")
+    eng.run_until_idle(max_steps=300)
+    assert r.out_tokens == merged_oracle[("t-r2", tuple(PROMPTS[1]))][:4]
+    assert pager.pages_resident > 0
+    # the new families render and the drift gate stays clean
+    from bigdl_tpu.serving.metrics import Metrics, metric_drift
+
+    rendered = Metrics(eng).render()
+    missing, unregistered = metric_drift(rendered, eng)
+    assert not missing and not unregistered, (missing, unregistered)
+    assert "bigdl_tpu_adapter_page_ins_total" in rendered
+    assert "bigdl_tpu_adapter_page_outs_total" in rendered
+    assert "bigdl_tpu_adapter_pages_resident" in rendered
+
+
+@pytest.mark.chaos
+def test_adapter_page_in_stall_quarantines_one_request(model, adapter_dir):
+    """An injected device page-in stall fails exactly the request that
+    triggered it ("error", structured kind) — neighbours, including
+    another tenant, finish normally; nothing leaks; refcounts drain."""
+    d, _ = adapter_dir
+    inj = FaultInjector(seed=0).arm("adapter_page_in_stall", times=1)
+    reg = AdapterRegistry(dir=d)
+    jobs = [(PROMPTS[0], "t-r2"), (PROMPTS[1], "t-r3"), (PROMPTS[2], None)]
+    eng, reqs = _run_engine(model, jobs, reg, faults=inj)
+    bad, good, base = reqs
+    assert bad.done and bad.finish_reason == "error"
+    assert "page_in_stall" in bad.error and "t-r2" in bad.error
+    assert good.finish_reason in ("stop", "length"), good.error
+    assert base.finish_reason in ("stop", "length")
+    assert inj.fired["adapter_page_in_stall"] == 1
+    # the failed page-in left no partial residency, and the stalled
+    # tenant's registry reference was handed back (evictable again)
+    assert all(e["refcount"] == 0 for e in reg.resident())
+    assert eng.page_leaks() == 0
+    # the stalled tenant works on retry (fault exhausted)
+    r = eng.submit(PROMPTS[0], max_new_tokens=4, adapter="t-r2")
+    eng.run_until_idle(max_steps=300)
+    assert r.finish_reason in ("stop", "length"), r.error
+
+
+@pytest.mark.core
+def test_speculative_adapter_parity_vs_merged(model, adapter_dir,
+                                              merged_oracle):
+    """The S-LoRA completion oracle: a mixed batch (3 ranks + base)
+    decoded through SPECULATIVE rounds — base-model draft, adapter
+    applied in the verify forward — emits the same greedy tokens as
+    non-speculative adapter decode, i.e. the offline merge_lora oracle
+    (which test_mixed_batch_parity_vs_merged pins to the plain path)."""
+    d, _ = adapter_dir
+    reg = AdapterRegistry(dir=d)
+    jobs = list(zip(PROMPTS, [None, "t-r2", "t-r3", "t-r5"]))
+    eng, reqs = _run_engine(model, jobs, reg, speculative=True, draft_k=2)
+    assert eng.spec_rounds > 0 and eng.spec_emitted > 0
+    for (prompt, name), req in zip(jobs, reqs):
+        assert req.finish_reason in ("stop", "length"), req.error
+        assert req.out_tokens == merged_oracle[(name, tuple(prompt))], \
+            (name, prompt)
+    assert eng._pager is not None and eng._pager.page_ins > 0
+    assert all(e["refcount"] == 0 for e in reg.resident())
+
+
+@pytest.mark.chaos
+def test_speculative_adapter_parity_under_preemption(model, adapter_dir,
+                                                     merged_oracle):
+    """Injected pool exhaustion preempts an adapter-carrying slot out of
+    a SPECULATIVE batch; after resume the emitted tokens still extend
+    the merged oracle (greedy prefix-stability), and the shared pool
+    reconciles at drain."""
+    d, _ = adapter_dir
+    reg = AdapterRegistry(dir=d)
+    jobs = list(zip(PROMPTS, [None, "t-r2", "t-r3", "t-r5"]))
+    # skip admission (4) + adapter page-in (6) allocs so both faults
+    # land on decode page growth (see test_parity_under_preemption)
+    inj = FaultInjector(seed=0).arm("alloc_page", times=2, after=12)
+    eng, reqs = _run_engine(model, jobs, reg, n_new=12, faults=inj,
+                            speculative=True, draft_k=2)
+    assert eng.preemptions > 0, "scenario must actually preempt"
+    for (prompt, name), req in zip(jobs, reqs):
+        assert req.finish_reason in ("stop", "length"), req.error
+        oracle = merged_oracle[(name, tuple(prompt))]
+        assert req.out_tokens[: len(oracle)] == oracle, (name, prompt)
+        assert len(req.out_tokens) == 12
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_speculative_adapter_replay_after_crash(model, adapter_dir,
+                                                tmp_path, merged_oracle):
+    """crash_before_done on a speculative adapter engine: the successor
+    (also speculative) replays the journaled request WITH its adapter
+    and matches the merged oracle — the journal path is agnostic to how
+    tokens were emitted."""
+    d, _ = adapter_dir
+    jpath = str(tmp_path / "journal.jsonl")
+    inj = FaultInjector(seed=0).arm("crash_before_done", times=1)
+    reg = AdapterRegistry(dir=d)
+    eng = InferenceEngine(model, n_slots=4, max_len=128, paged=True,
+                          page_size=16, adapters=reg, journal=jpath,
+                          faults=inj, speculative=True, draft_k=2)
+    req = eng.submit(PROMPTS[1], max_new_tokens=8, adapter="t-r2")
+    with pytest.raises(Exception):
+        eng.run_until_idle(max_steps=500)
+    assert req.done
+    reg2 = AdapterRegistry(dir=d)
+    eng2 = InferenceEngine(model, n_slots=4, max_len=128, paged=True,
+                           page_size=16, adapters=reg2, journal=jpath,
+                           speculative=True, draft_k=2)
+    assert len(eng2.recovered_requests) == 1
+    rec = eng2.recovered_requests[0]
+    assert rec.adapter == "t-r2"
+    eng2.run_until_idle(max_steps=500)
+    assert rec.done and rec.finish_reason in ("stop", "length")
+    assert rec.out_tokens == merged_oracle[("t-r2", tuple(PROMPTS[1]))]
+    eng2.close()

@@ -124,6 +124,69 @@ def test_gemm_grad_matches_xla_path(rng, monkeypatch):
 
 
 @pytest.mark.core
+def test_lora_fused_epilogue_parity(rng, monkeypatch):
+    """ISSUE 18: the LoRA epilogue folded into the dequant-GEMM's
+    writeback (`qmatmul_lora`, gate-trick batched adapters) matches the
+    XLA `lora_epilogue` fallback — logits at bf16 tolerance, exact
+    gradients through the custom_vjp product rule — for both the shared
+    (training) and batched per-row (serving) adapter shapes, straddling
+    the GEMV/GEMM dispatch boundary."""
+    K, O, r, B = 256, 256, 4, 3
+    qt = quantize(jnp.asarray(rng.normal(size=(O, K)) * 0.1, jnp.float32),
+                  "sym_int4")
+
+    # batched per-row adapters: two live tenants + one adapter-less row
+    # (zero pair, scale 0 — must ride along unchanged)
+    a = jnp.asarray(rng.normal(size=(B, r, K)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(B, O, r)) * 0.1, jnp.float32)
+    a = a.at[2].set(0.0)
+    b = b.at[2].set(0.0)
+    scale = jnp.asarray([2.0, 0.5, 0.0], jnp.float32)
+    shared = (a[0], b[0], jnp.asarray(2.0, jnp.float32))
+
+    def run(x, lora):
+        return linear(x, qt, None, jnp.bfloat16, lora=lora)
+
+    for t in (1, 40):  # 3 rows -> GEMV; 120 rows -> tiled GEMM
+        x = jnp.asarray(rng.normal(size=(B, t, K)), jnp.float32)
+        for lora in ((a, b, scale), shared):
+            monkeypatch.setenv("BIGDL_TPU_PALLAS", "interpret")
+            y_fused = run(x, lora)
+            monkeypatch.setenv("BIGDL_TPU_PALLAS", "0")
+            y_xla = run(x, lora)
+            np.testing.assert_allclose(
+                np.asarray(y_fused, jnp.float32),
+                np.asarray(y_xla, jnp.float32),
+                atol=0.2, rtol=0.05, err_msg=f"T={t}",
+            )
+    # the adapter-less row equals the plain (no-lora) fused matmul
+    monkeypatch.setenv("BIGDL_TPU_PALLAS", "interpret")
+    x = jnp.asarray(rng.normal(size=(B, 8, K)), jnp.float32)
+    y = run(x, (a, b, scale))
+    y0 = linear(x, qt, None, jnp.bfloat16)
+    np.testing.assert_allclose(
+        np.asarray(y[2], jnp.float32), np.asarray(y0[2], jnp.float32),
+        atol=1e-6, rtol=0,
+    )
+
+    # gradients: d/dx and d/d(a, b) agree with the XLA epilogue path
+    g = jnp.asarray(rng.normal(size=(B, 8, O)), jnp.float32)
+
+    def loss(x, a, b):
+        return jnp.sum(run(x, (a, b, scale)).astype(jnp.float32) * g)
+
+    monkeypatch.setenv("BIGDL_TPU_PALLAS", "interpret")
+    grads_fused = jax.grad(loss, argnums=(0, 1, 2))(x, a, b)
+    monkeypatch.setenv("BIGDL_TPU_PALLAS", "0")
+    grads_xla = jax.grad(loss, argnums=(0, 1, 2))(x, a, b)
+    for gf, gx in zip(grads_fused, grads_xla):
+        np.testing.assert_allclose(
+            np.asarray(gf, jnp.float32), np.asarray(gx, jnp.float32),
+            atol=2e-2, rtol=2e-2,
+        )
+
+
+@pytest.mark.core
 def test_qlora_train_step_fused_matches_xla(monkeypatch):
     """QLoRA acceptance (ISSUE 9): one train step over a quantized base
     with rows > _GEMV_MAX_ROWS runs the frozen-base matmuls through the
