@@ -21,5 +21,6 @@ from bigdl_tpu.analysis.core import (  # noqa: F401
     lint_text,
     load_baseline,
     run,
+    stale_baseline_entries,
     write_baseline,
 )

@@ -631,6 +631,10 @@ class JournalLineDiscipline(Check):
             )
 
 
+from .interproc import INTERPROC_CHECKS  # noqa: E402 (checks need the
+# Check/Finding definitions above via core; interproc imports from core
+# directly so this late import only avoids a cosmetic cycle)
+
 ALL_CHECKS = (
     WallClockBan,
     AtomicWriteBan,
@@ -639,4 +643,4 @@ ALL_CHECKS = (
     MetricsDrift,
     DonationHazard,
     JournalLineDiscipline,
-)
+) + INTERPROC_CHECKS

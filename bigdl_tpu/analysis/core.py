@@ -315,22 +315,86 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
 # CLI body (bigdl-tpu lint delegates here; returns the exit code)
 # ---------------------------------------------------------------------------
 
+def stale_baseline_entries(baseline: Sequence[dict],
+                           findings: Sequence[Finding]) -> list:
+    """Baseline entries matching no current finding — each is itself an
+    error (the violation was fixed; the entry must go), reported as a
+    BASE001 finding so the baseline monotonically shrinks."""
+    live = {f.key() for f in findings}
+    out = []
+    for e in baseline:
+        if (e.get("rule"), e.get("path"), e.get("code")) not in live:
+            out.append(Finding(
+                rule="BASE001", path=e.get("path", "?"),
+                line=int(e.get("line", 0) or 0),
+                message=(f"stale baseline entry for {e.get('rule')} — no "
+                         "current finding matches its code line; the "
+                         "violation was fixed, so the entry must be "
+                         "removed"),
+                hint="run `bigdl-tpu lint --update-baseline` (drops "
+                     "stale entries, keeps surviving justifications)",
+                code=e.get("code", ""),
+            ))
+    return out
+
+
+def _emit(new: Sequence[Finding], grandfathered: Sequence[Finding],
+          fmt: str, out) -> None:
+    if fmt == "json":
+        doc = {
+            "findings": [dataclasses.asdict(f) for f in new],
+            "baselined": len(grandfathered),
+        }
+        print(json.dumps(doc, indent=2), file=out)
+        return
+    if fmt == "github":
+        # GitHub workflow-command annotations: one line per finding,
+        # surfaced inline on the PR diff by the Actions runner.
+        for f in new:
+            msg = f.message + (f" (fix: {f.hint})" if f.hint else "")
+            # newlines/`::` would terminate the workflow command early
+            msg = msg.replace("%", "%25").replace("\n", "%0A")
+            print(f"::error file={f.path},line={f.line},"
+                  f"title=graftlint {f.rule}::{msg}", file=out)
+        print(f"graftlint: {len(new)} finding(s), "
+              f"{len(grandfathered)} baselined", file=out)
+        return
+    for f in new:
+        print(f.format(), file=out)
+    tail = (f"graftlint: {len(new)} finding(s)"
+            + (f" ({len(grandfathered)} baselined)" if grandfathered else "")
+            + f" across {len({f.path for f in new}) if new else 0} file(s)")
+    print(tail, file=out)
+
+
 def run(paths: Optional[Sequence[str]] = None,
         baseline_path: Optional[str] = None,
         rules: Optional[Sequence[str]] = None,
         write_baseline_path: Optional[str] = None,
-        out=None) -> int:
+        out=None, fmt: str = "human",
+        update_baseline: bool = False) -> int:
     """Full lint run: scan, subtract baseline, print, exit code.
-    0 = clean; 1 = non-baselined findings; 2 = usage/config error."""
+    0 = clean; 1 = non-baselined findings (or stale baseline entries);
+    2 = usage/config error.
+
+    ``fmt`` selects the output: "human" (default), "json" (one document
+    with every finding), or "github" (``::error`` annotation lines).
+    ``update_baseline`` regenerates the baseline in place from the
+    current findings — justifications of surviving entries carry over,
+    stale entries drop."""
     import sys
 
     out = out or sys.stdout
-    if write_baseline_path and (paths or rules):
+    if fmt not in ("human", "json", "github"):
+        print(f"graftlint: unknown format {fmt!r} "
+              "(choose human, json, github)", file=out)
+        return 2
+    if (write_baseline_path or update_baseline) and (paths or rules):
         # a filtered scan sees only a slice of the findings; writing it
         # as THE baseline would silently drop every grandfathered entry
         # outside the slice, and the next full run would fail on them
-        print("graftlint: --write-baseline requires a full, unfiltered "
-              "scan (no paths, no --rules)", file=out)
+        print("graftlint: --write-baseline/--update-baseline require a "
+              "full, unfiltered scan (no paths, no --rules)", file=out)
         return 2
     checks = default_checks()
     if rules:
@@ -350,15 +414,28 @@ def run(paths: Optional[Sequence[str]] = None,
         print(f"graftlint: bad baseline {bl_path}: {e}", file=out)
         return 2
     new, grandfathered = apply_baseline(findings, baseline)
+    if update_baseline:
+        write_baseline(findings, bl_path, previous=baseline)
+        live = {f.key() for f in findings}
+        surviving = sum(
+            1 for e in baseline
+            if (e.get("rule"), e.get("path"), e.get("code")) in live)
+        print(f"graftlint: baseline {bl_path} now carries "
+              f"{len(findings)} entry(ies) "
+              f"({len(baseline) - surviving} stale dropped, "
+              f"{surviving} justification(s) preserved); "
+              "new entries need their TODO justifications filled in",
+              file=out)
+        return 0
     if write_baseline_path:
         write_baseline(findings, write_baseline_path, previous=baseline)
         print(f"graftlint: wrote {len(findings)} finding(s) to "
               f"{write_baseline_path}", file=out)
         return 0
-    for f in new:
-        print(f.format(), file=out)
-    tail = (f"graftlint: {len(new)} finding(s)"
-            + (f" ({len(grandfathered)} baselined)" if grandfathered else "")
-            + f" across {len({f.path for f in new}) if new else 0} file(s)")
-    print(tail, file=out)
+    # baseline hygiene: on a full scan, an entry absorbing nothing is
+    # itself an error (partial scans can't judge staleness — a filtered
+    # run legitimately misses findings the entry still matches)
+    if not paths and not rules:
+        new = list(new) + stale_baseline_entries(baseline, findings)
+    _emit(new, grandfathered, fmt, out)
     return 1 if new else 0

@@ -661,12 +661,14 @@ def cmd_lint(args):
 
         bigdl-tpu lint                     # whole bigdl_tpu package
         bigdl-tpu lint bigdl_tpu/serving   # a subtree / single file
-        bigdl-tpu lint --rules WCT001,ATW001
+        bigdl-tpu lint --rules WCT001,PAGE002
+        bigdl-tpu lint --format github     # ::error CI annotations
         bigdl-tpu lint --write-baseline    # grandfather current findings
+        bigdl-tpu lint --update-baseline   # drop stale, keep justifications
 
-    Exit 0 = clean, 1 = non-baselined findings, 2 = config error.
-    Deliberately jax-free: scripts/ci.sh --lint asserts jax never
-    entered sys.modules during a run."""
+    Exit 0 = clean, 1 = non-baselined findings or stale baseline
+    entries, 2 = config error. Deliberately jax-free: scripts/ci.sh
+    --lint asserts jax never entered sys.modules during a run."""
     from bigdl_tpu.analysis import core as lint_core
 
     if args.list_rules:
@@ -681,6 +683,8 @@ def cmd_lint(args):
         baseline_path=args.baseline,
         rules=args.rules.split(",") if args.rules else None,
         write_baseline_path=write_to,
+        fmt=args.format,
+        update_baseline=args.update_baseline,
     ))
 
 
@@ -977,8 +981,10 @@ def main(argv=None):
         "lint",
         help="graftlint: AST invariant checks over bigdl_tpu/ (clock "
              "injection, atomic writes, fault points, lock discipline, "
-             "metrics drift, donation, journal crc; exit 1 on any "
-             "non-baselined finding — docs/static-analysis.md)",
+             "metrics drift, donation, journal crc, plus the v2 "
+             "interprocedural families: PAGE page-leak proofs, LCK "
+             "lock-order cycles, DSP dispatch consistency; exit 1 on "
+             "any non-baselined finding — docs/static-analysis.md)",
     )
     ln.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the installed "
@@ -991,6 +997,14 @@ def main(argv=None):
     ln.add_argument("--write-baseline", action="store_true",
                     help="record current findings as the new baseline "
                          "(each entry then needs a justification edit)")
+    ln.add_argument("--update-baseline", action="store_true",
+                    help="regenerate the baseline in place: stale "
+                         "entries drop, surviving justifications carry "
+                         "over")
+    ln.add_argument("--format", choices=("human", "json", "github"),
+                    default="human",
+                    help="output format (github = ::error annotation "
+                         "lines for CI inline comments)")
     ln.add_argument("--list-rules", action="store_true")
     ln.set_defaults(fn=cmd_lint)
 

@@ -195,6 +195,7 @@ def _fused_kernel(x: jax.Array, w: QTensor) -> Optional[Callable]:
     """The fused kernel this (x, w) pair dispatches to, or None for the
     XLA dequant path. Shape guards are shared by both shape classes."""
     from bigdl_tpu.ops.pallas import use_pallas
+    from bigdl_tpu.ops.pallas.tiling import VMEM_BUDGET
 
     entry = _QGEMV_QTYPES.get(w.qtype)
     if entry is None or w.data.ndim != 2:
@@ -204,10 +205,11 @@ def _fused_kernel(x: jax.Array, w: QTensor) -> Optional[Callable]:
         return None
     # the kernels tile O at >= 128 rows (Mosaic lane rule forbids
     # smaller output tiles); if even a 128-row tile's persistent weight
-    # block cannot fit the scoped-VMEM budget half, fall back to the
-    # XLA dequant path rather than compile a kernel that overflows vmem
+    # block cannot fit half the scoped-VMEM budget (the other half is
+    # the x/acc slabs), fall back to the XLA dequant path rather than
+    # compile a kernel that overflows vmem
     row_bytes = kw_ * w.data.dtype.itemsize
-    if 128 * row_bytes > 5 * 1024 * 1024:
+    if 128 * row_bytes > VMEM_BUDGET // 2:
         return None
     if w.shape[-1] % entry.k_multiple != 0:
         return None

@@ -659,7 +659,17 @@ class AdapterPager:
                     self._pool.decref(p)
                 return False
             pages.append(pg)
-        self.store.write(pages, flat)
+        try:
+            self.store.write(pages, flat)
+        except Exception:
+            # the device scatter is a fault point (host OOM, bad
+            # artifact dtype, injected stall): its raise must not
+            # strand the freshly-allocated page refs — nothing holds
+            # them yet, so give them straight back and let the caller
+            # quarantine the one request (graftlint PAGE002)
+            for p in pages:
+                self._pool.decref(p)
+            raise
         self.page_ins += len(pages)
         rec = _PagedAdapter(entry.name, pages, shapes, int(flat.size))
         rec.holders.add(rid)

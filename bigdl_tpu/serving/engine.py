@@ -1153,23 +1153,31 @@ class InferenceEngine:
                 self._journal.record_submit(req)
             self._queue.put(req)
             return req
+        shed_qsize = None
         with self._admission_lock:
-            if self._queue.qsize() >= self.max_queue:
+            qsize = self._queue.qsize()
+            if qsize >= self.max_queue:
                 # bounded admission: overload surfaces as a fast explicit
                 # rejection the client can retry, not as unbounded queue
                 # latency. Checked BEFORE the journal append — a shed
                 # request was never accepted, so a crash must not replay
-                # it.
-                self._shed_request(req, "queue_full", (
-                    f"queue full: {self._queue.qsize()} waiting >= "
-                    f"max_queue {self.max_queue}; retry later"
-                ), journaled=False)
-                return req
-            with self._stat_lock:
-                self._inflight += 1
-            if self._journal is not None:
-                self._journal.record_submit(req)
-            self._queue.put(req)
+                # it. Only the DECISION needs the lock's check-then-put
+                # atomicity; the rejection itself (request-log write,
+                # stream put) is blocking work that must not convoy
+                # every other submit behind it (graftlint LCK102), so
+                # it runs after release.
+                shed_qsize = qsize
+            else:
+                with self._stat_lock:
+                    self._inflight += 1
+                if self._journal is not None:
+                    self._journal.record_submit(req)
+                self._queue.put(req)
+        if shed_qsize is not None:
+            self._shed_request(req, "queue_full", (
+                f"queue full: {shed_qsize} waiting >= "
+                f"max_queue {self.max_queue}; retry later"
+            ), journaled=False)
         return req
 
     def _slot_sampling(self, req: Request) -> tuple[float, int, float, bool]:

@@ -24,13 +24,17 @@ else
 fi
 
 run_lint() {
-  echo "== graftlint: AST invariant gate (docs/static-analysis.md;"
-  echo "   pure-CPU, < 10 s, asserts jax never imports)"
+  echo "== graftlint: interprocedural invariant gate (docs/static-analysis.md;"
+  echo "   per-file AST rules + v2 PAGE/LCK/DSP flow analysis;"
+  echo "   pure-CPU, < 10 s enforced, asserts jax never imports)"
   python - <<'PY'
-import sys
+import sys, time
+t0 = time.monotonic()
 from bigdl_tpu.analysis import run
 rc = run()
+dt = time.monotonic() - t0
 assert "jax" not in sys.modules, "graftlint must never import jax"
+assert dt < 10.0, f"graftlint took {dt:.1f}s — over the 10 s budget"
 sys.exit(rc)
 PY
 }

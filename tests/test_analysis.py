@@ -3,6 +3,7 @@ positive, suppressed, baseline-filtered — plus the real-tree gate and
 the regression guard that the clock/atomic sites fixed in this PR stay
 clean. Deliberately jax-free (the lint contract) and fast."""
 
+import io
 import json
 import os
 import subprocess
@@ -505,3 +506,540 @@ def test_lint_cli_runs_without_importing_jax():
 def test_parse_error_is_a_finding_not_a_crash():
     fs = lc.lint_text("def broken(:\n", "bigdl_tpu/x.py")
     assert len(fs) == 1 and fs[0].rule == "PARSE"
+
+
+# ---------------------------------------------------------------------------
+# PAGE0xx — interprocedural page-ref liveness (analysis/flow.py)
+# ---------------------------------------------------------------------------
+
+def test_page001_leak_on_early_return():
+    fs = lint("""
+        class Holder:
+            def grab(self, want):
+                pg = self.pool.alloc()
+                if want:
+                    return True  # leaks pg
+                self.pool.decref(pg)
+                return False
+    """, "bigdl_tpu/serving/pagefix.py", "PAGE001")
+    assert len(fs) == 1
+    assert "pg" in fs[0].message and "return" in fs[0].message
+
+
+def test_page001_none_refined_rollback_and_transfer_are_clean():
+    # the engine's _admit_paged shape: incref loop, alloc loop with
+    # full rollback on a dry pool, then ownership transfer into the
+    # slot table — no finding on any path
+    fs = lint("""
+        class Holder:
+            def admit(self, shared, need, slot):
+                for pg in shared:
+                    self.pool.incref(pg)
+                fresh = []
+                for _ in range(need):
+                    pg = self.pool.alloc()
+                    if pg is None:
+                        for q in fresh:
+                            self.pool.decref(q)
+                        for q in shared:
+                            self.pool.decref(q)
+                        return False
+                    fresh.append(pg)
+                table = shared + fresh
+                self._slots[slot] = table
+                return True
+    """, "bigdl_tpu/serving/pagefix.py")
+    assert [f for f in fs if f.rule.startswith("PAGE")] == []
+
+
+def test_page001_return_of_ref_is_a_transfer_not_a_leak():
+    fs = lint("""
+        class Holder:
+            def take(self):
+                pg = self.pool.alloc()
+                return pg
+    """, "bigdl_tpu/serving/pagefix.py", "PAGE001")
+    assert fs == []
+
+
+def test_page002_may_raise_call_with_live_refs_fires():
+    fs = lint("""
+        class Pager:
+            def page_in(self, n, flat):
+                pages = []
+                for _ in range(n):
+                    pg = self.pool.alloc()
+                    if pg is None:
+                        for p in pages:
+                            self.pool.decref(p)
+                        return False
+                    pages.append(pg)
+                self.store.write(pages, flat)  # may raise; pages leak
+                self._res["x"] = pages
+                return True
+    """, "bigdl_tpu/serving/pagefix.py", "PAGE002")
+    assert len(fs) == 1
+    assert "pages" in fs[0].message
+
+
+def test_page002_try_except_rollback_is_clean():
+    fs = lint("""
+        class Pager:
+            def page_in(self, n, flat):
+                pages = []
+                for _ in range(n):
+                    pg = self.pool.alloc()
+                    if pg is None:
+                        return False
+                    pages.append(pg)
+                try:
+                    self.store.write(pages, flat)
+                except Exception:
+                    for p in pages:
+                        self.pool.decref(p)
+                    raise
+                self._res["x"] = pages
+                return True
+    """, "bigdl_tpu/serving/pagefix.py", "PAGE002")
+    assert fs == []
+
+
+def test_page002_suppression_comment_silences_the_site():
+    fs = lint("""
+        class Pager:
+            def page_in(self, n, flat):
+                pg = self.pool.alloc()
+                # graftlint: disable=PAGE002
+                self.store.write([pg], flat)
+                self._res["x"] = [pg]
+                self.pool.decref(pg)
+    """, "bigdl_tpu/serving/pagefix.py", "PAGE002")
+    assert fs == []
+
+
+def test_page_findings_are_baselinable_like_any_other():
+    findings = lint("""
+        class Holder:
+            def grab(self):
+                pg = self.pool.alloc()
+                return True
+    """, "bigdl_tpu/serving/pagefix.py", "PAGE001")
+    assert len(findings) == 1
+    bl = [{"rule": "PAGE001", "path": "bigdl_tpu/serving/pagefix.py",
+           "code": findings[0].code, "justification": "fixture"}]
+    new, old = lc.apply_baseline(findings, bl)
+    assert new == [] and len(old) == 1
+
+
+def test_page002_regression_the_adapter_pager_bug_shape():
+    """The exact pre-fix AdapterPager.ensure shape: allocate the page
+    run, then store.write with no try — the refs strand if the device
+    scatter raises. This PR fixed the real site (serving/adapters.py);
+    this fixture pins the checker's ability to catch the class."""
+    fs = lint("""
+        class Pager:
+            def ensure(self, entry, rid):
+                flat = self._flatten(entry)
+                pages = []
+                for _ in range(self.store.n_for(flat.size)):
+                    pg = self._alloc()
+                    if pg is None:
+                        for p in pages:
+                            self._pool.decref(p)
+                        return False
+                    pages.append(pg)
+                self.store.write(pages, flat)
+                rec = _PagedAdapter(entry.name, pages, [], 0)
+                self._res[entry.name] = rec
+                return True
+    """, "bigdl_tpu/serving/pagefix.py", "PAGE002")
+    assert len(fs) == 1 and "write" in fs[0].code
+
+
+def test_page_real_adapter_and_engine_paths_are_clean():
+    paths = [os.path.join(REPO, p) for p in (
+        "bigdl_tpu/serving/adapters.py",
+        "bigdl_tpu/serving/engine.py",
+        "bigdl_tpu/serving/radix.py",
+        "bigdl_tpu/kvpaged.py",
+    )]
+    fs = [f for f in lc.lint_paths(paths) if f.rule.startswith("PAGE")]
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# LCK1xx — lock-order cycles + blocking under hot locks
+# ---------------------------------------------------------------------------
+
+def test_lck101_opposite_order_is_a_cycle_with_witnesses():
+    fs = lint("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, "bigdl_tpu/serving/lockfix.py", "LCK101")
+    assert len(fs) >= 1
+    msg = fs[0].message
+    assert "cycle" in msg and "Box._a" in msg and "Box._b" in msg
+    # both witness paths are named in the message
+    assert msg.count("acquires") >= 2
+
+
+def test_lck101_cross_function_cycle_through_the_call_graph():
+    fs = lint("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def outer(self):
+                with self._a:
+                    self.helper()
+
+            def helper(self):
+                with self._b:
+                    pass
+
+            def other(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, "bigdl_tpu/serving/lockfix.py", "LCK101")
+    assert len(fs) >= 1
+    assert "cycle" in fs[0].message
+
+
+def test_lck101_consistent_order_is_clean():
+    fs = lint("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def m2(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """, "bigdl_tpu/serving/lockfix.py", "LCK101")
+    assert fs == []
+
+
+def test_lck101_rlock_reentry_is_allowed_plain_lock_is_not():
+    src = """
+        import threading
+
+        class Reg:
+            def __init__(self):
+                self._lock = threading.{kind}()
+
+            def get(self):
+                with self._lock:
+                    return 1
+
+            def acquire(self):
+                with self._lock:
+                    return self.get()
+    """
+    assert lint(src.format(kind="RLock"),
+                "bigdl_tpu/serving/lockfix.py", "LCK101") == []
+    fs = lint(src.format(kind="Lock"),
+              "bigdl_tpu/serving/lockfix.py", "LCK101")
+    assert len(fs) == 1 and "re-acquisition" in fs[0].message
+
+
+def test_lck102_blocking_call_under_hot_lock_fires():
+    fs = lint("""
+        import threading
+
+        class Eng:
+            def __init__(self):
+                self._stat_lock = threading.Lock()
+
+            def scrape(self):
+                with self._stat_lock:
+                    self.f.flush()
+    """, "bigdl_tpu/serving/lockfix.py", "LCK102")
+    assert len(fs) == 1
+    assert "flush" in fs[0].message and "_stat_lock" in fs[0].message
+
+
+def test_lck102_blocking_after_release_is_clean():
+    fs = lint("""
+        import threading
+
+        class Eng:
+            def __init__(self):
+                self._stat_lock = threading.Lock()
+
+            def scrape(self):
+                with self._stat_lock:
+                    snap = dict(self.stats)
+                self.f.flush()
+                return snap
+    """, "bigdl_tpu/serving/lockfix.py", "LCK102")
+    assert fs == []
+
+
+def test_lck102_transitively_blocking_callee_fires_at_the_lock_frame():
+    fs = lint("""
+        import threading
+
+        class Eng:
+            def __init__(self):
+                self._admission_lock = threading.Lock()
+
+            def _persist(self):
+                self.f.flush()
+
+            def submit(self, req):
+                with self._admission_lock:
+                    self._persist()
+    """, "bigdl_tpu/serving/lockfix.py", "LCK102")
+    assert len(fs) == 1
+    # anchored at submit's call site (the frame holding the lock),
+    # not inside _persist
+    assert "_persist" in fs[0].message
+
+
+def test_lck102_suppression_comment_silences_the_site():
+    fs = lint("""
+        import threading
+
+        class Eng:
+            def __init__(self):
+                self._stat_lock = threading.Lock()
+
+            def scrape(self):
+                with self._stat_lock:
+                    # graftlint: disable=LCK102
+                    self.f.flush()
+    """, "bigdl_tpu/serving/lockfix.py", "LCK102")
+    assert fs == []
+
+
+def test_lck_real_tree_only_the_baselined_submit_journal_remains():
+    """The shipped tree's only LCK finding is the justified
+    record_submit-under-_admission_lock baseline entry (journal order
+    must match queue order; see baseline.json)."""
+    findings = [f for f in lc.lint_paths() if f.rule.startswith("LCK1")]
+    new, old = lc.apply_baseline(
+        findings, lc.load_baseline(lc.DEFAULT_BASELINE))
+    assert new == [], "\n".join(f.format() for f in new)
+    assert len(old) == 1 and "record_submit" in old[0].code
+
+
+# ---------------------------------------------------------------------------
+# DSP0xx — kernel-dispatch consistency (registry <-> tables <-> budgets)
+# ---------------------------------------------------------------------------
+
+def test_dsp001_missing_and_unknown_gemv_entries():
+    # overlay of ops/linear.py: the registry (real quant/qtypes.py) has
+    # many non-dense qtypes; this table covers one and invents one
+    fs = lint("""
+        _QGEMV_QTYPES = {
+            "sym_int4": _entry(64, None),
+            "bogus_q9": _entry(64, None),
+        }
+    """, "bigdl_tpu/ops/linear.py", "DSP001")
+    missing = [f for f in fs if "has no _QGEMV_QTYPES entry" in f.message]
+    unknown = [f for f in fs if "bogus_q9" in f.message]
+    assert any("asym_int4" in f.message for f in missing)
+    assert len(unknown) == 1 and "not registered" in unknown[0].message
+
+
+def test_dsp001_real_linear_table_is_complete():
+    fs = [f for f in lc.lint_paths(
+        [os.path.join(REPO, "bigdl_tpu/ops/linear.py")])
+        if f.rule == "DSP001"]
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
+def test_dsp002_phantom_pallas_import():
+    fs = lint("""
+        from bigdl_tpu.ops.pallas import use_pallas, totally_bogus_kernel
+    """, "bigdl_tpu/ops/foo.py", "DSP002")
+    assert len(fs) == 1 and "totally_bogus_kernel" in fs[0].message
+    assert lint("""
+        from bigdl_tpu.ops.pallas import use_pallas
+    """, "bigdl_tpu/ops/foo.py", "DSP002") == []
+
+
+def test_dsp003_k_multiple_must_respect_block_size():
+    # sym_int4's block_size is 32; a k_multiple of 48 splits blocks
+    fs = lint("""
+        _QGEMV_QTYPES = {
+            "sym_int4": _entry(48, None),
+        }
+    """, "bigdl_tpu/ops/linear.py", "DSP003")
+    assert len(fs) == 1 and "48" in fs[0].message \
+        and "block" in fs[0].message
+    assert lint("""
+        _QGEMV_QTYPES = {
+            "sym_int4": _entry(64, None),
+        }
+    """, "bigdl_tpu/ops/linear.py", "DSP003") == []
+
+
+def test_dsp003_spec_for_must_cover_every_storage_or_default():
+    gap = lint("""
+        def spec_for(spec):
+            if spec.storage == "packed_u8":
+                return 1
+    """, "bigdl_tpu/ops/pallas/qdecode.py", "DSP003")
+    assert any("packed_planes" in f.message for f in gap)
+    assert lint("""
+        def spec_for(spec):
+            if spec.storage == "packed_u8":
+                return 1
+            raise ValueError(spec.storage)
+    """, "bigdl_tpu/ops/pallas/qdecode.py", "DSP003") == []
+
+
+def test_dsp004_restated_budget_literal_in_ops_fires():
+    # 5 MiB == VMEM_BUDGET // 2 (tiling.py) — the exact drift this PR
+    # fixed in linear._fused_kernel
+    fs = lint("""
+        CAP = 5 * 1024 * 1024
+    """, "bigdl_tpu/ops/foo.py", "DSP004")
+    assert len(fs) == 1 and "VMEM_BUDGET // 2" in fs[0].message
+    # an unrelated MiB value is fine, and non-ops files are out of scope
+    assert lint("CAP = 7 * 1024 * 1024\n",
+                "bigdl_tpu/ops/foo.py", "DSP004") == []
+    assert lint("CAP = 5 * 1024 * 1024\n",
+                "bigdl_tpu/quant/foo.py", "DSP004") == []
+
+
+def test_dsp005_lora_cap_must_leave_base_kernel_headroom():
+    fs = lint("""
+        VMEM_BUDGET = 10 * 1024 * 1024
+        LORA_VMEM_CAP = 6 * 1024 * 1024
+    """, "bigdl_tpu/ops/pallas/tiling.py", "DSP005")
+    assert len(fs) == 1 and "LORA_VMEM_CAP" in fs[0].message
+    # anchored at the offending constant's own assignment line
+    assert fs[0].code.startswith("LORA_VMEM_CAP")
+    assert lint("""
+        VMEM_BUDGET = 10 * 1024 * 1024
+        LORA_VMEM_CAP = 4 * 1024 * 1024
+    """, "bigdl_tpu/ops/pallas/tiling.py", "DSP005") == []
+
+
+def test_dsp005_vmem_ceiling():
+    fs = lint("""
+        VMEM_BUDGET = 24 * 1024 * 1024
+    """, "bigdl_tpu/ops/pallas/tiling.py", "DSP005")
+    assert len(fs) == 1 and "16 MiB" in fs[0].message
+
+
+def test_dsp_suppression_comment_works():
+    assert lint("""
+        # graftlint: disable=DSP004
+        CAP = 5 * 1024 * 1024
+    """, "bigdl_tpu/ops/foo.py", "DSP004") == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline hygiene (BASE001 + --update-baseline) and output formats
+# ---------------------------------------------------------------------------
+
+def test_stale_baseline_entry_is_an_error_on_full_scans(tmp_path):
+    bl = tmp_path / "baseline.json"
+    stale = {"rule": "WCT001", "path": "bigdl_tpu/serving/gone.py",
+             "code": "t = time.time()", "justification": "long fixed"}
+    entries = lc.load_baseline(lc.DEFAULT_BASELINE) + [stale]
+    bl.write_text(json.dumps({"findings": entries}))
+    buf = io.StringIO()
+    rc = lc.run(baseline_path=str(bl), out=buf)
+    assert rc == 1
+    assert "BASE001" in buf.getvalue()
+    assert "stale baseline entry" in buf.getvalue()
+    # stale_baseline_entries is the primitive behind it
+    fs = lc.stale_baseline_entries([stale], [])
+    assert len(fs) == 1 and fs[0].rule == "BASE001"
+
+
+def test_update_baseline_drops_stale_and_keeps_justifications(tmp_path):
+    bl = tmp_path / "baseline.json"
+    stale = {"rule": "WCT001", "path": "bigdl_tpu/serving/gone.py",
+             "code": "t = time.time()", "justification": "long fixed"}
+    entries = lc.load_baseline(lc.DEFAULT_BASELINE) + [stale]
+    bl.write_text(json.dumps({"findings": entries}))
+    buf = io.StringIO()
+    rc = lc.run(baseline_path=str(bl), update_baseline=True, out=buf)
+    assert rc == 0
+    assert "1 stale dropped" in buf.getvalue()
+    rewritten = lc.load_baseline(str(bl))
+    assert all(e["path"] != "bigdl_tpu/serving/gone.py" for e in rewritten)
+    kept = [e for e in rewritten if e["rule"] == "LCK102"]
+    assert len(kept) == 1 and "journal order" in kept[0]["justification"]
+
+
+def test_update_baseline_refused_under_filters(tmp_path):
+    buf = io.StringIO()
+    rc = lc.run(rules=["WCT001"], update_baseline=True, out=buf)
+    assert rc == 2 and "full, unfiltered scan" in buf.getvalue()
+
+
+def _violation_dir(tmp_path):
+    d = tmp_path / "bigdl_tpu" / "serving"
+    d.mkdir(parents=True)
+    (d / "vio.py").write_text("import time\nt = time.time()\n")
+    bl = tmp_path / "empty.json"
+    bl.write_text('{"findings": []}')
+    return str(tmp_path / "bigdl_tpu"), str(bl)
+
+
+def test_format_json_is_machine_parseable(tmp_path):
+    target, bl = _violation_dir(tmp_path)
+    buf = io.StringIO()
+    rc = lc.run(paths=[target], baseline_path=bl, fmt="json", out=buf)
+    assert rc == 1
+    doc = json.loads(buf.getvalue())
+    assert doc["baselined"] == 0
+    assert [f["rule"] for f in doc["findings"]] == ["WCT001"]
+    assert doc["findings"][0]["path"].endswith("serving/vio.py")
+    assert doc["findings"][0]["line"] == 2
+
+
+def test_format_github_emits_error_annotations(tmp_path):
+    target, bl = _violation_dir(tmp_path)
+    buf = io.StringIO()
+    rc = lc.run(paths=[target], baseline_path=bl, fmt="github", out=buf)
+    assert rc == 1
+    line = [l for l in buf.getvalue().splitlines()
+            if l.startswith("::error ")][0]
+    assert "file=" in line and ",line=2," in line \
+        and "title=graftlint WCT001" in line
+
+
+def test_format_unknown_is_a_usage_error():
+    buf = io.StringIO()
+    assert lc.run(fmt="yaml", out=buf) == 2
+    assert "unknown format" in buf.getvalue()
+
+
+def test_shipped_baseline_has_no_stale_entries():
+    findings = lc.lint_paths()
+    stale = lc.stale_baseline_entries(
+        lc.load_baseline(lc.DEFAULT_BASELINE), findings)
+    assert stale == [], "\n".join(f.format() for f in stale)
