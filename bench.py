@@ -591,7 +591,7 @@ def child_analytic() -> dict:
     os.environ["BENCH_FORCE_CPU"] = "1"  # never touch the tunnel
     _child_setup()
     from bigdl_tpu.benchmark.roofline import (
-        attention_matrix, collective_matrix, gemm_matrix,
+        attention_matrix, backward_matrix, collective_matrix, gemm_matrix,
     )
     from bigdl_tpu.ops.linear import _QGEMV_QTYPES
 
@@ -604,9 +604,15 @@ def child_analytic() -> dict:
     # bytes + modeled ring time at llama2-7b tp=4, fp32 vs the
     # quantized wire formats (parallel/qcollectives.py)
     rows.update(collective_matrix())
+    # backward twin (ISSUE 20): the fused dx kernel vs the XLA remat
+    # (which writes a bf16 copy of W to HBM per train step) plus the dW
+    # accumulation rows, at qbackward's real tile shapes
+    rows.update(backward_matrix(sorted(_QGEMV_QTYPES), Ms=(1, 32, 512),
+                                K=4096, O=4096))
     ar32 = rows["allreduce_tp4_m1_fp32"]
     ar8 = rows["allreduce_tp4_m1_int8"]
     m512 = rows["sym_int4_m512"]
+    dx512 = rows["dx_sym_int4_m512"]
     return {
         "metric": "fused_gemm_analytic_bytes_ratio_m512",
         "value": m512["bytes_ratio_vs_xla"],
@@ -617,6 +623,9 @@ def child_analytic() -> dict:
         "collective_int8_time_recovered_tp4": round(
             1 - ar8["per_step_s"] / ar32["per_step_s"], 4
         ),
+        # ISSUE 20 acceptance headline: >= 2.5x fewer HBM bytes for the
+        # fused backward dx at M=512, K=O=4096, sym_int4 vs the remat
+        "bwd_dx_bytes_ratio_m512": dx512["bytes_ratio_vs_xla"],
         "analytic": rows,
     }
 

@@ -16,13 +16,17 @@ Rule map (details + examples in docs/static-analysis.md):
 - LCK102   blocking call (fsync/flush/sleep/host transfer) under a hot
            lock (``_stat_lock`` / ``_admission_lock``)
 - DSP001   registered qtype missing from the GEMV dispatch table (or a
-           dispatch key naming an unregistered qtype)
+           dispatch key naming an unregistered qtype); table entry with
+           neither a fused backward kernel nor a stated bwd_exempt
 - DSP002   ``from bigdl_tpu.ops.pallas import X`` where X is not
            exported by the kernel package
-- DSP003   dispatch k_multiple incompatible with the qtype's
-           block/superblock geometry; DecodeSpec storage not covered
+- DSP003   dispatch k_multiple (forward or bwd_k_multiple) incompatible
+           with the qtype's block/superblock geometry; DecodeSpec
+           storage not covered
 - DSP004   VMEM-budget magic number drifted from tiling.py's constants
 - DSP005   tiling.py budget invariants (caps, lane alignment) violated
+- DSP006   attention epilogue decodes K/V tiles inline instead of
+           through the shared qdecode.decode_kv body
 """
 from __future__ import annotations
 
@@ -247,11 +251,100 @@ def _gemv_table(tree: ast.Module) -> Tuple[Optional[int],
     return None, {}
 
 
+#: _GemvEntry field order (positional-arg mapping for the resolvers
+#: below); kept in sync by test_dsp001_field_order_matches_linear.
+_GEMV_FIELDS = ("k_multiple", "run", "gemm", "gemm_exempt",
+                "bwd", "bwd_exempt", "bwd_k_multiple")
+
+
+def _gemv_entries(tree: ast.Module):
+    """(qtype, key lineno, value ast.Call) per _QGEMV_QTYPES entry."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_QGEMV_QTYPES"
+                and isinstance(node.value, ast.Dict)):
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Call)):
+                    yield k.value, k.lineno, v
+
+
+def _entry_factories(tree: ast.Module) -> Dict[str, tuple]:
+    """name -> (param names, {param: default expr}, return-call field
+    exprs) for every module-level helper whose body returns a
+    ``_GemvEntry(...)`` — linear.py's ``_entry`` and any sibling a new
+    format family adds."""
+    out: Dict[str, tuple] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        ret = None
+        for stmt in ast.walk(node):
+            if (isinstance(stmt, ast.Return)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Name)
+                    and stmt.value.func.id == "_GemvEntry"):
+                ret = stmt.value
+        if ret is None:
+            continue
+        a = node.args
+        params = [p.arg for p in a.args]
+        defaults = dict(zip(params[len(params) - len(a.defaults):],
+                            a.defaults))
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            params.append(p.arg)
+            if d is not None:
+                defaults[p.arg] = d
+        fields = dict(zip(_GEMV_FIELDS, ret.args))
+        for kw in ret.keywords:
+            if kw.arg:
+                fields[kw.arg] = kw.value
+        out[node.name] = (params, defaults, fields)
+    return out
+
+
+def _entry_fields(call: ast.Call,
+                  factories: Dict[str, tuple]) -> Optional[Dict[str, object]]:
+    """Resolve one table entry's _GemvEntry field exprs, following one
+    level of factory indirection (``_entry(64, f)`` substitutes the
+    caller's arguments into the factory's ``_GemvEntry(...)`` return).
+    None when the callee cannot be analyzed statically."""
+    fname = call.func.id if isinstance(call.func, ast.Name) else None
+    if fname == "_GemvEntry":
+        fields: Dict[str, object] = dict(zip(_GEMV_FIELDS, call.args))
+        for kw in call.keywords:
+            if kw.arg:
+                fields[kw.arg] = kw.value
+        return fields
+    fac = factories.get(fname or "")
+    if fac is None:
+        return None
+    params, defaults, ret_fields = fac
+    bind: Dict[str, object] = dict(zip(params, call.args))
+    for kw in call.keywords:
+        if kw.arg:
+            bind[kw.arg] = kw.value
+    fields = {}
+    for field, expr in ret_fields.items():
+        if isinstance(expr, ast.Name) and expr.id in params:
+            expr = bind.get(expr.id, defaults.get(expr.id))
+        fields[field] = expr
+    return fields
+
+
+def _expr_is_none(expr: object) -> bool:
+    """Absent (NamedTuple default None) or a literal ``None``."""
+    return expr is None or (isinstance(expr, ast.Constant)
+                            and expr.value is None)
+
+
 class DispatchCoverage(Check):
     rule = "DSP001"
     description = (
         "every non-dense registered qtype needs a _QGEMV_QTYPES entry "
-        "(or the table names a qtype that is not registered)"
+        "(or the table names a qtype that is not registered); every "
+        "entry needs a fused backward kernel or an explicit bwd_exempt"
     )
 
     def run(self, ctx: FileContext) -> Iterable[Finding]:
@@ -284,6 +377,29 @@ class DispatchCoverage(Check):
                     message="_QGEMV_QTYPES entry '%s' names a qtype that "
                             "is not registered in quant/qtypes.py" % name,
                     hint="remove the stale entry or register the qtype",
+                )
+        # the backward column: the import-time assert catches this at
+        # runtime, but only on a path that imports linear.py — the lint
+        # catches it on the diff. A silent bwd=None entry falls back to
+        # XLA-remat dx, which writes a full bf16 dequant of W to HBM
+        # every train step (the backward twin of the forward cliff).
+        factories = _entry_factories(ctx.tree)
+        for name, line, call in _gemv_entries(ctx.tree):
+            fields = _entry_fields(call, factories)
+            if fields is None:
+                continue  # opaque callee: runtime assert still guards
+            if _expr_is_none(fields.get("bwd")) \
+                    and _expr_is_none(fields.get("bwd_exempt")):
+                yield Finding(
+                    rule=self.rule, path=ctx.rel, line=line,
+                    message="'%s' declares neither a fused backward "
+                            "kernel (bwd=) nor a bwd_exempt reason — dx "
+                            "would silently fall back to XLA-remat "
+                            "dequant every train step" % name,
+                    hint="route bwd through ops/pallas/qbackward.py's "
+                         "table-driven dx kernel, or state why the "
+                         "format cannot decode in the transposed access "
+                         "pattern",
                 )
 
 
@@ -348,9 +464,11 @@ def _pallas_exports(project: "flow.Project") -> Set[str]:
 class DispatchGeometry(Check):
     rule = "DSP003"
     description = (
-        "dispatch k_multiple must be divisible by the qtype's block "
-        "(and superblock) size; DecodeSpec storage dispatch must cover "
-        "every registered storage or have an explicit default"
+        "dispatch k_multiple (forward or backward) must be divisible by "
+        "the qtype's block (and superblock) size — and bwd_k_multiple "
+        "may only coarsen the forward alignment; DecodeSpec storage "
+        "dispatch must cover every registered storage or have an "
+        "explicit default"
     )
 
     def run(self, ctx: FileContext) -> Iterable[Finding]:
@@ -395,6 +513,49 @@ class DispatchGeometry(Check):
                     message="'%s' uses packed_planes storage but declares "
                             "no planes tuple" % name,
                     hint="declare the per-plane bit widths in QTypeSpec",
+                )
+        # backward tile geometry: a declared bwd_k_multiple must satisfy
+        # the same block/superblock divisibility as the forward's, and
+        # may only COARSEN it (the dx kernel's chunk walk has the same
+        # plane-split period as the forward's — a finer backward
+        # alignment would admit shapes the decode loop cannot tile)
+        factories = _entry_factories(ctx.tree)
+        for name, line, call in _gemv_entries(ctx.tree):
+            fields = _entry_fields(call, factories)
+            if fields is None:
+                continue
+            expr = fields.get("bwd_k_multiple")
+            if _expr_is_none(expr):
+                continue  # inherits k_multiple, already checked above
+            try:
+                bkm = int(flow.eval_const(expr))
+            except (ValueError, TypeError):
+                continue
+            spec = specs.get(name)
+            if spec is None or bkm <= 0:
+                continue
+            for field in ("block_size", "superblock"):
+                unit = spec.get(field)
+                if isinstance(unit, int) and unit > 0 and bkm % unit != 0:
+                    yield Finding(
+                        rule=self.rule, path=ctx.rel, line=line,
+                        message="'%s' bwd_k_multiple %d is not a multiple "
+                                "of its %s %d — the dx kernel's K walk "
+                                "would straddle quant groups"
+                                % (name, bkm, field, unit),
+                        hint="backward alignment must keep whole quant "
+                             "blocks per decoded chunk",
+                    )
+            fwd = table.get(name, (-1, 0))[0]
+            if fwd > 0 and bkm % fwd != 0:
+                yield Finding(
+                    rule=self.rule, path=ctx.rel, line=line,
+                    message="'%s' bwd_k_multiple %d is not a multiple of "
+                            "its forward k_multiple %d — it may only "
+                            "coarsen the contraction alignment, never "
+                            "refine it" % (name, bkm, fwd),
+                    hint="use a multiple of k_multiple (or None to "
+                         "inherit it)",
                 )
 
     def _check_storage_coverage(self, ctx: FileContext) -> Iterable[Finding]:
@@ -542,6 +703,17 @@ class TilingBudgetInvariants(Check):
          lambda e: e["VMEM_BUDGET"] <= 16 * 1024 * 1024,
          "VMEM_BUDGET exceeds the 16 MiB per-core scoped-vmem ceiling",
          "the budget must leave room for Mosaic's own scratch"),
+        (("_DX_SLAB_BYTES", "VMEM_BUDGET"),
+         lambda e: e["_DX_SLAB_BYTES"] < e["VMEM_BUDGET"],
+         "_DX_SLAB_BYTES does not fit inside VMEM_BUDGET — the dx "
+         "accumulator slab would leave no room for the chunk loop",
+         "shrink the backward accumulator slab or raise the budget"),
+        (("DX_ACC_BPE",),
+         lambda e: e["DX_ACC_BPE"] >= 6,
+         "DX_ACC_BPE under-prices the dx row tile (f32 accumulator + "
+         "bf16 output block is 6 B/element minimum)",
+         "keep DX_ACC_BPE >= 6 so pick_block_m_dx cannot overcommit "
+         "VMEM"),
     )
 
     def run(self, ctx: FileContext) -> Iterable[Finding]:
@@ -568,9 +740,76 @@ def _const_lines(tree: ast.Module):
             yield stmt.targets[0].id, stmt.lineno
 
 
+#: the attention kernel files whose K/V loads must decode through the
+#: one shared body in qdecode.decode_kv (the fp8-KV epilogues)
+_ATTN_EPILOGUE_RELS = (
+    "bigdl_tpu/ops/pallas/flash_attention.py",
+    "bigdl_tpu/ops/pallas/paged_attention.py",
+    "bigdl_tpu/ops/pallas/flash_backward.py",
+)
+
+
+class AttentionDecoderUnification(Check):
+    rule = "DSP006"
+    description = (
+        "attention epilogues must decode K/V tiles through "
+        "qdecode.decode_kv — an inlined astype/bit-decode is the "
+        "three-copies-of-the-decoder drift this family exists to stop"
+    )
+
+    def run(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.rel not in _ATTN_EPILOGUE_RELS:
+            return
+        uses_decode_kv = False
+        touches_kv = False
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name) and node.id in ("k_ref", "v_ref"):
+                touches_kv = True
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            callee = (f.attr if isinstance(f, ast.Attribute)
+                      else f.id if isinstance(f, ast.Name) else None)
+            if callee == "decode_kv":
+                uses_decode_kv = True
+            elif callee == "decode_values":
+                yield Finding(
+                    rule=self.rule, path=ctx.rel, line=node.lineno,
+                    message="decode_values called directly — the bit "
+                            "decoder's body belongs to qdecode; the "
+                            "attention epilogues call the decode_kv "
+                            "wrapper so fp8-KV and the GEMM weights "
+                            "cannot drift apart",
+                    hint="use qdecode.decode_kv",
+                )
+            elif (callee == "astype"
+                    and isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Subscript)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id in ("k_ref", "v_ref")):
+                yield Finding(
+                    rule=self.rule, path=ctx.rel, line=node.lineno,
+                    message="K/V tile decoded inline (%s[...].astype) — "
+                            "this is the duplicated-decoder pattern "
+                            "decode_kv replaced" % f.value.value.id,
+                    hint="load through qdecode.decode_kv (scale=None "
+                         "for the bf16 passthrough arm)",
+                )
+        if touches_kv and not uses_decode_kv:
+            yield Finding(
+                rule=self.rule, path=ctx.rel, line=1,
+                message="file reads k_ref/v_ref but never calls "
+                        "qdecode.decode_kv — the shared-decoder "
+                        "unification has regressed",
+                hint="route every K/V tile load through "
+                     "qdecode.decode_kv",
+            )
+
+
 INTERPROC_CHECKS = (
     PageLeakOnExit, PageLeakOnRaise,
     LockOrderCycle, BlockingUnderHotLock,
     DispatchCoverage, KernelExportConsistency, DispatchGeometry,
     VmemLiteralDrift, TilingBudgetInvariants,
+    AttentionDecoderUnification,
 )

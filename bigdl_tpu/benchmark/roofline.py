@@ -24,8 +24,9 @@ bench.py's jax-free parent evaluates it in a CPU-pinned child.
 from __future__ import annotations
 
 from bigdl_tpu.ops.pallas.tiling import (
-    finest_split, flash_blocks, flash_live_blocks, pick_block_m,
-    pick_block_o, round_up,
+    DX_ACC_BPE, chunk_target_dx, finest_split, flash_blocks,
+    flash_live_blocks, pick_block_m, pick_block_m_dx, pick_block_o,
+    pick_block_o_dw, round_up,
 )
 from bigdl_tpu.quant.qtypes import resolve_qtype
 
@@ -94,6 +95,108 @@ def qmatmul_cost(qtype: str, M: int, K: int, O: int) -> dict:
         # means the fused kernel moves fewer HBM bytes for the same math
         "bytes_ratio_vs_xla": round(xla_bytes / fused_bytes, 2),
     }
+
+
+def _storage_planes(spec) -> tuple:
+    """The packed-plane tuple of a qtype's storage — the jax-free twin
+    of ops/pallas/qdecode.spec_for's planes field (this module must not
+    import jax; the mapping is 3 lines and covered by the DSP003
+    storage-coverage check on the real spec_for)."""
+    if spec.storage == "packed_u8":
+        return (4,)
+    if spec.storage == "packed_planes":
+        return tuple(spec.planes)
+    return ()
+
+
+def bwd_dx_cost(qtype: str, M: int, K: int, O: int) -> dict:
+    """Analytic cost of the fused backward dx[M,K] = g[M,O] @ dq(W) at
+    qbackward's REAL tiles (tiling.pick_block_m_dx / chunk_target_dx —
+    the same policy the kernel resolves, so model and implementation
+    cannot drift).
+
+    Fetch pattern (qbackward._dxmm): grid (m, o) with o innermost as the
+    reduction sweep — the [block_m, K] f32 accumulator stays in VMEM
+    scratch across a full weight sweep, so packed weights cross HBM once
+    per M tile, g and dx exactly once, and the dequantized bf16 copy of
+    W never exists in HBM. The XLA remat path it replaces writes that
+    copy and reads it back (2*K*O*2) every train step."""
+    spec = resolve_qtype(qtype)
+    row_bytes = weight_bytes_per_row(qtype, K)
+    w_total = O * row_bytes
+
+    block_m = pick_block_m_dx(M, K)
+    mp = round_up(max(M, 1), block_m)
+    block_o = pick_block_o(O, row_bytes, cap=256)
+    grid_m = mp // block_m
+    persist = (block_m * K * DX_ACC_BPE + block_o * row_bytes
+               + block_m * block_o * _X_BPE)
+    ck = chunk_target_dx(block_o, block_m, persist,
+                         finest_split(K, _storage_planes(spec)),
+                         temp_bpe=20 if spec.asymmetric else 14)
+
+    fused_bytes = w_total * grid_m + mp * O * _X_BPE + mp * K * _OUT_BPE
+    xla_bytes = (w_total + 2 * K * O * 2 + M * O * _X_BPE
+                 + M * K * _OUT_BPE)
+    flops = 2 * M * K * O
+    return {
+        "kernel": "bwd_dx", "qtype": qtype,
+        "shape": f"m{M}xk{K}xo{O}",
+        "block_m": block_m, "block_o": block_o,
+        "chunk": ck, "grid_m": grid_m,
+        "fused_bytes": fused_bytes,
+        "xla_remat_bytes": xla_bytes,
+        "flops": flops,
+        "fused_intensity": round(flops / fused_bytes, 2),
+        "bytes_ratio_vs_xla": round(xla_bytes / fused_bytes, 2),
+    }
+
+
+def bwd_dw_cost(M: int, K: int, O: int) -> dict:
+    """Analytic cost of the fused dW[O,K] = g^T @ x tiled accumulation
+    (qbackward._dwmm) at its real tiles: grid (o, m) with m innermost,
+    a [block_o, K] f32 accumulator per O tile. No dequant is involved —
+    x is re-fetched once per O tile (the reduction-bound shape of any
+    real tiled g^T @ x), so the honest ratio vs an ideal single-pass
+    einsum sits near or below 1. The row exists for train-step pricing
+    (sim/cost.train_step_s) and the unfrozen/bf16-shadow hook, not as a
+    bytes win."""
+    block_m = pick_block_m(M, max(K, O))
+    mp = round_up(max(M, 1), block_m)
+    block_o = pick_block_o_dw(O, K)
+    op = round_up(O, block_o)
+    grid_o = op // block_o
+    fused_bytes = mp * op * _X_BPE + grid_o * mp * K * _X_BPE + op * K * _OUT_BPE
+    xla_bytes = M * O * _X_BPE + M * K * _X_BPE + O * K * _OUT_BPE
+    flops = 2 * M * K * O
+    return {
+        "kernel": "bwd_dw", "shape": f"m{M}xk{K}xo{O}",
+        "block_m": block_m, "block_o": block_o, "grid_o": grid_o,
+        "fused_bytes": fused_bytes,
+        "xla_bytes": xla_bytes,
+        "flops": flops,
+        "fused_intensity": round(flops / fused_bytes, 2),
+        "bytes_ratio_vs_xla": round(xla_bytes / fused_bytes, 2),
+    }
+
+
+def backward_matrix(qtypes, Ms=(1, 32, 512, 2048), K: int = 4096,
+                    O: int = 4096) -> dict:
+    """bench.py's analytic backward sweep: the fused dx kernel for every
+    fused format at train-step row counts, plus the qtype-independent
+    dW accumulation rows. Pure host math — the headline acceptance
+    number (dx bytes ratio at M=512, sym_int4) lands with the tunnel
+    down."""
+    out = {}
+    for qt in qtypes:
+        spec = resolve_qtype(qt)
+        if K % (spec.superblock or spec.block_size):
+            continue
+        for m in Ms:
+            out[f"dx_{qt}_m{m}"] = bwd_dx_cost(qt, m, K, O)
+    for m in Ms:
+        out[f"dw_m{m}"] = bwd_dw_cost(m, K, O)
+    return out
 
 
 def lora_epilogue_cost(M: int, K: int, O: int, R: int,

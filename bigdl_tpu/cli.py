@@ -441,6 +441,14 @@ def cmd_train_status(args):
         print(f"legacy single-file checkpoint present: {legacy}")
     events = sorted(_glob.glob(os.path.join(d, "supervisor_events*.jsonl")))
     for ev_path in events:
+        # run provenance: the newest `backward` event says which dx path
+        # the step function was traced with (fused Pallas vs XLA remat) —
+        # scan deeper than the display tail so an old flip isn't missed
+        bwd = [e for e in EventLog.tail(ev_path, n=10000)
+               if e.get("kind") == "backward"]
+        if bwd:
+            print(f"backward path: {bwd[-1].get('path')} "
+                  f"(recorded at step {bwd[-1].get('step')})")
         tail = EventLog.tail(ev_path, n=args.events)
         print(f"\n{os.path.basename(ev_path)} (last {len(tail)} events):")
         for e in tail:

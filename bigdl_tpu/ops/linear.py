@@ -13,15 +13,21 @@ shapes (odd O/K, exempt formats) take the in-graph XLA dequant that XLA
 fuses into the matmul.
 
 The fused paths are wrapped in a custom_vjp so training (QLoRA's frozen
-low-bit base) can differentiate through them: dx = g @ dequant(W) runs
-on the XLA dequant path in the backward (XLA rematerializes the
-dequant — exactly the pre-fused behavior), while the forward keeps the
-fused kernel. A fused low-bit backward is the ROADMAP follow-up
-("Training Transformers with 4-bit Integers", arxiv 2306.11987).
+low-bit base) can differentiate through them. The backward is fused
+too: dx = g @ dequant(W) routes to the Pallas dx kernel
+(ops/pallas/qbackward.py), which dequantizes weight tiles per-chunk in
+VMEM straight into the MXU — the bf16 rematerialized copy of W the XLA
+remat path writes to HBM every train step never exists ("Training
+Transformers with 4-bit Integers", arxiv 2306.11987). The registry's
+`bwd` column drives it through the same shared decoder as the forward,
+with an import-time assert that no qtype silently falls back; the XLA
+remat stays available under `fused_backward_scope(False)` as the parity
+oracle.
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Callable, NamedTuple, Optional, Union
 
@@ -123,6 +129,14 @@ def _run_q2k(x, w, bo):
                        w.sub_mins, out_dtype=x.dtype, block_o=bo)
 
 
+def _run_dx(g, w, bo):
+    # shared fused backward: dx = g @ dequant(W), table-driven through
+    # qdecode.spec_for — one kernel body serves every registered format
+    from bigdl_tpu.ops.pallas import qmatmul_dx
+
+    return qmatmul_dx(g, w, out_dtype=g.dtype, block_o=bo)
+
+
 def _run_q6k(x, w, bo):
     # planar q3_k is structurally identical to q6_k (int8 centered
     # codes, int8 sub-scales per 16, f16 d per 256) and shares its kernel
@@ -148,18 +162,32 @@ class _GemvEntry(NamedTuple):
     shared decoder in ops/pallas/qdecode.py). A format without a fused
     GEMM path MUST say why in `gemm_exempt` — the dispatch-coverage test
     fails any entry that silently leaves prefill shapes on the XLA
-    dequant path."""
+    dequant path.
+
+    `bwd` is the fused backward dx kernel (ops/pallas/qbackward.py,
+    same table-driven decoder); a format without one MUST say why in
+    `bwd_exempt` — a silent XLA-remat fallback rewrites a full bf16
+    dequant of W to HBM every train step, the backward twin of the
+    forward cliff. `bwd_k_multiple` optionally coarsens the contraction
+    alignment the backward needs (None inherits k_multiple; the dx
+    kernel's chunk walk has the same plane-split period as the
+    forward's, so every current format inherits)."""
     k_multiple: int
     run: Callable  # (x [M, K] compute dtype, w, block_o) -> y [M, O]
     gemm: Optional[Callable] = None  # rows > _GEMV_MAX_ROWS kernel
     gemm_exempt: Optional[str] = None  # stated reason when gemm is None
+    bwd: Optional[Callable] = None  # (g [M, O], w, block_o) -> dx [M, K]
+    bwd_exempt: Optional[str] = None  # stated reason when bwd is None
+    bwd_k_multiple: Optional[int] = None  # None = inherit k_multiple
 
 
 def _entry(k_multiple: int, run: Callable) -> _GemvEntry:
     # every current format's kernel is M-tiled, so the same callable
-    # serves both shape classes; a future format that can only GEMV must
-    # pass an explicit gemm_exempt reason instead
-    return _GemvEntry(k_multiple, run, gemm=run)
+    # serves both shape classes, and the table-driven dx kernel serves
+    # every format's backward; a future format that can only GEMV (or
+    # cannot decode in the transposed access pattern) must pass an
+    # explicit gemm_exempt / bwd_exempt reason instead
+    return _GemvEntry(k_multiple, run, gemm=run, bwd=_run_dx)
 
 
 # every qtype with a decode path dispatches to a fused Pallas kernel —
@@ -188,6 +216,12 @@ for _name, _e in _QGEMV_QTYPES.items():
         f"{_name}: declare a fused GEMM kernel or an explicit gemm_exempt "
         "reason (silent XLA-dequant fallback above _GEMV_MAX_ROWS is the "
         "2.7x cliff class this registry exists to prevent)"
+    )
+    assert _e.bwd is not None or _e.bwd_exempt, (
+        f"{_name}: declare a fused backward kernel or an explicit "
+        "bwd_exempt reason — a silent XLA-remat dx writes a full bf16 "
+        "dequant of W to HBM every train step, the backward twin of the "
+        "forward cliff"
     )
 
 
@@ -232,6 +266,50 @@ def _use_qgemm(x: jax.Array, w: QTensor) -> bool:
             and _fused_kernel(x, w) is not None)
 
 
+# Backward-path selector, read at TRACE time inside the custom_vjp bwd
+# rules: True routes dx through the fused Pallas kernel whenever the
+# entry has one, False keeps the XLA rematerialized dequant (the parity
+# oracle, and the pre-PR behavior). Trace-time means the flag is baked
+# into the jaxpr — flipping it under an already-jitted train step does
+# nothing until retrace, which is exactly the semantics a per-run knob
+# (train/qlora.make_train_step(fused_backward=...)) needs.
+_FUSED_BACKWARD = True
+
+
+def fused_backward_enabled() -> bool:
+    """Whether custom_vjp backward rules traced now use the fused dx."""
+    return _FUSED_BACKWARD
+
+
+@contextlib.contextmanager
+def fused_backward_scope(enabled: bool = True):
+    """Scope the backward-path selector around a trace (the train-step
+    builder wraps its value_and_grad in this)."""
+    global _FUSED_BACKWARD
+    prev = _FUSED_BACKWARD
+    _FUSED_BACKWARD = bool(enabled)
+    try:
+        yield
+    finally:
+        _FUSED_BACKWARD = prev
+
+
+def _fused_dx(g: jax.Array, w: QTensor, qtype: str, block_o: int):
+    """dx = g @ dequant(W) for the custom_vjp bwd rules: the fused
+    Pallas kernel when the registry + selector allow it, else the XLA
+    rematerialized dequant. Forward eligibility (O % 128, weight-tile
+    VMEM fit, K % k_multiple, use_pallas) already held — the vjp only
+    wraps fused forwards — so the only fresh check is the backward's own
+    alignment column."""
+    entry = _QGEMV_QTYPES[qtype]
+    km = entry.bwd_k_multiple or entry.k_multiple
+    if (_FUSED_BACKWARD and entry.bwd is not None
+            and w.shape[-1] % km == 0):
+        return entry.bwd(g, w, block_o)
+    wd = w.dequantize(g.dtype)
+    return jnp.einsum("...o,ok->...k", g, wd, preferred_element_type=g.dtype)
+
+
 def _zero_cotangent(w: QTensor) -> QTensor:
     """Symbolic-zero cotangent for the frozen quantized weight: float
     leaves get typed zeros, integer code/sub-scale leaves get float0
@@ -256,14 +334,10 @@ def _fused_fwd(x, w, qtype, block_o):
 
 
 def _fused_bwd(qtype, block_o, w, g):
-    # dx = g @ dequant(W): the backward stays on the in-graph dequant
-    # path (XLA rematerializes the dequant as a constant of the VJP —
-    # exactly what autodiff of the fallback einsum produced before the
-    # forward was fused); W itself is frozen, so its cotangent is a
-    # symbolic zero
-    wd = w.dequantize(g.dtype)
-    dx = jnp.einsum("...o,ok->...k", g, wd, preferred_element_type=g.dtype)
-    return dx, _zero_cotangent(w)
+    # dx = g @ dequant(W) through the fused Pallas kernel (or the XLA
+    # remat oracle under fused_backward_scope(False)); W itself is
+    # frozen, so its cotangent is a symbolic zero
+    return _fused_dx(g, w, qtype, block_o), _zero_cotangent(w)
 
 
 _fused_matmul.defvjp(_fused_fwd, _fused_bwd)
@@ -322,10 +396,10 @@ def _fused_lora_fwd(x, w, a_cat, b_cat, gate, qtype, block_o):
 
 
 def _fused_lora_bwd(qtype, block_o, res, g):
-    # the backward stays on the XLA path like _fused_bwd, with the
-    # epilogue's product-rule terms spelled out so QLoRA training can
-    # differentiate a lora-fused forward: for v = (x @ A^T) * gate,
-    # y = x @ dq(W)^T + v @ B^T
+    # the base-weight dx term routes through the fused kernel exactly
+    # like _fused_bwd; the epilogue's product-rule terms stay on XLA
+    # (rank-R operands are far below 128-lane tile economics). For
+    # v = (x @ A^T) * gate, y = x @ dq(W)^T + v @ B^T
     x, w, a, b, gt = res
     cd = g.dtype
     K = x.shape[-1]
@@ -333,11 +407,11 @@ def _fused_lora_bwd(qtype, block_o, res, g):
     xf = x.reshape(-1, K).astype(cd)
     gf = g.reshape(-1, O)
     ac, bc, gtc = a.astype(cd), b.astype(cd), gt.astype(cd)
-    wd = w.dequantize(cd)
     u = xf @ ac.T  # [M, R]
     dv = gf @ bc  # [M, R]
     du = dv * gtc
-    dx = (gf @ wd + du @ ac).reshape(x.shape).astype(x.dtype)
+    dxw = _fused_dx(gf, w, qtype, block_o).astype(cd)
+    dx = (dxw + du @ ac).reshape(x.shape).astype(x.dtype)
     da = (du.T @ xf).astype(a.dtype)
     db = (gf.T @ (u * gtc)).astype(b.dtype)
     dgate = (dv * u).astype(gt.dtype)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bigdl_tpu.ops.pallas import qdecode
 from bigdl_tpu.ops.pallas.tiling import (
     FLASH_BLOCK_K, FLASH_BLOCK_Q, MOSAIC_LANES, flash_blocks,
 )
@@ -59,6 +60,7 @@ def _kernel(
     window: Optional[int],
     softcap: Optional[float],
     quantized: bool,
+    kv_value: tuple,
 ):
     if quantized:  # fp8 KV: per-(slot, head) f32 scales ride alongside
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
@@ -88,9 +90,12 @@ def _kernel(
     @pl.when(live)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
-        if quantized:
-            k = k * ks_ref[0, 0]  # [BK, 1] broadcasts over D
+        # shared KV decode body (fp8 codes cross as uint8 bits and go
+        # through the same qdecode bit decoder as fp8 GEMM weights);
+        # the [BK, 1] scale broadcasts over D
+        k = qdecode.decode_kv(
+            k_ref[0, 0], ks_ref[0, 0] if quantized else None, kv_value
+        )  # [BK, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK]
@@ -119,9 +124,9 @@ def _kernel(
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # [BQ, BK]
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
-        v = v_ref[0, 0].astype(jnp.float32)  # [BK, D]
-        if quantized:
-            v = v * vs_ref[0, 0]
+        v = qdecode.decode_kv(
+            v_ref[0, 0], vs_ref[0, 0] if quantized else None, kv_value
+        )  # [BK, D]
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -152,12 +157,20 @@ def _flash(
     group = Hq // Hkv
     n_q, n_k = T // block_q, S // block_k
     quantized = k_scale is not None
+    kv_value = ("e4m3",) if k.dtype == jnp.float8_e4m3fn else ("e5m2",)
+    if quantized:
+        # fp8 codes cross the pallas_call boundary as uint8 bit patterns
+        # (the qmatmul fp8-weight move): in-kernel they decode through
+        # the shared qdecode body, exactly the GEMM formats' decoder
+        k = jax.lax.bitcast_convert_type(k, jnp.uint8)
+        v = jax.lax.bitcast_convert_type(v, jnp.uint8)
 
     grid = (B, Hq, n_q, n_k)
     kernel = functools.partial(
         _kernel,
         scale=scale, block_q=block_q, block_k=block_k,
         causal=causal, window=window, softcap=softcap, quantized=quantized,
+        kv_value=kv_value,
     )
     kv_spec = pl.BlockSpec(
         (1, 1, block_k, D), lambda b, h, i, j: (b, h // group, j, 0),

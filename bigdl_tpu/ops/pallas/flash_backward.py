@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bigdl_tpu.ops.pallas import qdecode
 from bigdl_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
 from bigdl_tpu.ops.pallas.tiling import MOSAIC_LANES
 from bigdl_tpu.utils import round_up
@@ -96,7 +97,7 @@ def _fwd_kernel(
     @pl.when(_block_live(qoff, i, j, block_q, block_k, causal, window))
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)
+        k = qdecode.decode_kv(k_ref[0, 0])
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -111,7 +112,7 @@ def _fwd_kernel(
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = qdecode.decode_kv(v_ref[0, 0])
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -148,7 +149,7 @@ def _dq_kernel(
     @pl.when(_block_live(qoff, i, j, block_q, block_k, causal, window))
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        k = qdecode.decode_kv(k_ref[0, 0])
         s = jax.lax.dot_general(
             q * scale, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -159,7 +160,7 @@ def _dq_kernel(
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)  # [BQ, BK]
 
         do = do_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = qdecode.decode_kv(v_ref[0, 0])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -195,7 +196,7 @@ def _dkv_kernel(
     @pl.when(_block_live(qoff, i, j, block_q, block_k, causal, window))
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        k = qdecode.decode_kv(k_ref[0, 0])
         s = jax.lax.dot_general(
             q * scale, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -209,7 +210,7 @@ def _dkv_kernel(
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = qdecode.decode_kv(v_ref[0, 0])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )

@@ -33,16 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Deliberately the BARE newer CompilerParams spelling, NOT the
-# _compat.py alias (which would make this kernel importable on the
-# pinned jax 0.4.37): re-enabling it re-runs 3 interpret-mode paged
-# tests worth ~20 s inside a tier-1 window that already hits its 870 s
-# timeout mid-suite (every second displaces passing tests at the tail),
-# and the engine-level token-parity test additionally shows argmax-level
-# divergence vs the XLA gather path that needs its own triage. Flip to
-# `from bigdl_tpu.ops.pallas._compat import CompilerParams` once either
-# the budget or the divergence is resolved (flash_backward.py shows the
-# pattern).
+from bigdl_tpu.ops.pallas import qdecode
+from bigdl_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
 
 _NEG_INF = -1e30
 
@@ -65,11 +57,16 @@ def _kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, *refs,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     q = q_ref[0].reshape(n_kv, group, -1).astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)  # [page, Hkv, D]
-    v = v_ref[0, 0].astype(jnp.float32)
-    if quantized:
-        k = k * ks_ref[0, 0][..., None]
-        v = v * vs_ref[0, 0][..., None]
+    # shared KV decode body (qdecode.decode_kv): pages stay TYPED fp8
+    # here — bitcasting the [L, n_pages, ...] pool per decode step would
+    # copy it in HBM — so decode_kv takes its typed-fp8 arm, exact and
+    # bit-identical to the uint8 bit-decode arm the flash wrapper uses
+    k = qdecode.decode_kv(
+        k_ref[0, 0], ks_ref[0, 0][..., None] if quantized else None
+    )  # [page, Hkv, D]
+    v = qdecode.decode_kv(
+        v_ref[0, 0], vs_ref[0, 0][..., None] if quantized else None
+    )
 
     # scores [Hkv, G, page], both dots batched over the kv-head axis
     s = jax.lax.dot_general(
@@ -184,7 +181,7 @@ def paged_decode_attention(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), out_dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

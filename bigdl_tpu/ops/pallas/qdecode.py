@@ -271,6 +271,39 @@ def load_side(spec: DecodeSpec, refs):
     return (f16_bits_to_f32(s[:]),)
 
 
+def decode_kv(codes, scale=None, value: tuple = ("e5m2",)):
+    """The ONE attention-epilogue KV decode body, shared by
+    flash_attention / paged_attention / flash_backward (the in-kernel
+    fp8 dequant used to be duplicated in each kernel; graftlint's
+    dispatch-consistency family guards against it reappearing).
+
+    `codes` is a loaded KV tile in any layout:
+
+    * uint8 — fp8 bit patterns (the flash wrapper bitcasts the fp8 cache
+      before pallas_call, the same move qmatmul makes for fp8 weight
+      storage): decoded through `decode_values`/`fp8_bits_to_f32`, the
+      SAME bit decoder the fused GEMM/GEMV/backward kernels use for fp8
+      weights, so attention and GEMM formats cannot drift;
+    * typed fp8 — decoded by dtype conversion (paged attention keeps the
+      pool typed: bitcasting [L, n_pages, ...] per decode step would
+      copy the whole pool in HBM). Both arms are EXACT on every finite
+      fp8 pattern, so they are bit-identical by construction (asserted
+      by tests/test_qbackward.py's unification parity test);
+    * anything else (bf16 cache) — f32 passthrough, `scale` normally
+      None.
+
+    `scale` broadcasts against the decoded tile (trailing singleton
+    conventions are the caller's); None skips the multiply entirely, so
+    unquantized paths pay nothing."""
+    if codes.dtype == jnp.uint8:
+        vals = decode_values(codes.astype(jnp.int32), value)
+    else:
+        vals = codes.astype(jnp.float32)
+    if scale is None:
+        return vals
+    return vals * scale
+
+
 def decode_chunk(spec: DecodeSpec, K: int, w, side, e0: int, c: int):
     """bf16 weight chunk [bo, c] for logical elements [e0, e0+c) of an
     O-tile: codes from the weight tile, values per the decode tag,

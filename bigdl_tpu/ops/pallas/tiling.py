@@ -84,6 +84,76 @@ def pick_block_m(M: int, K: int, x_bpe: int = 2) -> int:
 
 
 # ---------------------------------------------------------------------------
+# backward tile policy — shared by ops/pallas/qbackward.py (the fused
+# low-bit dx/dW kernels) and benchmark/roofline.py's analytic backward
+# costs. The dx kernel's transposed access pattern (contract over the
+# weight's O rows, accumulate a full-K output row tile across the o
+# sweep) keeps a [block_m, K] f32 accumulator PLUS the bf16 output
+# block resident per grid cell, so its row-tile slab is priced at
+# DX_ACC_BPE, not the forward's 2 B/element x slab.
+# ---------------------------------------------------------------------------
+
+#: resident bytes per dx element per grid cell: the f32 accumulator the
+#: o sweep updates (4) + the bf16 output block written on the last step
+#: (2). The forward's bf16 x slab has no cross-step accumulator.
+DX_ACC_BPE = 6
+
+#: dx accumulator-slab allowance: larger than the forward's x slab
+#: (the acc IS the kernel's working set — weight tiles and dequant
+#: temporaries are the small residents here), but strictly inside
+#: VMEM_BUDGET so the chunk loop always has headroom (DSP005 audits
+#: this invariant).
+_DX_SLAB_BYTES = 6 * 1024 * 1024 + 512 * 1024
+
+
+def pick_block_m_dx(M: int, K: int) -> int:
+    """Row tile of the fused dx kernel's (m, o) grid.
+
+    Same shape rules as `pick_block_m` (8-sublane multiples, prefer the
+    whole padded extent for decode-class M, else the largest power of
+    two) but sized against the [block_m, K] f32-accumulator + bf16-out
+    slab at DX_ACC_BPE. Bigger tiles matter MORE here than in the
+    forward: packed weights are re-fetched once per M tile, and the
+    backward's weight sweep is the traffic the fusion exists to kill."""
+    mp8 = round_up(max(M, 1), 8)
+    if mp8 <= 256 and mp8 * K * DX_ACC_BPE <= _DX_SLAB_BYTES:
+        return mp8
+    for bm in (256, 128, 64, 32, 16):
+        if bm < mp8 and bm * K * DX_ACC_BPE <= _DX_SLAB_BYTES:
+            return bm
+    return 8
+
+
+def chunk_target_dx(block_o: int, block_m: int, persist_bytes: int,
+                    kh: int, temp_bpe: int = 14) -> int:
+    """`chunk_target` for the dx kernel: the per-chunk temporaries gain
+    the [block_m, ck] f32 partial-product tile (the dot's result before
+    it folds into the accumulator) on top of the dequant intermediates,
+    so the chunk budget must charge both."""
+    for ck in (2048, 1024, 512, 256, 128):
+        if ck > kh:
+            continue
+        temp = (block_o * ck * temp_bpe + (ck // 16) * ck * 4
+                + block_m * ck * 4)
+        if persist_bytes + temp <= VMEM_BUDGET:
+            return ck
+    return 128
+
+
+def pick_block_o_dw(O: int, K: int) -> int:
+    """Output-row tile of the fused dW kernel's (o, m) grid: dW[O, K] =
+    g^T @ x accumulates a [block_o, K] f32 tile across the m sweep —
+    the same accumulator-slab shape as dx with O in the row seat."""
+    op8 = round_up(max(O, 1), 8)
+    if op8 <= 256 and op8 * K * DX_ACC_BPE <= _DX_SLAB_BYTES:
+        return op8
+    for bo in (256, 128, 64, 32, 16):
+        if bo < op8 and bo * K * DX_ACC_BPE <= _DX_SLAB_BYTES:
+            return bo
+    return 8
+
+
+# ---------------------------------------------------------------------------
 # LoRA epilogue policy — shared by ops/pallas/qmatmul.py (the fused
 # epilogue's operand blocks) and benchmark/roofline.py / sim/cost.py's
 # analytic LoRA cost, extending the "never disagree" contract to the

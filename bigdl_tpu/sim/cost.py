@@ -23,8 +23,8 @@ import dataclasses
 from typing import Optional
 
 from bigdl_tpu.benchmark.roofline import (
-    all_reduce_cost, decode_attention_cost, flash_prefill_cost,
-    lora_epilogue_cost, qmatmul_cost,
+    all_reduce_cost, bwd_dw_cost, bwd_dx_cost, decode_attention_cost,
+    flash_prefill_cost, lora_epilogue_cost, qmatmul_cost,
 )
 from bigdl_tpu.models.config import ModelConfig
 from bigdl_tpu.quant.qtypes import resolve_qtype
@@ -73,6 +73,13 @@ class CostModel:
     #: False reproduces the pre-fusion path for before/after comparisons
     #: (docs/benchmarking.md §3 banks the seed-0 pair)
     fused_lora: bool = True
+    #: whether the train-step backward is priced at the fused Pallas dx
+    #: kernel (ops/pallas/qbackward.py: packed weights re-decoded
+    #: per-chunk in VMEM) or the XLA remat path (a full bf16 dequant of
+    #: W written to + read back from HBM per projection per step) —
+    #: train/qlora.make_train_step's fused_backward knob, priced here so
+    #: the supervisor path is sim-gateable like serving
+    fused_backward: bool = True
 
     # -- pieces --------------------------------------------------------------
 
@@ -304,6 +311,68 @@ class CostModel:
             chunk *= 2
         return chunk
 
+    def train_step_s(self, tokens: int, adapter_rank: int = 8) -> float:
+        """Price one QLoRA train step over a `tokens`-row batch —
+        forward + backward — so the supervisor path is sim-gateable
+        like serving (train/qlora.make_train_step is the real thing).
+
+        Forward: the serving prefill charge (fused dequant GEMMs +
+        flash attention + the LoRA epilogue). Backward, per projection:
+        the dx term at `roofline.bwd_dx_cost`'s real tile shapes —
+        fused (qbackward kernel) or the XLA remat that writes a bf16
+        copy of W to HBM and reads it back, per the `fused_backward`
+        field; dense (unquantized) configs charge dx plus the fused dW
+        accumulation instead. Flash backward is priced at 2x the
+        forward attention bytes and 2.5x its FLOPs (the dq and dkv
+        passes each re-sweep KV, and the kernel recomputes the
+        probabilities from the saved LSE rather than loading a [T, S]
+        matrix); adapter grads (da/db) double the LoRA epilogue stream.
+        The lm_head (dense bf16 by convention) charges a same-shape dx."""
+        cfg = self.config
+        M = int(tokens)
+        if M <= 0:
+            return self.step_overhead_s
+        lin = self.linear_cost(M)
+        att = flash_prefill_cost(
+            M, M, cfg.num_attention_heads, cfg.num_key_value_heads,
+            cfg.head_dim_, layers=cfg.num_hidden_layers,
+            quantize_kv=False,
+        )
+        lo = self.lora_cost([adapter_rank], M=M)
+
+        qt = self._supported_qtype()
+        shapes = [
+            (cfg.hidden_size, cfg.q_dim + 2 * cfg.kv_dim),
+            (cfg.q_dim, cfg.hidden_size),
+            (cfg.hidden_size, 2 * cfg.intermediate_size),
+            (cfg.intermediate_size, cfg.hidden_size),
+        ]
+        bwd_b = bwd_f = 0
+        for K, O in shapes:
+            if qt is not None:  # frozen low-bit base: dx only
+                c = bwd_dx_cost(qt, M, K, O)
+                bwd_b += (c["fused_bytes"] if self.fused_backward
+                          else c["xla_remat_bytes"])
+                bwd_f += c["flops"]
+            else:  # dense trainable weights: dx + the dW accumulation
+                dw = bwd_dw_cost(M, K, O)
+                bwd_b += K * O * 2 + M * (K + O) * 2 + dw["fused_bytes"]
+                bwd_f += 2 * M * K * O + dw["flops"]
+        bwd_b *= cfg.num_hidden_layers
+        bwd_f *= cfg.num_hidden_layers
+        K, O = cfg.hidden_size, cfg.vocab_size  # lm_head dx, dense bf16
+        bwd_b += K * O * 2 + M * (K + O) * 2
+        bwd_f += 2 * M * K * O
+        bwd_b += 2 * att["bytes"]
+        bwd_f += int(2.5 * att["flops"])
+        bwd_b += 2 * lo["bytes"]
+        bwd_f += 2 * lo["flops"]
+
+        total_b = lin["bytes"] + att["bytes"] + lo["bytes"] + bwd_b
+        total_f = lin["flops"] + att["flops"] + lo["flops"] + bwd_f
+        return (self._seconds(total_b, total_f)
+                + 2 * self.tp_comm_s(M) + self.step_overhead_s)
+
     def kv_copy_s(self, tokens: int) -> float:
         """HBM->HBM KV move (prefill-insert, sub-page prefix copy)."""
         nbytes = 2 * tokens * self.kv_token_bytes()  # read + write
@@ -331,4 +400,5 @@ class CostModel:
             "ici_gbps": self.ici_gbps,
             "comm_qtype": self.comm_qtype,
             "fused_lora": self.fused_lora,
+            "fused_backward": self.fused_backward,
         }

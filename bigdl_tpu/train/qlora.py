@@ -11,14 +11,16 @@ constant; XLA rematerializes the dequant, no custom autograd class
 needed). One jitted train step covers forward, backward, and the optax
 update, sharded over the same (dp, sp, tp) mesh as inference.
 
-The frozen-base matmul in the FORWARD runs on the fused tiled
-dequant-GEMM (ops/linear.py routes training shapes — rows >
-`_GEMV_MAX_ROWS` — to the Pallas kernel under a custom_vjp): base
-weights stay packed in HBM and dequantize tile-by-tile in VMEM instead
-of materializing a bf16 copy per step. The backward's dx = g @ dq(W)
-stays on the XLA rematerialized-dequant path, numerically identical to
-the pre-fused behavior (parity: tests/test_qgemm.py). A fused low-bit
-backward is the ROADMAP follow-up (arxiv 2306.11987).
+The frozen-base matmul runs fused in BOTH directions (ops/linear.py
+routes training shapes — rows > `_GEMV_MAX_ROWS` — to the Pallas kernel
+under a custom_vjp): the forward's y = x @ dq(W)^T and the backward's
+dx = g @ dq(W) both dequantize base-weight tiles in VMEM
+(ops/pallas/qmatmul.py forward, ops/pallas/qbackward.py dx) instead of
+materializing a bf16 copy of W in HBM per step. The old XLA
+rematerialized-dequant backward survives as the parity oracle behind
+`make_train_step(..., fused_backward=False)` /
+`ops.linear.fused_backward_scope(False)` (parity:
+tests/test_qbackward.py; arxiv 2306.11987).
 """
 
 from __future__ import annotations
@@ -181,6 +183,7 @@ def make_train_step(
     batch_axis: str = "dp",
     remat: bool = False,
     return_grad_norm: bool = False,
+    fused_backward: bool = True,
 ):
     """Returns jittable step(params, lora, opt_state, tokens, loss_mask) ->
     (lora, opt_state, loss). Only lora['layers'] is trained (the alpha/rank
@@ -209,6 +212,13 @@ def make_train_step(
     shards rotate over ICI, making attention memory O(T/sp) for
     long-context training. Requires an enclosing mesh context (parallel._compat.set_mesh) and
     sliding_window/softcap-free attention (llama-family default).
+
+    fused_backward=False traces the step with the XLA
+    rematerialized-dequant dx instead of the Pallas fused backward
+    (ops/pallas/qbackward.py) — the parity oracle for A/B-ing loss
+    curves across the flip. The choice is baked into the jaxpr at trace
+    time (ops.linear.fused_backward_scope), so it is per-step-function,
+    not per-call; the supervisor EventLog records which path a run used.
     """
     attention_override = None
     if ring_mesh is not None:
@@ -260,13 +270,19 @@ def make_train_step(
             )
 
     def step(params, lora, opt_state, tokens, loss_mask):
+        from bigdl_tpu.ops.linear import fused_backward_scope
+
         scale = lora["scale"]
-        loss, grads = jax.value_and_grad(
-            lambda layers: next_token_loss(
-                config, inner_forward, params,
-                {"layers": layers, "scale": scale}, tokens, loss_mask,
-            )
-        )(lora["layers"])
+        # the scope is read at TRACE time inside the custom_vjp bwd
+        # rules, so wrapping the value_and_grad call (which runs during
+        # jit tracing of `step`) bakes the chosen dx path into the jaxpr
+        with fused_backward_scope(fused_backward):
+            loss, grads = jax.value_and_grad(
+                lambda layers: next_token_loss(
+                    config, inner_forward, params,
+                    {"layers": layers, "scale": scale}, tokens, loss_mask,
+                )
+            )(lora["layers"])
         updates, opt_state = optimizer.update(grads, opt_state, lora["layers"])
         layers = optax.apply_updates(lora["layers"], updates)
         new_lora = {"layers": layers, "scale": scale}

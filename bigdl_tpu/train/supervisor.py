@@ -271,6 +271,11 @@ class TrainSupervisor:
         wall_clock: Callable[[], float] = time.time,  # epoch-domain ts
         # for the EventLog lines (durations and epochs are different
         # clock domains — a simulated run injects both)
+        fused_backward: Optional[bool] = None,  # which dx path step_fn
+        # was traced with (train/qlora.make_train_step's knob): recorded
+        # in the EventLog at run start so loss curves compared across
+        # the fused/remat flip carry their provenance. None = the caller
+        # didn't say (pre-knob step_fn); nothing is recorded.
     ):
         from bigdl_tpu.parallel.health import HealthMonitor
 
@@ -311,6 +316,7 @@ class TrainSupervisor:
             root, ext = os.path.splitext(name)
             name = f"{root}.r{process_index}{ext or '.jsonl'}"
         self.tracer = tracer
+        self.fused_backward = fused_backward
         self.events = EventLog(os.path.join(ckpt_dir, name),
                                tracer=tracer, clock=wall_clock)
         self._wd: Optional[StepWatchdog] = None
@@ -393,6 +399,15 @@ class TrainSupervisor:
         step args after lora/opt_state (a deterministic-by-step fn makes
         skip/rollback replays exact; a stream that ignores `step` is
         fine for stochastic data). Returns the final state dict."""
+        if self.fused_backward is not None:
+            # one provenance event per run, not per step: `bigdl-tpu
+            # train-status` surfaces it so two loss curves can be told
+            # apart by backward path after the fact
+            self.events.emit(
+                "backward", self.step,
+                path=("fused_pallas" if self.fused_backward
+                      else "xla_remat"),
+            )
         try:
             while self.step < total_steps:
                 self._check_preempt()

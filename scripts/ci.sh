@@ -52,6 +52,10 @@ if [[ "${1:-}" == "--core" ]]; then
   echo "   tiled dequant-GEMM dispatch coverage + parity matrix straddling"
   echo "   _GEMV_MAX_ROWS and the QLoRA fused-base train-step parity"
   echo "   (test_qgemm -m core) +"
+  echo "   fused low-bit backward: dx/dW grad parity for every qtype at"
+  echo "   M in {1,32,33,512}, vjp routing + fused_backward knob parity,"
+  echo "   decode_kv bit-identity across the fp8-KV epilogues"
+  echo "   (test_qbackward -m core) +"
   echo "   fault-injection chaos suite (CPU-only; slow storm variants excluded) +"
   echo "   storage-corruption matrix (test_durability: injected bit_flip/"
   echo "   truncate/torn_rename/drop_file x checkpoint/train/journal) +"

@@ -916,6 +916,103 @@ def test_dsp003_spec_for_must_cover_every_storage_or_default():
     """, "bigdl_tpu/ops/pallas/qdecode.py", "DSP003") == []
 
 
+def test_dsp001_backward_column_requires_kernel_or_exemption():
+    # the factory's bwd default is None: an entry that neither passes a
+    # kernel nor states bwd_exempt is the silent XLA-remat fallback
+    fs = lint("""
+        def _entry(k_multiple, run, bwd=None, bwd_exempt=None):
+            return _GemvEntry(k_multiple, run, gemm=run, bwd=bwd,
+                              bwd_exempt=bwd_exempt)
+
+        _QGEMV_QTYPES = {
+            "sym_int4": _entry(64, None),
+            "nf4": _entry(128, None, bwd_exempt="codebook gather only"),
+            "sym_int8": _entry(32, None, bwd=_run_dx),
+        }
+    """, "bigdl_tpu/ops/linear.py", "DSP001")
+    bwd = [f for f in fs if "neither a fused backward" in f.message]
+    assert len(bwd) == 1 and "sym_int4" in bwd[0].message
+
+
+def test_dsp001_backward_column_direct_gemventry_literal():
+    fs = lint("""
+        _QGEMV_QTYPES = {
+            "sym_int4": _GemvEntry(64, _run_sym_int4),
+        }
+    """, "bigdl_tpu/ops/linear.py", "DSP001")
+    bwd = [f for f in fs if "neither a fused backward" in f.message]
+    assert len(bwd) == 1  # NamedTuple default bwd=None, no exemption
+
+
+def test_dsp003_bwd_k_multiple_must_respect_block_and_forward():
+    # sym_int4's block_size is 32: bwd_k_multiple=48 splits quant blocks
+    # AND refines the forward alignment (48 % 64 != 0) — two findings
+    fs = lint("""
+        _QGEMV_QTYPES = {
+            "sym_int4": _GemvEntry(64, None, bwd=None,
+                                   bwd_exempt="x", bwd_k_multiple=48),
+        }
+    """, "bigdl_tpu/ops/linear.py", "DSP003")
+    assert any("block_size" in f.message for f in fs)
+    assert any("forward k_multiple" in f.message for f in fs)
+    # a coarsening multiple of both is fine
+    assert lint("""
+        _QGEMV_QTYPES = {
+            "sym_int4": _GemvEntry(64, None, bwd=None,
+                                   bwd_exempt="x", bwd_k_multiple=128),
+        }
+    """, "bigdl_tpu/ops/linear.py", "DSP003") == []
+
+
+def test_dsp003_real_linear_backward_geometry_clean():
+    fs = [f for f in lc.lint_paths(
+        [os.path.join(REPO, "bigdl_tpu/ops/linear.py")])
+        if f.rule == "DSP003"]
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
+def test_dsp006_inline_kv_astype_fires():
+    fs = lint("""
+        def _kernel(q_ref, k_ref, v_ref, o_ref):
+            q = q_ref[0, 0].astype(jnp.float32)
+            k = k_ref[0, 0].astype(jnp.float32)
+            v = qdecode.decode_kv(v_ref[0, 0])
+    """, "bigdl_tpu/ops/pallas/flash_attention.py", "DSP006")
+    assert len(fs) == 1 and "k_ref" in fs[0].message
+    # q_ref is not a KV tile; decode_kv'd v is the blessed path
+
+
+def test_dsp006_direct_decode_values_in_epilogue_fires():
+    fs = lint("""
+        def _kernel(k_ref, o_ref):
+            k = decode_values(k_ref[0, 0], ("e5m2",))
+    """, "bigdl_tpu/ops/pallas/paged_attention.py", "DSP006")
+    assert any("decode_values" in f.message for f in fs)
+
+
+def test_dsp006_missing_decode_kv_is_a_regression():
+    fs = lint("""
+        def _kernel(k_ref, v_ref, o_ref):
+            k = k_ref[0, 0] * 1.0
+    """, "bigdl_tpu/ops/pallas/flash_backward.py", "DSP006")
+    assert len(fs) == 1 and "regressed" in fs[0].message
+
+
+def test_dsp006_scope_is_the_attention_epilogues_only():
+    assert lint("""
+        def _kernel(k_ref, o_ref):
+            k = k_ref[0, 0].astype(jnp.float32)
+    """, "bigdl_tpu/ops/pallas/qmatmul.py", "DSP006") == []
+
+
+def test_dsp006_real_attention_files_clean():
+    paths = [os.path.join(REPO, "bigdl_tpu/ops/pallas", n) for n in
+             ("flash_attention.py", "paged_attention.py",
+              "flash_backward.py")]
+    fs = [f for f in lc.lint_paths(paths) if f.rule == "DSP006"]
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
 def test_dsp004_restated_budget_literal_in_ops_fires():
     # 5 MiB == VMEM_BUDGET // 2 (tiling.py) — the exact drift this PR
     # fixed in linear._fused_kernel
